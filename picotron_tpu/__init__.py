@@ -1,0 +1,18 @@
+"""picotron-tpu: a minimal TPU-native 4D-parallel pre-training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of rkinas/picotron
+(torch.distributed + NCCL + CUDA/Triton) for TPU:
+
+- one named device mesh ``('dp', 'pp', 'cp', 'tp')`` over ICI/DCN instead of
+  torch.distributed process groups (reference: picotron/process_group_manager.py)
+- ``shard_map`` + ``lax`` collectives (psum / all_gather / ppermute) instead of
+  NCCL all-reduce / batched p2p (reference: the four */_communications.py files)
+- Pallas TPU kernels for flash attention and RMSNorm instead of flash-attn CUDA
+  and Triton kernels (reference: picotron/model.py:32-64)
+- optax AdamW, HF datasets/tokenizers, orbax-style sharded checkpoints.
+"""
+
+__version__ = "0.1.0"
+
+from picotron_tpu.config import Config  # noqa: F401
+from picotron_tpu.topology import Topology  # noqa: F401

@@ -1,0 +1,189 @@
+"""Experiment configuration.
+
+One JSON file per experiment, schema mirroring the reference's
+``template/base_config.json:1-52`` (sections: distributed / model / training /
+dataset / checkpoint / logging). The reference's second, implicit config
+channel — environment variables like FLASH_ATTEN / CONTEXT_PARALLEL / DTYPE
+(reference train.py:65-68, model.py:147) — is deliberately replaced by explicit
+fields here (``model.attention_impl``, ``model.dtype``); SURVEY.md §5.6 calls
+that channel an implementation wart, not a capability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class DistributedConfig:
+    """4D topology sizes. Grid ordering is (dp, pp, cp, tp), tp fastest-varying,
+    mirroring the reference rank grid (process_group_manager.py:13) so that tp
+    neighbors sit on the innermost ICI dimension and dp on the outermost."""
+
+    tp_size: int = 1
+    cp_size: int = 1
+    pp_size: int = 1
+    dp_size: int = 1
+    pp_engine: str = "1f1b"  # "afab" | "1f1b"   (reference train.py:223-229)
+    use_cpu: bool = False  # run on host CPU devices (reference gloo path, train.py:83)
+
+
+@dataclass
+class ModelConfig:
+    name: str = "HuggingFaceTB/SmolLM-1.7B"
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    hidden_size: int = 2048
+    intermediate_size: int = 8192
+    vocab_size: int = 49152
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 2048
+    dtype: str = "bfloat16"  # compute/param dtype (reference train.py:76-77)
+    # "auto": pallas flash attention on TPU, XLA sdpa elsewhere.
+    # Replaces the reference's FLASH_ATTEN env switch (model.py:147-157).
+    attention_impl: str = "auto"  # "auto" | "sdpa" | "flash"
+    use_pallas_rmsnorm: Optional[bool] = None  # None = auto (TPU only)
+    # gather logits over tp before the loss (reference tensor_parallel.py:48-50
+    # gather_output=True); False = vocab-parallel cross-entropy (faster).
+    gather_logits: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+@dataclass
+class TrainingConfig:
+    seed: int = 42
+    learning_rate: float = 3e-4
+    # torch AdamW defaults — the reference passes only lr (train.py:209)
+    weight_decay: float = 0.01
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    grad_clip: float = 0.0  # 0 = off
+    total_train_steps: int = 100
+    seq_length: int = 1024
+    micro_batch_size: int = 1
+    gradient_accumulation_steps: int = 1
+    max_tokens: Optional[int] = None
+    # "full": remat every decoder layer (jax.checkpoint); "none": store all.
+    remat: str = "full"
+
+
+@dataclass
+class DatasetConfig:
+    name: str = "synthetic"  # "synthetic" or an HF dataset path
+    split: str = "train"
+    text_column: str = "text"
+    num_workers: int = 0
+    num_proc: int = 1
+    subset_name: Optional[str] = None
+
+
+@dataclass
+class CheckpointConfig:
+    save_dir: str = "checkpoints"
+    save_frequency: int = 0  # 0 = disabled
+    load_path: str = ""
+
+
+@dataclass
+class LoggingConfig:
+    use_wandb: bool = False
+    run_name: str = "picotron-tpu"
+    log_frequency: int = 1
+
+
+@dataclass
+class Config:
+    distributed: DistributedConfig = field(default_factory=DistributedConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
+    dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+
+    @property
+    def world_size(self) -> int:
+        d = self.distributed
+        return d.tp_size * d.cp_size * d.pp_size * d.dp_size
+
+    @property
+    def global_batch_size(self) -> int:
+        """micro_batch * grad_acc * dp  (reference data.py:17)."""
+        return (
+            self.training.micro_batch_size
+            * self.training.gradient_accumulation_steps
+            * self.distributed.dp_size
+        )
+
+    @property
+    def tokens_per_step(self) -> int:
+        return self.global_batch_size * self.training.seq_length
+
+    def validate(self) -> None:
+        """Divisibility constraints, surfaced as errors the way the reference
+        uses asserts (train.py:85-86, model.py:94-95, tensor_parallel.py:226)."""
+        d, m, t = self.distributed, self.model, self.training
+        if t.seq_length % d.cp_size != 0:
+            raise ValueError(f"seq_length {t.seq_length} % cp_size {d.cp_size} != 0")
+        if m.num_attention_heads % d.tp_size != 0:
+            raise ValueError(f"num_attention_heads {m.num_attention_heads} % tp_size {d.tp_size} != 0")
+        if m.num_key_value_heads % d.tp_size != 0:
+            raise ValueError(f"num_key_value_heads {m.num_key_value_heads} % tp_size {d.tp_size} != 0")
+        if m.num_attention_heads % m.num_key_value_heads != 0:
+            raise ValueError("num_attention_heads must be a multiple of num_key_value_heads")
+        if m.vocab_size % d.tp_size != 0:
+            raise ValueError(f"vocab_size {m.vocab_size} % tp_size {d.tp_size} != 0")
+        if m.hidden_size % m.num_attention_heads != 0:
+            raise ValueError("hidden_size must be divisible by num_attention_heads")
+        if m.num_hidden_layers % d.pp_size != 0:
+            # The reference gives remainder layers to the earliest stages
+            # (pipeline_parallel.py:33-36); the SPMD pipeline needs equal
+            # stages, so we require divisibility instead.
+            raise ValueError(f"num_hidden_layers {m.num_hidden_layers} % pp_size {d.pp_size} != 0")
+        if d.pp_size > 1 and t.gradient_accumulation_steps < 1:
+            raise ValueError("pipeline parallelism needs >= 1 microbatch")
+        if d.pp_engine not in ("afab", "1f1b"):
+            raise ValueError(f"unknown pp_engine {d.pp_engine!r} (afab|1f1b)")
+        if t.seq_length > m.max_position_embeddings:
+            raise ValueError(
+                f"seq_length {t.seq_length} > max_position_embeddings "
+                f"{m.max_position_embeddings}")
+
+    # ---- JSON round-trip (reference: train.py:62-63 consumes one JSON file) ----
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "Config":
+        def build(dc, section: dict):
+            known = {f.name for f in dataclasses.fields(dc)}
+            return dc(**{k: v for k, v in section.items() if k in known})
+
+        cfg = cls(
+            distributed=build(DistributedConfig, raw.get("distributed", {})),
+            model=build(ModelConfig, raw.get("model", {})),
+            training=build(TrainingConfig, raw.get("training", {})),
+            dataset=build(DatasetConfig, raw.get("dataset", {})),
+            checkpoint=build(CheckpointConfig, raw.get("checkpoint", {})),
+            logging=build(LoggingConfig, raw.get("logging", {})),
+        )
+        cfg.validate()
+        return cfg
+
+    @classmethod
+    def from_json(cls, path: str) -> "Config":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))

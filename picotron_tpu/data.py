@@ -1,0 +1,127 @@
+"""Data pipeline: tokenize, pack, shard.
+
+Re-design of the reference's MicroBatchDataLoader (picotron/data.py): HF
+dataset load + tokenizer, pack token stream into seq_length+1 chunks
+(data.py:57-100), dp-sharded sampling with interleaved assignment
+(DistributedSampler semantics, shuffle=False, data.py:40-45), infinite
+iterator bumping the epoch on wrap (data.py:118-137). Differences that fall
+out of single-controller JAX:
+
+- the loader yields the *global* batch [grad_acc, mbs*dp, seq]; the dp split
+  and the per-rank contiguous CP sequence slice (reference collate,
+  data.py:102-116) happen by sharding the array (None,'dp','cp') rather than
+  by per-process slicing — same math, zero data movement code;
+- no tokenizer broadcast (data.py:23-32): there is one process;
+- a built-in "synthetic" source (deterministic affine-chain token stream)
+  because TPU test environments are often offline; any HF dataset path works
+  when the hub is reachable.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from picotron_tpu.config import Config
+
+
+def synthetic_corpus(vocab_size: int, length: int, seed: int) -> np.ndarray:
+    """Deterministic, learnable token stream: a noisy affine bigram chain
+    (next = a*t + b mod V, with occasional random jumps) so loss curves fall
+    measurably below ln(V) once the model learns the transitions."""
+    rng = np.random.default_rng(seed)
+    a = int(rng.integers(1, vocab_size))
+    b = int(rng.integers(0, vocab_size))
+    toks = np.empty(length, dtype=np.int32)
+    toks[0] = rng.integers(0, vocab_size)
+    jumps = rng.random(length) < 0.05
+    jump_vals = rng.integers(0, vocab_size, length)
+    for i in range(1, length):
+        toks[i] = jump_vals[i] if jumps[i] else (a * int(toks[i - 1]) + b) % vocab_size
+    return toks
+
+
+def _pack(stream: np.ndarray, chunk: int) -> np.ndarray:
+    n = len(stream) // chunk
+    return stream[: n * chunk].reshape(n, chunk)
+
+
+class MicroBatchDataLoader:
+    """Yields {'input_ids','target_ids'}: int32 [grad_acc, mbs*dp, seq_length]."""
+
+    def __init__(self, cfg: Config, tokenizer=None):
+        t, d = cfg.training, cfg.distributed
+        self.seq_length = t.seq_length
+        self.micro_batch_size = t.micro_batch_size
+        self.grad_acc = t.gradient_accumulation_steps
+        self.dp_size = d.dp_size
+        self.global_batch_size = cfg.global_batch_size  # mbs*acc*dp (data.py:17)
+        self.rows_per_step = t.micro_batch_size * d.dp_size
+        self.tokenizer = tokenizer
+
+        if cfg.dataset.name == "synthetic":
+            stream = synthetic_corpus(
+                cfg.model.vocab_size,
+                max(2_000_000, 64 * self.rows_per_step * (t.seq_length + 1)),
+                cfg.training.seed,
+            )
+        else:
+            stream = self._load_hf_stream(cfg, tokenizer)
+        # pack into seq_length+1 so input/target are shifted views
+        # (reference data.py:88-96)
+        self.samples = _pack(stream, self.seq_length + 1)
+        if len(self.samples) < self.rows_per_step:
+            raise ValueError("dataset too small for one global batch")
+        self._epoch = 0
+        self._cursor = 0
+
+    @staticmethod
+    def _load_hf_stream(cfg: Config, tokenizer) -> np.ndarray:
+        import datasets  # deferred: offline environments use "synthetic"
+
+        if tokenizer is None:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(cfg.model.name)
+        ds = datasets.load_dataset(
+            cfg.dataset.name, cfg.dataset.subset_name, split=cfg.dataset.split
+        )
+        col = cfg.dataset.text_column
+
+        def tok(batch):
+            return {"ids": tokenizer(batch[col])["input_ids"]}
+
+        ds = ds.map(tok, batched=True, num_proc=max(cfg.dataset.num_proc, 1),
+                    remove_columns=ds.column_names)
+        return np.concatenate([np.asarray(x, np.int32) for x in ds["ids"]])
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def _next_rows(self, n: int) -> np.ndarray:
+        """n consecutive global samples, wrapping epochs (data.py:118-137)."""
+        out = []
+        while n > 0:
+            take = min(n, len(self.samples) - self._cursor)
+            out.append(self.samples[self._cursor : self._cursor + take])
+            self._cursor += take
+            n -= take
+            if self._cursor == len(self.samples):
+                self._cursor = 0
+                self._epoch += 1
+        return np.concatenate(out, 0)
+
+    def __next__(self) -> dict:
+        M, R = self.grad_acc, self.rows_per_step
+        rows = self._next_rows(M * R)
+        # DistributedSampler(shuffle=False) hands sample i to dp rank i % dp
+        # (data.py:40-45); row-major [dp, mbs] layout after this gather puts
+        # each rank's rows contiguous for the 'dp' sharding.
+        rows = rows.reshape(M, R, self.seq_length + 1)
+        idx = np.arange(R).reshape(self.micro_batch_size, self.dp_size).T.reshape(-1)
+        rows = rows[:, idx]
+        return {
+            "input_ids": np.ascontiguousarray(rows[:, :, :-1]),
+            "target_ids": np.ascontiguousarray(rows[:, :, 1:]),
+        }

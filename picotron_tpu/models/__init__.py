@@ -1,0 +1,1 @@
+from picotron_tpu.models import llama  # noqa: F401

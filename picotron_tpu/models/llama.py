@@ -1,0 +1,259 @@
+"""Llama-family decoder as a pure function over a parameter pytree.
+
+Architecture spec from the reference (picotron/model.py): Embedding ->
+N x DecoderLayer (RMSNorm -> Attention(+RoPE, GQA) -> residual -> RMSNorm ->
+SwiGLU MLP -> residual) -> final RMSNorm -> LM head (untied, model.py:226-271).
+Init laws preserved so loss curves can match: linear weights
+U(-sqrt(1/fan_in), sqrt(1/fan_in)) (model.py:109-119, 172-181), embedding
+N(0, 1) (model.py:220-221), norm weights ones.
+
+Parallelism is built in rather than layered on by module surgery
+(reference train.py:174-193):
+- TP: weights arrive pre-sharded by shard_map; column-parallel = tp_copy + local
+  matmul, row-parallel = local matmul + tp_reduce (reference
+  tensor_parallel.py:35-50 module-swap table). Head counts are local,
+  nh/tp and nkv/tp, as in model.py:94-97.
+- CP: attention switches to ring_attention when cp_size > 1 (the reference's
+  CONTEXT_PARALLEL branch, model.py:147-150); RoPE tables are sliced to the
+  local chunk (model.py:201).
+- PP: ``stage_apply`` is the uniform per-stage program — embedding applied on
+  the first stage, loss on the last, selected by the traced 'pp' axis index
+  (replacing the reference's per-stage nn.Identity surgery,
+  pipeline_parallel.py:12-15).
+
+Parameter layout: linear weights are stored (in_features, out_features) so the
+forward is ``x @ w``; decoder layers are stacked on a leading layer axis and
+scanned, which is also the axis pipeline parallelism shards.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from picotron_tpu.config import Config, ModelConfig
+from picotron_tpu.ops.attention import sdpa
+from picotron_tpu.ops.cross_entropy import (
+    cross_entropy_gathered,
+    cross_entropy_vocab_parallel,
+)
+from picotron_tpu.ops.rmsnorm import rms_norm
+from picotron_tpu.ops.rope import apply_rope, precompute_rope
+from picotron_tpu.parallel.cp import ring_attention
+from picotron_tpu.parallel.tp import tp_copy, tp_gather, tp_reduce
+
+Params = dict[str, Any]
+
+
+# --------------------------------------------------------------------------- #
+# init
+# --------------------------------------------------------------------------- #
+
+
+def _uniform(key, shape, fan_in, dtype):
+    bound = math.sqrt(1.0 / fan_in)
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound).astype(dtype)
+
+
+def init_params(key, m: ModelConfig) -> Params:
+    """Global (unsharded-shape) parameter pytree. Jit with out_shardings to
+    materialize directly as sharded arrays — replaces the reference's
+    meta-device init + materialization dance (checkpoint.py:15-48, 50-102)."""
+    H, I, V, L = m.hidden_size, m.intermediate_size, m.vocab_size, m.num_hidden_layers
+    D = m.head_dim
+    Hq, Hkv = m.num_attention_heads * D, m.num_key_value_heads * D
+    dt = jnp.dtype(m.dtype)
+    ks = {name: jax.random.fold_in(key, i) for i, name in enumerate(
+        ["embed", "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"])}
+    ones = lambda *shape: jnp.ones(shape, dt)
+    return {
+        "embed": jax.random.normal(ks["embed"], (V, H), jnp.float32).astype(dt),
+        "layers": {
+            "attn_norm": ones(L, H),
+            "wq": _uniform(ks["wq"], (L, H, Hq), H, dt),
+            "wk": _uniform(ks["wk"], (L, H, Hkv), H, dt),
+            "wv": _uniform(ks["wv"], (L, H, Hkv), H, dt),
+            "wo": _uniform(ks["wo"], (L, Hq, H), Hq, dt),
+            "mlp_norm": ones(L, H),
+            "w_gate": _uniform(ks["w_gate"], (L, H, I), H, dt),
+            "w_up": _uniform(ks["w_up"], (L, H, I), H, dt),
+            "w_down": _uniform(ks["w_down"], (L, I, H), I, dt),
+        },
+        "final_norm": ones(H),
+        "lm_head": _uniform(ks["lm_head"], (H, V), H, dt),
+    }
+
+
+def param_pspecs(_: ModelConfig) -> Params:
+    """PartitionSpecs: layer stack sharded over 'pp' (contiguous stage slices,
+    the rule at reference pipeline_parallel.py:33-36), column-parallel weights
+    shard out-features over 'tp', row-parallel shard in-features, embedding is
+    vocab-parallel (reference tensor_parallel.py:35-50); embed/final_norm/
+    lm_head are replicated across 'pp' stages. Everything replicated over
+    'dp' and 'cp'."""
+    return {
+        "embed": P("tp", None),
+        "layers": {
+            "attn_norm": P("pp", None),
+            "wq": P("pp", None, "tp"),
+            "wk": P("pp", None, "tp"),
+            "wv": P("pp", None, "tp"),
+            "wo": P("pp", "tp", None),
+            "mlp_norm": P("pp", None),
+            "w_gate": P("pp", None, "tp"),
+            "w_up": P("pp", None, "tp"),
+            "w_down": P("pp", "tp", None),
+        },
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# forward pieces (all run inside shard_map; collectives over size-1 axes are free)
+# --------------------------------------------------------------------------- #
+
+
+def embed_lookup(w, tokens):
+    """Vocab-parallel embedding: mask out-of-shard tokens, psum partials
+    (reference VocabParallelEmbedding, tensor_parallel.py:246-271)."""
+    v_local = w.shape[0]
+    start = lax.axis_index("tp") * v_local
+    local = tokens - start
+    ok = (local >= 0) & (local < v_local)
+    e = jnp.take(w, jnp.clip(local, 0, v_local - 1), axis=0)
+    e = e * ok[..., None].astype(w.dtype)
+    return tp_reduce(e)
+
+
+def _attention(q, k, v, cfg: Config):
+    scale = 1.0 / math.sqrt(cfg.model.head_dim)
+    if cfg.distributed.cp_size > 1:
+        return ring_attention(q, k, v, scale, "cp", cfg.distributed.cp_size, True)
+    impl = cfg.model.attention_impl
+    if impl == "auto":
+        # TODO(flash): flip to the Pallas kernel on TPU once ops/pallas lands
+        impl = "sdpa"
+    if impl == "flash":
+        from picotron_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, scale, causal=True)
+    return sdpa(q, k, v, scale, causal=True)
+
+
+def decoder_layer(lp, h, cos, sin, cfg: Config):
+    """One decoder block with per-shard head counts (model.py:94-97,187-208)."""
+    m, tp = cfg.model, cfg.distributed.tp_size
+    nh, nkv, D = m.num_attention_heads // tp, m.num_key_value_heads // tp, m.head_dim
+    B, S, _ = h.shape
+
+    # attention sub-block: column(q,k,v) -> rope -> attn -> row(out)
+    x = rms_norm(h, lp["attn_norm"], m.rms_norm_eps)
+    x = tp_copy(x)
+    q = (x @ lp["wq"]).reshape(B, S, nh, D)
+    k = (x @ lp["wk"]).reshape(B, S, nkv, D)
+    v = (x @ lp["wv"]).reshape(B, S, nkv, D)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if nkv != nh:  # GQA: repeat kv heads (model.py:141-142)
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    o = _attention(q, k, v, cfg).reshape(B, S, nh * D)
+    h = h + tp_reduce(o @ lp["wo"])
+
+    # MLP sub-block: column(gate,up) -> SwiGLU -> row(down)  (model.py:163-185)
+    x = rms_norm(h, lp["mlp_norm"], m.rms_norm_eps)
+    x = tp_copy(x)
+    y = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
+    return h + tp_reduce(y @ lp["w_down"])
+
+
+def layers_forward(stacked, h, cos, sin, cfg: Config):
+    """Scan over the locally-held layer stack (this stage's contiguous slice)."""
+
+    def body(h, lp):
+        return decoder_layer(lp, h, cos, sin, cfg), None
+
+    if cfg.training.remat == "full":
+        body = jax.checkpoint(body)
+    h, _ = lax.scan(body, h, stacked)
+    return h
+
+
+def head_logits(params, h, m: ModelConfig):
+    """Final norm + untied LM head (the reference always creates a fresh
+    untied head, checkpoint.py:88-91); logits stay vocab-sharded."""
+    x = rms_norm(h, params["final_norm"], m.rms_norm_eps)
+    x = tp_copy(x)
+    return x @ params["lm_head"]
+
+
+def _loss(logits_local, targets, m: ModelConfig):
+    if m.gather_logits:
+        return cross_entropy_gathered(logits_local, targets)
+    return cross_entropy_vocab_parallel(logits_local, targets)
+
+
+def rope_tables(cfg: Config):
+    """Full-sequence tables; sliced per cp rank inside the step."""
+    return precompute_rope(
+        cfg.training.seq_length, cfg.model.head_dim, cfg.model.rope_theta,
+        jnp.dtype(cfg.model.dtype))
+
+
+def slice_rope_for_cp(cos, sin, s_local):
+    """Each cp rank's chunk of the angle tables (reference model.py:201,
+    context_parallel.py:189-195)."""
+    start = lax.axis_index("cp") * s_local
+    return (lax.dynamic_slice_in_dim(cos, start, s_local, 0),
+            lax.dynamic_slice_in_dim(sin, start, s_local, 0))
+
+
+def stage_apply(params, h_recv, tokens, targets, cos, sin, cfg: Config):
+    """The uniform per-pipeline-stage program. Returns (h_out, loss) where
+    h_out is the activation sent downstream (pre-final-norm) and loss is
+    nonzero only on the last stage (reference computes loss only there,
+    pipeline_parallel.py:67-69, 97-100)."""
+    pp = cfg.distributed.pp_size
+    stage = lax.axis_index("pp")
+    is_first = stage == 0
+    is_last = stage == pp - 1
+    dt = jnp.dtype(cfg.model.dtype)
+
+    emb = embed_lookup(params["embed"], tokens).astype(dt)
+    h = jnp.where(is_first, emb, h_recv)
+    s_local = tokens.shape[-1]
+    cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local)
+    h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)
+    logits = head_logits(params, h, cfg.model)
+    loss = _loss(logits, targets, cfg.model)
+    return h, jnp.where(is_last, loss, 0.0)
+
+
+def forward_logits(params, tokens, cfg: Config, gather: bool = True):
+    """Whole-model forward to logits (no pipeline), for eval/tests. Runs inside
+    shard_map; with a 1-device mesh this is the plain single-chip model."""
+    cos, sin = rope_tables(cfg)
+    dt = jnp.dtype(cfg.model.dtype)
+    h = embed_lookup(params["embed"], tokens).astype(dt)
+    s_local = tokens.shape[-1]
+    cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local)
+    h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)
+    logits = head_logits(params, h, cfg.model)
+    return tp_gather(logits) if gather else logits
+
+
+def num_params(m: ModelConfig) -> int:
+    """Global parameter count (the reference reconstructs this across shards,
+    utils.py:52-79; here it's arithmetic)."""
+    H, I, V, L, D = (m.hidden_size, m.intermediate_size, m.vocab_size,
+                     m.num_hidden_layers, m.head_dim)
+    per_layer = (H * m.num_attention_heads * D + 2 * H * m.num_key_value_heads * D
+                 + m.num_attention_heads * D * H + 3 * H * I + 2 * H)
+    return V * H + L * per_layer + H + H * V

@@ -1,0 +1,3 @@
+from picotron_tpu.ops.rope import precompute_rope, apply_rope  # noqa: F401
+from picotron_tpu.ops.rmsnorm import rms_norm  # noqa: F401
+from picotron_tpu.ops.attention import sdpa, block_attention  # noqa: F401

@@ -1,0 +1,68 @@
+"""Attention: XLA scaled-dot-product reference path + LSE-returning block form.
+
+The reference has a 3-way backend switch in Attention.forward
+(picotron/model.py:147-157): ring attention (CP), flash-attn CUDA kernel, or
+torch SDPA. Here:
+
+- ``sdpa`` is the XLA path (and CPU test oracle): fp32 softmax, causal mask.
+- ``block_attention`` additionally returns the log-sum-exp per query row; it is
+  the building block that the ring-attention loop merges across K/V blocks
+  (LSE-merge numerics spec: reference context_parallel.py:112-128, 157-187).
+- the Pallas TPU flash-attention kernel lives in ops/pallas/flash_attention.py.
+
+All functions take q/k/v with the SAME number of heads — GQA repetition
+(reference model.py:141-142 repeat_interleave) happens in the model, so its
+gradient (sum over repeated heads) falls out of autodiff.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax rows with no
+# visible keys finite (they appear in ring attention's skipped blocks)
+
+
+def _causal_mask(s_q: int, s_k: int, q_offset) -> jnp.ndarray:
+    """[s_q, s_k] boolean, True = attend. Query i (global position q_offset+i)
+    may see key j (global position given by the caller's block layout)."""
+    qi = jnp.arange(s_q)[:, None] + q_offset
+    kj = jnp.arange(s_k)[None, :]
+    return qi >= kj
+
+
+def block_attention(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Sk, H, D]
+    v: jnp.ndarray,  # [B, Sk, H, D]
+    scale: float,
+    mask: Optional[jnp.ndarray] = None,  # [Sq, Sk] or broadcastable, True=attend
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (out float32 [B, Sq, H, D], lse float32 [B, Sq, H])."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    # a fully-masked row has m = NEG_INF, p = 1, lse ~ NEG_INF + log(s_k):
+    # finite garbage whose tiny LSE makes ring attention's merge discard it
+    p = jnp.exp(scores - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    lse = (m + jnp.log(denom))[..., 0]  # [B, H, Sq]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / denom, v.astype(jnp.float32))
+    return out, lse.transpose(0, 2, 1)  # lse -> [B, Sq, H]
+
+
+def sdpa(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: float,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Plain attention, fp32 softmax, output cast back to q.dtype."""
+    mask = _causal_mask(q.shape[1], k.shape[1], 0) if causal else None
+    out, _ = block_attention(q, k, v, scale, mask)
+    return out.astype(q.dtype)

@@ -1,0 +1,50 @@
+"""Cross-entropy over (possibly vocab-sharded) logits.
+
+The reference gathers tensor-parallel logits before the loss — the final
+projection is ColumnParallel with gather_output=True
+(tensor_parallel.py:48-50, all-gather at tp_communications.py:51-72) and the
+loss is plain F.cross_entropy (train.py:46-49). ``cross_entropy_gathered``
+reproduces that. ``cross_entropy_vocab_parallel`` is the TPU-native fast path:
+it never materializes the gathered [B,S,V] tensor, computing the global
+log-sum-exp and target logit with a pmax/psum pair over 'tp' instead
+(selected by model.gather_logits=False).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_gathered(logits_local, targets, tp_axis: str = "tp"):
+    """logits_local: [B, S, V/tp] shard; targets: [B, S] global token ids.
+    Returns mean loss (float32 scalar)."""
+    logits = jax.lax.all_gather(logits_local, tp_axis, axis=-1, tiled=True)
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - target_logit)
+
+
+def cross_entropy_vocab_parallel(logits_local, targets, tp_axis: str = "tp"):
+    """Same value as cross_entropy_gathered without materializing full logits."""
+    logits32 = logits_local.astype(jnp.float32)
+    v_local = logits32.shape[-1]
+    shard = jax.lax.axis_index(tp_axis)
+    vocab_start = shard * v_local
+
+    local_max = jnp.max(logits32, axis=-1)
+    # stop_gradient (inside, so pmax never sees a tangent — it has no
+    # differentiation rule) is exact: the max shift cancels analytically in
+    # logz - target_logit.
+    global_max = jax.lax.pmax(jax.lax.stop_gradient(local_max), tp_axis)
+    sumexp = jnp.sum(jnp.exp(logits32 - global_max[..., None]), axis=-1)
+    global_sumexp = jax.lax.psum(sumexp, tp_axis)
+    logz = global_max + jnp.log(global_sumexp)
+
+    local_ids = targets - vocab_start
+    in_range = (local_ids >= 0) & (local_ids < v_local)
+    safe_ids = jnp.clip(local_ids, 0, v_local - 1)
+    picked = jnp.take_along_axis(logits32, safe_ids[..., None], axis=-1)[..., 0]
+    target_logit = jax.lax.psum(jnp.where(in_range, picked, 0.0), tp_axis)
+    return jnp.mean(logz - target_logit)

@@ -1,0 +1,22 @@
+"""RMSNorm.
+
+Numerics spec from the reference's pure-torch LlamaRMSNorm
+(picotron/model.py:66-85): variance in float32, ``x * rsqrt(var + eps)`` cast
+back to the input dtype, then scaled by the (learned) weight. The reference's
+fast path is a Triton kernel (TritonRMSNorm, model.py:38-64); the TPU-native
+fast path is the Pallas kernel in picotron_tpu/ops/pallas/rmsnorm.py — this
+module is the XLA-fused formulation used on CPU and as the oracle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = (x32 * jax.lax.rsqrt(var + eps)).astype(dtype)
+    return normed * weight

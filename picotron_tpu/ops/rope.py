@@ -1,0 +1,37 @@
+"""Rotary position embeddings (GPT-NeoX / HF-Llama rotate_half convention).
+
+Numerics spec from the reference (picotron/model.py:15-30): inverse frequencies
+computed in float32, angle table cos/sin(pos * theta) tiled to head_dim
+(torch ``.repeat(1, 2)`` = concatenation), cast to compute dtype once; applied
+as ``x * cos + rotate_half(x) * sin`` with rotate_half = [-x2, x1]. The
+reference fuses this with a CUDA kernel when FLASH_ATTEN=1 (model.py:130-136);
+on TPU the mul/add chain fuses into the surrounding matmuls under XLA, so no
+Pallas kernel is needed for parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def precompute_rope(seq_length: int, head_dim: int, base: float, dtype) -> tuple:
+    """Return (cos, sin), each [seq_length, head_dim], computed in float64/32
+    on host for stable numerics (reference computes on CPU fp32, model.py:23)."""
+    assert head_dim % 2 == 0
+    inv_freq = 1.0 / (base ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    pos = np.arange(seq_length, dtype=np.float64)[:, None]  # [S, 1]
+    angles = pos * inv_freq[None, :]  # [S, head_dim/2]
+    cos = np.concatenate([np.cos(angles), np.cos(angles)], axis=-1)
+    sin = np.concatenate([np.sin(angles), np.sin(angles)], axis=-1)
+    return jnp.asarray(cos, dtype=dtype), jnp.asarray(sin, dtype=dtype)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [batch, seq, heads, head_dim]; cos/sin: [seq, head_dim]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return x * c + rotated * s

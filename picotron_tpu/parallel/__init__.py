@@ -1,0 +1,2 @@
+from picotron_tpu.parallel.tp import tp_copy, tp_reduce, tp_gather  # noqa: F401
+from picotron_tpu.parallel.cp import ring_attention  # noqa: F401

@@ -1,0 +1,147 @@
+"""Context parallelism: ring attention over the 'cp' mesh axis.
+
+TPU-native re-design of the reference's RingAttentionFunc
+(context_parallel/context_parallel.py:19-110): the async NCCL isend/irecv ring
+(cp_communications.py:22-53) becomes ``lax.ppermute`` — XLA double-buffers the
+permute against the block compute, which is exactly what the reference's
+commit()/wait() staging achieves by hand.
+
+Semantics preserved from the reference:
+- contiguous (non-zigzag) sequence chunks: rank r owns queries/keys for global
+  positions [r*S_local, (r+1)*S_local)  (data.py:102-116 slicing);
+- causal block schedule: the block from source rank ``src`` contributes iff
+  ``src <= r`` (context_parallel.py:36), diagonal block causally masked;
+- numerically-stable LSE merge of partial outputs
+  (update_out_and_lse, context_parallel.py:157-187);
+- backward re-derives P from the saved LSE and sends the dK/dV accumulators
+  around the ring alongside K/V so each contribution lands on the owning rank
+  (the reference's second ring channel, context_parallel.py:60-110).
+
+The known load imbalance of non-zigzag causal ring attention (acknowledged at
+reference tests/test_dataloader.py:136) is faithful: in SPMD every rank runs
+the full schedule, masking skipped blocks, so the wall-clock matches the
+reference's slowest (last) rank. Zigzag is the first post-parity optimization.
+
+Unlike the reference (pure-torch block math, TODO for flash at
+context_parallel.py:22-23), the inner block runs through ops.block_attention,
+which XLA fuses; a Pallas block kernel can be swapped in transparently.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from picotron_tpu.ops.attention import NEG_INF, block_attention
+from picotron_tpu.utils import collective_scan_unroll
+
+
+def _block_mask(s_q: int, s_k: int, src, rank, causal: bool):
+    """True = attend. src/rank are traced cp indices; contiguous chunking means
+    src < rank -> keys strictly before queries (attend all), src == rank ->
+    diagonal causal block, src > rank -> keys after queries (skip)."""
+    if not causal:
+        return jnp.ones((s_q, s_k), dtype=bool)
+    tri = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
+    full = jnp.ones_like(tri)
+    none = jnp.zeros_like(tri)
+    return jnp.where(src < rank, full, jnp.where(src == rank, tri, none))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_attention(q, k, v, scale: float, axis: str, axis_size: int, causal: bool):
+    """q, k, v: [B, S_local, H, D] (kv heads already GQA-repeated, as the
+    reference repeats before the ring, model.py:141-142). Returns [B,S,H,D]."""
+    out, _ = _ring_fwd_impl(q, k, v, scale, axis, axis_size, causal)
+    return out
+
+
+def _ring_fwd_impl(q, k, v, scale, axis, n, causal):
+    rank = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    b, s, h, d = q.shape
+    out0 = jnp.zeros((b, s, h, d), jnp.float32)
+    lse0 = jnp.full((b, s, h), NEG_INF, jnp.float32)
+
+    def step(carry, t):
+        kv, out, lse = carry
+        kt, vt = kv
+        src = (rank - t) % n
+        mask = _block_mask(s, s, src, rank, causal)
+        blk_out, blk_lse = block_attention(q, kt, vt, scale, mask)
+        # LSE merge (reference context_parallel.py:170-171):
+        #   out <- out - sigmoid(blk_lse - lse) * (out - blk_out)
+        #   lse <- logaddexp(lse, blk_lse)
+        w = jax.nn.sigmoid(blk_lse - lse)[..., None]
+        merged_out = out - w * (out - blk_out)
+        merged_lse = jnp.logaddexp(lse, blk_lse)
+        valid = jnp.logical_not(causal) | (src <= rank)
+        out = jnp.where(valid, merged_out, out)
+        lse = jnp.where(valid, merged_lse, lse)
+        kv = lax.ppermute(kv, axis, perm)
+        return (kv, out, lse), None
+
+    (kv, out, lse), _ = lax.scan(step, ((k, v), out0, lse0), jnp.arange(n),
+                                 unroll=collective_scan_unroll())
+    return out.astype(q.dtype), lse
+
+
+def _ring_fwd(q, k, v, scale, axis, n, causal):
+    out, lse = _ring_fwd_impl(q, k, v, scale, axis, n, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(scale, axis, n, causal, res, dout):
+    q, k, v, out, lse = res
+    rank = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    b, s, h, d = q.shape
+
+    q32 = q.astype(jnp.float32)
+    do32 = dout.astype(jnp.float32)
+    # D_i = sum_j dO_ij * O_ij (softmax backward rowsum, the reference's manual
+    # 6-step derivation, context_parallel.py:130-155)
+    D = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # [B, S, H]
+    D_t = D.transpose(0, 2, 1)[..., None]  # [B, H, Sq, 1]
+    lse_t = lse.transpose(0, 2, 1)[..., None]  # [B, H, Sq, 1]
+
+    dq0 = jnp.zeros((b, s, h, d), jnp.float32)
+    dkv0 = (jnp.zeros((b, s, h, d), jnp.float32), jnp.zeros((b, s, h, d), jnp.float32))
+
+    def step(carry, t):
+        kv, dkv, dq = carry
+        kt, vt = kv
+        dk_acc, dv_acc = dkv
+        src = (rank - t) % n
+        mask = _block_mask(s, s, src, rank, causal)
+
+        k32 = kt.astype(jnp.float32)
+        v32 = vt.astype(jnp.float32)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k32) * scale
+        # P re-derived from the final LSE: exp(scores - lse) is each block's
+        # true share of the global softmax (context_parallel.py:112-128).
+        p = jnp.where(mask[None, None], jnp.exp(scores - lse_t), 0.0)
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v32)
+        ds = p * (dp - D_t) * scale
+        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, k32)
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q32)
+
+        dq = dq + dq_blk
+        # accumulators travel the ring with their kv chunk and arrive home
+        # after n rotations (reference's d_kv_comm channel,
+        # context_parallel.py:104-106)
+        dkv = (dk_acc + dk_blk, dv_acc + dv_blk)
+        kv, dkv = lax.ppermute((kv, dkv), axis, perm)
+        return (kv, dkv, dq), None
+
+    (kv, dkv, dq), _ = lax.scan(step, ((k, v), dkv0, dq0), jnp.arange(n),
+                                unroll=collective_scan_unroll())
+    dk, dv = dkv
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)

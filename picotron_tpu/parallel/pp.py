@@ -1,0 +1,146 @@
+"""Pipeline parallelism: AFAB and 1F1B schedules over the 'pp' mesh axis.
+
+The reference runs its schedules as rank-divergent Python loops with blocking
+NCCL p2p (pipeline_parallel.py:54-83 AFAB, :85-145 1F1B;
+pp_communications.py). Under XLA's single-program SPMD model every device must
+trace the same computation, so both schedules are re-derived as uniform
+collective-permute pipelines: stage-s-to-s+1 sends become a non-circular
+``lax.ppermute``, per-stage divergence (which microbatch a stage works on,
+warmup/cooldown bubbles) becomes traced index arithmetic on
+``lax.axis_index('pp')`` with masked no-op steps. Activations between stages
+are constant-shape, exactly what a jitted permute wants (the reference also
+fixes tensor_shapes once, train.py:201).
+
+- AFAB: the forward pipeline is a ``lax.scan`` over M + pp - 1 ticks;
+  ``jax.grad`` through the scan automatically yields the reversed
+  (backward) pipeline — the transpose of ppermute is the opposite-direction
+  ppermute. All-forward-then-all-backward memory (every in-flight microbatch's
+  activations stored), like the reference's AFAB (:71-72). Note: AD accumulates
+  microbatch grads in the *param dtype* — use 1F1B (fp32 accumulation) when
+  bf16 + large grad_acc; AFAB's role is the independent correctness oracle.
+
+- 1F1B: a manual schedule. Each tick runs one forward microbatch and one
+  backward microbatch on every stage (warmup/cooldown are masked), with the
+  backward re-deriving the stage VJP from a saved stage *input* (O(pp) ring
+  buffer — the 1F1B memory win, reference :86) and rematerializing the stage
+  forward. Gradients accumulate in float32, the reference's main_grad dtype
+  policy (data_parallel.py:66,81); the last microbatch's psum happens outside,
+  matching require_backward_grad_sync-on-last-micro (train.py:40-41).
+
+With pp_size == 1 both schedules degenerate to the plain gradient-accumulation
+loop over microbatches (the reference's non-PP train_step, train.py:29-55).
+
+stage_fn(params, h_recv, tokens_mb, targets_mb) -> (h_out, loss) is
+models.llama.stage_apply partially applied.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from picotron_tpu.utils import collective_scan_unroll
+
+
+def _take_mb(arr, i):
+    return lax.dynamic_index_in_dim(arr, i, 0, keepdims=False)
+
+
+def _down_perm(pp):  # stage s -> s+1; stage 0 receives zeros
+    return [(i, i + 1) for i in range(pp - 1)]
+
+
+def _up_perm(pp):  # stage s -> s-1; last stage receives zeros
+    return [(i + 1, i) for i in range(pp - 1)]
+
+
+def pipeline_afab_loss(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
+    """Differentiable pipelined loss. tokens/targets: [M, mbs, S_local].
+    Returns the mean microbatch loss, identical (via pp-psum) on all stages."""
+    M = tokens.shape[0]
+    s = lax.axis_index("pp")
+    T = M + pp_size - 1
+    perm = _down_perm(pp_size)
+
+    def tick(h_recv, t):
+        mb = jnp.clip(t - s, 0, M - 1)
+        h_out, loss_mb = stage_fn(params, h_recv, _take_mb(tokens, mb), _take_mb(targets, mb))
+        valid = (t - s >= 0) & (t - s < M)
+        contrib = jnp.where(valid, loss_mb, 0.0)  # loss_mb is already last-stage-only
+        h_next = lax.ppermute(h_out, "pp", perm) if perm else jnp.zeros_like(h_out)
+        return h_next, contrib
+
+    h0 = jnp.zeros(h_shape, h_dtype)
+    _, contribs = lax.scan(tick, h0, jnp.arange(T), unroll=collective_scan_unroll())
+    return lax.psum(jnp.sum(contribs), "pp") / M
+
+
+def pipeline_afab(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
+    """(loss, grads) via autodiff through the forward pipeline."""
+    loss, grads = jax.value_and_grad(
+        lambda p: pipeline_afab_loss(stage_fn, p, tokens, targets, pp_size, h_shape, h_dtype)
+    )(params)
+    return loss, grads
+
+
+def pipeline_1f1b(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
+    """(loss, grads_fp32) via the interleaved one-forward-one-backward schedule.
+
+    Tick t: stage s forwards microbatch ``t - s`` and backwards microbatch
+    ``t - (2*pp - 2 - s)`` (both masked to [0, M)). The last stage backwards a
+    microbatch the same tick it forwards it; stage s lags by pp-1-s ticks —
+    the steady state of the reference's schedule (pipeline_parallel.py:86,
+    :116-134). dh flows up the pipeline one tick behind the corresponding
+    forward, via the reverse ppermute.
+    """
+    M = tokens.shape[0]
+    s = lax.axis_index("pp")
+    is_last = s == pp_size - 1
+    T = M + 2 * (pp_size - 1)
+    BUF = 2 * pp_size - 1  # max in-flight stage inputs = 2*pp - 2 - 2*s < BUF
+    down, up = _down_perm(pp_size), _up_perm(pp_size)
+
+    gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    hbuf0 = jnp.zeros((BUF,) + tuple(h_shape), h_dtype)
+    h0 = jnp.zeros(h_shape, h_dtype)
+
+    def tick(carry, t):
+        h_recv, dh_recv, hbuf, gacc, loss_acc = carry
+
+        # ---- forward half-tick
+        mb_f = t - s
+        fvalid = (mb_f >= 0) & (mb_f < M)
+        mbf = jnp.clip(mb_f, 0, M - 1)
+        h_out, loss_mb = stage_fn(params, h_recv, _take_mb(tokens, mbf), _take_mb(targets, mbf))
+        loss_acc = loss_acc + jnp.where(fvalid, loss_mb, 0.0)
+        # save this stage's *input* for the backward remat; guarded so bubble
+        # ticks can't clobber a slot still awaiting its backward
+        stored = lax.dynamic_update_index_in_dim(hbuf, h_recv, mbf % BUF, 0)
+        hbuf = jnp.where(fvalid, stored, hbuf)
+
+        # ---- backward half-tick
+        mb_b = t - (2 * pp_size - 2 - s)
+        bvalid = (mb_b >= 0) & (mb_b < M)
+        mbb = jnp.clip(mb_b, 0, M - 1)
+        h_saved = _take_mb(hbuf, mbb % BUF)
+        tok_b, tgt_b = _take_mb(tokens, mbb), _take_mb(targets, mbb)
+        _, vjp_fn = jax.vjp(lambda p, h: stage_fn(p, h, tok_b, tgt_b), params, h_saved)
+        dh_out = jnp.where(is_last, jnp.zeros_like(dh_recv), dh_recv)
+        dloss = jnp.where(is_last & bvalid, 1.0 / M, 0.0).astype(jnp.float32)
+        dparams, dh_prev = vjp_fn((dh_out, dloss))
+        gacc = jax.tree.map(
+            lambda a, g: a + jnp.where(bvalid, g, 0).astype(jnp.float32), gacc, dparams
+        )
+
+        # ---- wire crossings (reference pp_communications.py:34-46 fused
+        # send-fwd/recv-bwd pairs; here XLA schedules both permutes together)
+        h_next = lax.ppermute(h_out, "pp", down) if down else jnp.zeros_like(h_out)
+        dh_next = lax.ppermute(dh_prev, "pp", up) if up else jnp.zeros_like(dh_prev)
+        return (h_next, dh_next, hbuf, gacc, loss_acc), None
+
+    carry0 = (h0, jnp.zeros(h_shape, h_dtype), hbuf0, gacc0, jnp.float32(0.0))
+    (h, dh, hbuf, gacc, loss_acc), _ = lax.scan(tick, carry0, jnp.arange(T),
+                                                unroll=collective_scan_unroll())
+    loss = lax.psum(loss_acc, "pp") / M
+    return loss, gacc

@@ -1,0 +1,85 @@
+"""Tensor parallelism: the Megatron f/g conjugate collectives, JAX-style.
+
+The reference implements these as autograd.Function pairs over NCCL
+(tensor_parallel/tp_communications.py:19-72):
+
+- f = CopyToModelParallelRegion: identity forward, all-reduce backward —
+  placed where a replicated activation enters a column-parallel matmul.
+- g = ReduceFromModelParallelRegion: all-reduce forward, identity backward —
+  placed after a row-parallel matmul whose output shards are partial sums.
+- GatherFromModelParallelRegion: all-gather forward, split backward — used to
+  gather vocab-sharded logits (tensor_parallel.py:48-50).
+
+Here each is a ~5-line ``jax.custom_vjp`` around ``lax.psum``/``all_gather``
+on the 'tp' mesh axis, usable inside ``shard_map``. The reference's async
+all-reduce-overlap variant (LinearWithAsyncAllReduce,
+tp_communications.py:74-101) needs no equivalent: XLA's latency-hiding
+scheduler overlaps the backward all-reduce with the grad-weight matmul
+automatically.
+
+The column/row/vocab-parallel *layers* themselves (reference
+tensor_parallel.py:54-271) are not classes here — a column-parallel linear is
+just ``tp_copy(x) @ w_shard`` and a row-parallel one ``tp_reduce(x @ w_shard)``
+in the model (models/llama.py); the vocab-parallel embedding's mask-and-psum
+trick (tensor_parallel.py:246-271) lives in models/llama.py:embed_lookup.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_copy(x, axis: str = "tp"):
+    """Identity forward / psum backward (Megatron f, tp_communications.py:19-33)."""
+    return x
+
+
+def _tp_copy_fwd(x, axis):
+    return x, None
+
+
+def _tp_copy_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_reduce(x, axis: str = "tp"):
+    """psum forward / identity backward (Megatron g, tp_communications.py:35-49)."""
+    return lax.psum(x, axis)
+
+
+def _tp_reduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _tp_reduce_bwd(axis, _, g):
+    return (g,)
+
+
+tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_gather(x, axis: str = "tp"):
+    """All-gather on the last dim forward / take-own-slice backward
+    (GatherFromModelParallelRegion, tp_communications.py:51-72)."""
+    return lax.all_gather(x, axis, axis=-1, tiled=True)
+
+
+def _tp_gather_fwd(x, axis):
+    return lax.all_gather(x, axis, axis=-1, tiled=True), x.shape[-1]
+
+
+def _tp_gather_bwd(axis, local_dim, g):
+    idx = lax.axis_index(axis)
+    return (lax.dynamic_slice_in_dim(g, idx * local_dim, local_dim, axis=-1),)
+
+
+tp_gather.defvjp(_tp_gather_fwd, _tp_gather_bwd)

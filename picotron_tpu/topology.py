@@ -1,0 +1,75 @@
+"""Device topology: one named mesh instead of torch process groups.
+
+The reference builds a 4D rank grid ``torch.arange(world).view(dp, pp, cp, tp)``
+and six process subgroups from it, held in a module-global singleton
+(reference picotron/process_group_manager.py:5-68). On TPU the whole object
+collapses into a single ``jax.sharding.Mesh`` with axes ``('dp','pp','cp','tp')``
+— tp fastest-varying so tensor-parallel neighbors sit on adjacent devices
+(innermost ICI), dp outermost (DCN), mirroring process_group_manager.py:13.
+Subgroups need no construction: a collective over axis name 'tp' *is* the tp
+group; the fused cp×dp group (process_group_manager.py:20) is just
+``('cp','dp')``. Ring neighbors (cp_send_rank/pp_next_rank, :43-53) become
+``lax.ppermute`` permutations, and the is_first/is_last-stage flags become
+``lax.axis_index('pp') == 0 / pp-1`` inside ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+MESH_AXES = ("dp", "pp", "cp", "tp")
+
+
+@dataclass(frozen=True)
+class Topology:
+    """Static topology facts + the mesh. The queryable surface of the
+    reference's ProcessGroupManager, minus anything that needs communication."""
+
+    mesh: Mesh
+    dp_size: int
+    pp_size: int
+    cp_size: int
+    tp_size: int
+
+    @property
+    def world_size(self) -> int:
+        return self.dp_size * self.pp_size * self.cp_size * self.tp_size
+
+    # Collective "groups" are just axis-name tuples.
+    GRAD_SYNC_AXES = ("dp", "cp")  # the fused cp_dp group of data_parallel.py:47,83
+    LOSS_AXES = ("dp", "cp")  # loss averaging group (utils.py:93-98)
+
+
+def build_topology(dp: int, pp: int, cp: int, tp: int, devices=None) -> Topology:
+    """Create the named mesh over the first dp*pp*cp*tp devices.
+
+    Row-major reshape puts tp on the fastest axis — same device adjacency as
+    the reference grid (process_group_manager.py:13).
+    """
+    world = dp * pp * cp * tp
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < world:
+        raise ValueError(
+            f"topology dp={dp} pp={pp} cp={cp} tp={tp} needs {world} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:world]).reshape(dp, pp, cp, tp)
+    mesh = Mesh(grid, MESH_AXES)
+    return Topology(mesh=mesh, dp_size=dp, pp_size=pp, cp_size=cp, tp_size=tp)
+
+
+def topology_from_config(cfg, devices=None) -> Topology:
+    d = cfg.distributed
+    return build_topology(d.dp_size, d.pp_size, d.cp_size, d.tp_size, devices=devices)
+
+
+def batch_pspec() -> P:
+    """Batch arrays are (microbatch, batch, seq): batch sharded over dp,
+    sequence over cp — the contiguous CP chunking the reference dataloader
+    does per-rank in collate (data.py:102-116) becomes a sharding."""
+    return P(None, "dp", "cp")

@@ -1,0 +1,83 @@
+"""Logging, formatting, MFU accounting.
+
+Ports of the reference's picotron/utils.py, TPU-ified: the analytic MFU
+formula is kept (utils.py:42-48) but the hardcoded H100 989.5 TFLOPs
+denominator becomes a per-chip-generation table; the fcntl-locked multi-process
+print (utils.py:12-20) is unnecessary under a single controller.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+# dense bf16 peak FLOPs per chip
+TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+H100_PEAK_FLOPS = 989.5e12  # the reference's denominator (utils.py:42)
+
+
+def peak_flops_per_chip(device=None) -> float | None:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return None  # CPU or unknown: MFU not reported
+
+
+def flops_per_token(num_params: int, num_layers: int, hidden: int, seq_len: int) -> float:
+    """6N + 12*layers*hidden*seq (reference utils.py:42-48: param FLOPs +
+    attention quadratic term)."""
+    return 6 * num_params + 12 * num_layers * hidden * seq_len
+
+
+def get_mfu(tokens_per_sec_per_chip: float, num_params: int, num_layers: int,
+            hidden: int, seq_len: int, peak: float | None) -> float | None:
+    if peak is None:
+        return None
+    fpt = flops_per_token(num_params, num_layers, hidden, seq_len)
+    return 100.0 * fpt * tokens_per_sec_per_chip / peak
+
+
+def to_readable_format(num: float, precision: int = 2) -> str:
+    """1234567 -> '1.23M' (reference utils.py:27-37)."""
+    for bound, suffix in ((1e12, "T"), (1e9, "B"), (1e6, "M"), (1e3, "K")):
+        if abs(num) >= bound:
+            return f"{num / bound:.{precision}f}{suffix}"
+    return f"{num:.{precision}f}"
+
+
+def set_all_seed(seed: int) -> None:
+    np.random.seed(seed)
+
+
+def device_memory_gb(device=None) -> float | None:
+    """Live bytes on device (the reference logs torch.cuda.memory_reserved,
+    train.py:257)."""
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+        return stats.get("bytes_in_use", 0) / 1e9
+    except Exception:
+        return None
+
+
+def collective_scan_unroll():
+    """Workaround for an XLA CPU runtime race: InProcessCommunicator's
+    rendezvous for collective-permutes inside While loops can admit
+    participants from adjacent loop iterations (observed:
+    "Check failed: id < num_threads (8 vs. 8) ... collective permute
+    RendezvousKey"), aborting the process. Fully unrolling ppermute-bearing
+    scans gives every permute a distinct op id, which sidesteps the
+    collision. TPU runtimes are unaffected, and the hot loops stay rolled
+    there for compile time."""
+    import jax
+
+    return True if jax.default_backend() == "cpu" else 1

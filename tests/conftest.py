@@ -1,0 +1,70 @@
+"""Force an 8-virtual-device CPU platform before JAX initializes a backend.
+
+This is the TPU rebuild's equivalent of the reference's CPU/Gloo fake-cluster
+path (reference README.md:40-47, train.py:83): every parallelism test runs as
+a real multi-device program on one host. SURVEY.md §4 calls for exactly this.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from picotron_tpu.config import Config  # noqa: E402
+
+
+@pytest.fixture
+def tiny_model_kwargs():
+    """A tiny Llama config: GQA (8 q-heads, 4 kv-heads), small but
+    tp/cp/pp-divisible everywhere."""
+    return dict(
+        num_hidden_layers=4,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=256,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        dtype="float32",
+        attention_impl="sdpa",
+    )
+
+
+def make_config(tiny_model_kwargs, dp=1, pp=1, cp=1, tp=1, seq=32, mbs=2, acc=1,
+                engine="1f1b", dtype=None, **overrides) -> Config:
+    raw = {
+        "distributed": {"dp_size": dp, "pp_size": pp, "cp_size": cp, "tp_size": tp,
+                        "pp_engine": engine, "use_cpu": True},
+        "model": dict(tiny_model_kwargs, **({"dtype": dtype} if dtype else {})),
+        "training": dict(seq_length=seq, micro_batch_size=mbs,
+                         gradient_accumulation_steps=acc, learning_rate=1e-3,
+                         remat="none", **overrides),
+        "dataset": {"name": "synthetic"},
+    }
+    return Config.from_dict(raw)
+
+
+@pytest.fixture
+def cfg_factory(tiny_model_kwargs):
+    def factory(**kw):
+        return make_config(tiny_model_kwargs, **kw)
+
+    return factory
+
+
+@pytest.fixture(autouse=True)
+def _clear_jax_caches():
+    """XLA's CPU runtime aborts after ~17 live multi-device executables with
+    collectives accumulate in-process; dropping compiled programs between
+    tests keeps the suite stable (and bounds memory)."""
+    yield
+    jax.clear_caches()

@@ -1,0 +1,78 @@
+"""Single-device model + train-step basics: shapes, determinism, learning."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from picotron_tpu import train_step as ts
+from picotron_tpu.data import MicroBatchDataLoader
+from picotron_tpu.models import llama
+from picotron_tpu.topology import topology_from_config
+
+
+def test_forward_shapes(cfg_factory):
+    cfg = cfg_factory()
+    topo = topology_from_config(cfg)
+    params, _ = ts.init_state(cfg, topo)
+    tokens = jnp.zeros((2, cfg.training.seq_length), jnp.int32)
+    fwd = jax.jit(
+        jax.shard_map(
+            lambda p, t: llama.forward_logits(p, t, cfg),
+            mesh=topo.mesh,
+            in_specs=(llama.param_pspecs(cfg.model), jax.sharding.PartitionSpec()),
+            out_specs=jax.sharding.PartitionSpec(),
+            check_vma=False,
+        )
+    )
+    logits = fwd(params, tokens)
+    assert logits.shape == (2, cfg.training.seq_length, cfg.model.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_init_deterministic(cfg_factory):
+    cfg = cfg_factory()
+    topo = topology_from_config(cfg)
+    p1, _ = ts.init_state(cfg, topo)
+    p2, _ = ts.init_state(cfg, topo)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_loss_decreases_single_device(cfg_factory):
+    cfg = cfg_factory(seq=64, mbs=4)
+    topo = topology_from_config(cfg)
+    params, opt_state = ts.init_state(cfg, topo)
+    step = ts.build_train_step(cfg, topo)
+    loader = MicroBatchDataLoader(cfg)
+    losses = []
+    for _ in range(30):
+        batch = next(loader)
+        tokens, targets = ts.shard_batch(batch, topo)
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    # synthetic affine-bigram corpus: model must learn transitions fast
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_grad_accumulation_matches_large_batch(cfg_factory):
+    """acc=4 x mbs=1 must equal acc=1 x mbs=4 grads-wise: compare one step's
+    loss trajectory (same data, same total batch)."""
+    cfg_a = cfg_factory(seq=32, mbs=4, acc=1)
+    cfg_b = cfg_factory(seq=32, mbs=1, acc=4)
+    topo = topology_from_config(cfg_a)
+    pa, oa = ts.init_state(cfg_a, topo)
+    pb, ob = ts.init_state(cfg_b, topo)
+    step_a = ts.build_train_step(cfg_a, topo)
+    step_b = ts.build_train_step(cfg_b, topo)
+    rows = np.random.default_rng(0).integers(
+        0, cfg_a.model.vocab_size, (4, 33), dtype=np.int32)
+    batch_a = {"input_ids": rows[None, :, :-1], "target_ids": rows[None, :, 1:]}
+    batch_b = {"input_ids": rows[:, None, :-1], "target_ids": rows[:, None, 1:]}
+    ta, tga = ts.shard_batch(batch_a, topo)
+    tb, tgb = ts.shard_batch(batch_b, topo)
+    pa, oa, loss_a = step_a(pa, oa, ta, tga)
+    pb, ob, loss_b = step_b(pb, ob, tb, tgb)
+    assert abs(float(loss_a) - float(loss_b)) < 1e-5
+    for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)

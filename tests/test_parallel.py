@@ -1,0 +1,77 @@
+"""Parallel == single-device equivalence, the core oracle (SURVEY.md §4).
+
+Generalizes the reference's test patterns — sliced-reference TP comparison
+(tests/test_tensor_parallel.py) and dual-dataloader CP comparison
+(tests/test_dataloader.py) — into one property: with the same seed, config and
+data, the fp32 loss trajectory must be identical for every 4D topology and
+both pipeline engines.
+"""
+
+import numpy as np
+import pytest
+
+from picotron_tpu import train_step as ts
+from picotron_tpu.data import MicroBatchDataLoader
+from picotron_tpu.topology import topology_from_config
+
+STEPS = 5
+
+
+def run_losses(cfg, steps=STEPS):
+    topo = topology_from_config(cfg)
+    params, opt_state = ts.init_state(cfg, topo)
+    step = ts.build_train_step(cfg, topo)
+    loader = MicroBatchDataLoader(cfg)
+    losses = []
+    for _ in range(steps):
+        tokens, targets = ts.shard_batch(next(loader), topo)
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    return np.asarray(losses)
+
+
+# Every topology trains on the same GLOBAL batch of 8 sequences per step
+# (gb = mbs * acc * dp, reference data.py:17): mbs = 8 // (dp * acc).
+GLOBAL_BATCH = 8
+
+TOPOLOGIES = [
+    dict(dp=2),
+    dict(dp=8),
+    dict(tp=2),
+    dict(tp=4),
+    dict(cp=2),
+    dict(cp=4),
+    dict(acc=2),
+    dict(pp=2, acc=2, engine="1f1b"),
+    dict(pp=2, acc=2, engine="afab"),
+    dict(pp=4, acc=4, engine="1f1b"),
+    dict(pp=4, acc=4, engine="afab"),
+    dict(dp=2, tp=2, cp=2),
+    dict(dp=2, pp=2, cp=2, acc=2, engine="1f1b"),
+    dict(dp=2, pp=2, tp=2, acc=2, engine="1f1b"),
+    dict(pp=2, cp=2, tp=2, acc=2, engine="1f1b"),
+]
+
+
+@pytest.fixture(scope="module")
+def baseline(request):
+    return {}
+
+
+@pytest.mark.parametrize("topo_kw", TOPOLOGIES, ids=lambda d: "-".join(
+    f"{k}{v}" for k, v in d.items()))
+def test_topology_matches_single_device(cfg_factory, baseline, topo_kw):
+    if "ref" not in baseline:
+        baseline["ref"] = run_losses(cfg_factory(seq=32, mbs=GLOBAL_BATCH))
+    kw = dict(topo_kw)
+    acc = kw.pop("acc", 1)
+    dp = kw.get("dp", 1)
+    got = run_losses(cfg_factory(seq=32, mbs=GLOBAL_BATCH // (dp * acc), acc=acc, **kw))
+    np.testing.assert_allclose(got, baseline["ref"], rtol=2e-5, atol=2e-5)
+
+
+def test_vocab_parallel_ce_matches_gathered(cfg_factory, tiny_model_kwargs):
+    cfg_g = cfg_factory(tp=4, seq=32, mbs=2)
+    cfg_v = cfg_factory(tp=4, seq=32, mbs=2)
+    cfg_v.model.gather_logits = False
+    np.testing.assert_allclose(run_losses(cfg_g), run_losses(cfg_v), rtol=2e-5, atol=2e-5)
