@@ -74,6 +74,10 @@ class TrainingConfig:
     max_tokens: Optional[int] = None
     # "full": remat every decoder layer (jax.checkpoint); "none": store all.
     remat: str = "full"
+    # dtype gradients accumulate in across microbatches: "float32" (the
+    # reference's main_grad policy, data_parallel.py:66,81) or "param"
+    # (param dtype; halves grad memory, useful single-chip).
+    grad_accum_dtype: str = "float32"
 
 
 @dataclass
@@ -152,6 +156,16 @@ class Config:
             raise ValueError("pipeline parallelism needs >= 1 microbatch")
         if d.pp_engine not in ("afab", "1f1b"):
             raise ValueError(f"unknown pp_engine {d.pp_engine!r} (afab|1f1b)")
+        if m.attention_impl not in ("auto", "sdpa", "flash"):
+            raise ValueError(
+                f"unknown attention_impl {m.attention_impl!r} (auto|sdpa|flash)")
+        if t.grad_accum_dtype not in ("float32", "param"):
+            raise ValueError(
+                f"unknown grad_accum_dtype {t.grad_accum_dtype!r} (float32|param)")
+        if t.grad_accum_dtype == "param" and d.pp_size > 1:
+            # the pipeline schedules accumulate in fp32 (the reference's
+            # main_grad policy); 'param' is a single-stage memory optimization
+            raise ValueError("grad_accum_dtype='param' requires pp_size == 1")
         if t.seq_length > m.max_position_embeddings:
             raise ValueError(
                 f"seq_length {t.seq_length} > max_position_embeddings "

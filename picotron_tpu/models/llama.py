@@ -47,6 +47,7 @@ from picotron_tpu.ops.rmsnorm import rms_norm
 from picotron_tpu.ops.rope import apply_rope, precompute_rope
 from picotron_tpu.parallel.cp import ring_attention
 from picotron_tpu.parallel.tp import tp_copy, tp_gather, tp_reduce
+from picotron_tpu.utils import on_tpu
 
 Params = dict[str, Any]
 
@@ -138,13 +139,23 @@ def _attention(q, k, v, cfg: Config):
         return ring_attention(q, k, v, scale, "cp", cfg.distributed.cp_size, True)
     impl = cfg.model.attention_impl
     if impl == "auto":
-        # TODO(flash): flip to the Pallas kernel on TPU once ops/pallas lands
-        impl = "sdpa"
+        impl = "flash" if on_tpu() else "sdpa"
     if impl == "flash":
         from picotron_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, scale, causal=True)
     return sdpa(q, k, v, scale, causal=True)
+
+
+def _norm(x, w, cfg: Config):
+    use_pallas = cfg.model.use_pallas_rmsnorm
+    if use_pallas is None:
+        use_pallas = on_tpu()
+    if use_pallas:
+        from picotron_tpu.ops.pallas.rmsnorm import rms_norm_pallas
+
+        return rms_norm_pallas(x, w, cfg.model.rms_norm_eps)
+    return rms_norm(x, w, cfg.model.rms_norm_eps)
 
 
 def decoder_layer(lp, h, cos, sin, cfg: Config):
@@ -154,7 +165,7 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
     B, S, _ = h.shape
 
     # attention sub-block: column(q,k,v) -> rope -> attn -> row(out)
-    x = rms_norm(h, lp["attn_norm"], m.rms_norm_eps)
+    x = _norm(h, lp["attn_norm"], cfg)
     x = tp_copy(x)
     q = (x @ lp["wq"]).reshape(B, S, nh, D)
     k = (x @ lp["wk"]).reshape(B, S, nkv, D)
@@ -168,7 +179,7 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
     h = h + tp_reduce(o @ lp["wo"])
 
     # MLP sub-block: column(gate,up) -> SwiGLU -> row(down)  (model.py:163-185)
-    x = rms_norm(h, lp["mlp_norm"], m.rms_norm_eps)
+    x = _norm(h, lp["mlp_norm"], cfg)
     x = tp_copy(x)
     y = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
     return h + tp_reduce(y @ lp["w_down"])
@@ -186,10 +197,10 @@ def layers_forward(stacked, h, cos, sin, cfg: Config):
     return h
 
 
-def head_logits(params, h, m: ModelConfig):
+def head_logits(params, h, cfg: Config):
     """Final norm + untied LM head (the reference always creates a fresh
     untied head, checkpoint.py:88-91); logits stay vocab-sharded."""
-    x = rms_norm(h, params["final_norm"], m.rms_norm_eps)
+    x = _norm(h, params["final_norm"], cfg)
     x = tp_copy(x)
     return x @ params["lm_head"]
 
@@ -231,7 +242,7 @@ def stage_apply(params, h_recv, tokens, targets, cos, sin, cfg: Config):
     s_local = tokens.shape[-1]
     cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local)
     h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)
-    logits = head_logits(params, h, cfg.model)
+    logits = head_logits(params, h, cfg)
     loss = _loss(logits, targets, cfg.model)
     return h, jnp.where(is_last, loss, 0.0)
 
@@ -245,7 +256,7 @@ def forward_logits(params, tokens, cfg: Config, gather: bool = True):
     s_local = tokens.shape[-1]
     cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local)
     h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)
-    logits = head_logits(params, h, cfg.model)
+    logits = head_logits(params, h, cfg)
     return tp_gather(logits) if gather else logits
 
 

@@ -1,0 +1,6 @@
+"""Pallas TPU kernels: the native fast path.
+
+The reference's performance rests on external CUDA/Triton kernels —
+flash-attn (model.py:32-36,151-153) and TritonRMSNorm (model.py:38-64).
+These are their TPU-native equivalents, written against Mosaic via Pallas.
+"""

@@ -1,0 +1,284 @@
+"""Flash attention as a Pallas TPU kernel (fwd + custom-VJP bwd).
+
+TPU-native equivalent of the reference's flash-attn CUDA dependency
+(reference picotron/model.py:7,32-36,151-153; pinned flash-attn==2.5.0).
+Same asymptotics as FlashAttention-2: O(S) memory (never materializes the
+[S, S] score matrix in HBM), online softmax in fp32, log-sum-exp saved for
+the backward, which re-derives P per block.
+
+Layout: the model's [B, S, H, D] is folded to [B*H, S, D]; the grid walks
+(batch*head, query-block) for the forward/dq and (batch*head, key-block) for
+dk/dv. K/V for one head live whole in VMEM (S*D*2B ~ 1 MB at S=8192, D=64)
+while scores exist only as a [block_q, block_k] VMEM tile — the MXU sees
+(block_q x D) @ (D x block_k) and (block_q x block_k) @ (block_k x D)
+matmuls, all 128-aligned. The per-row LSE is materialized as [BH, S, 128]
+with the value broadcast across the 128-lane minor dim — Mosaic requires the
+last two block dims be (8k, 128m), so a [BH, S] layout can't be tiled
+per-q-block (the in-tree TPU flash kernel uses the same trick).
+
+Causality is handled at two levels: whole key-blocks strictly above the
+diagonal are skipped (the fori_loop upper bound), the diagonal block gets an
+iota mask. The softmax-backward row term delta = rowsum(dO * O) is computed
+in-kernel from the O/dO blocks. GQA repetition happens in the model before
+the call (as the reference repeats before its kernel, model.py:141-142).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+LANE = 128  # minor-dim width for the broadcast LSE layout
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _pick_block(seq: int, want: int) -> int:
+    b = min(want, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _causal_band(s, q0, k0, bq, bk):
+    qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+# --------------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
+                block_k, causal):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [bq, D]
+    seq_k = k_ref.shape[1]
+    nk = seq_k // block_k
+    if causal:
+        # key blocks that intersect rows <= this q block's last row
+        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_band(s, qi * block_q, j * block_k, block_q, block_k)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    bq, d = q.shape
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (bq, LANE))
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    """Returns (out [BH,S,D], lse [BH,S,LANE] broadcast layout, fp32)."""
+    bh, s, d = q.shape
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+    grid = (bh, s // bq)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_q=bq, block_k=bk,
+                          causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANE), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, LANE), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------------- #
+# backward
+# --------------------------------------------------------------------------- #
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
+                   scale, block_q, block_k, causal):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0:1]
+    delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=1, keepdims=True)
+    seq_k = k_ref.shape[1]
+    nk = seq_k // block_k
+    if causal:
+        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_band(s, qi * block_q, j * block_k, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, nk, body, jnp.zeros(q.shape, jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, *, scale, block_q, block_k, causal):
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [bk, D]
+    v = v_ref[0].astype(jnp.float32)
+    seq_q = q_ref.shape[1]
+    nq = seq_q // block_q
+    # first q block that can see this k block
+    j0 = (kj * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        o = o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0:1]
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_band(s, i * block_q, kj * block_k, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros(k.shape, jnp.float32)
+    dk, dv = lax.fori_loop(j0, nq, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, dout):
+    q, k, v, out, lse = res
+    bh, s, d = q.shape
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk,
+                          causal=causal),
+        grid=(bh, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANE), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+    )(q, k, v, out, dout, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq,
+                          block_k=bk, causal=causal),
+        grid=(bh, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, s, LANE), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+    )(q, k, v, out, dout, lse)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------------- #
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q, k, v, scale: float | None = None, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """q, k, v: [B, S, H, D] with equal head counts. Returns [B, S, H, D]."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    out = _flash_bhsd(fold(q), fold(k), fold(v), float(scale), causal,
+                      block_q, block_k)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q, k, v, scale: float | None = None,
+                             causal: bool = True,
+                             block_q: int = DEFAULT_BLOCK_Q,
+                             block_k: int = DEFAULT_BLOCK_K):
+    """Forward-only variant returning (out [B,S,H,D], lse [B,S,H]) — the
+    building block for ring attention's LSE merge."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    out, lse = _fwd(fold(q), fold(k), fold(v), float(scale), causal,
+                    block_q, block_k)
+    return (out.reshape(b, h, s, d).transpose(0, 2, 1, 3),
+            lse[:, :, 0].reshape(b, h, s).transpose(0, 2, 1))

@@ -55,6 +55,36 @@ def _up_perm(pp):  # stage s -> s-1; last stage receives zeros
     return [(i + 1, i) for i in range(pp - 1)]
 
 
+def no_pipeline(stage_fn, params, tokens, targets, h_shape, h_dtype,
+                acc_dtype=jnp.float32):
+    """pp_size == 1: plain gradient-accumulation over microbatches — the
+    reference's non-PP train_step (train.py:29-55). A ``lax.scan`` over the
+    microbatch axis with value_and_grad per microbatch, accumulating grads in
+    ``acc_dtype`` (float32 = the reference's main_grad policy,
+    data_parallel.py:66,81; the param dtype halves optimizer-step memory for
+    single-chip benchmarking)."""
+    M = tokens.shape[0]
+    h0 = jnp.zeros(h_shape, h_dtype)
+
+    def loss_fn(p, tok, tgt):
+        _, loss = stage_fn(p, h0, tok, tgt)
+        return loss
+
+    gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), params)
+
+    def body(carry, mb):
+        gacc, loss_acc = carry
+        tok, tgt = mb
+        loss, g = jax.value_and_grad(loss_fn)(params, tok, tgt)
+        gacc = jax.tree.map(lambda a, gi: a + gi.astype(acc_dtype), gacc, g)
+        return (gacc, loss_acc + loss.astype(jnp.float32)), None
+
+    (gacc, loss_acc), _ = lax.scan(body, (gacc0, jnp.float32(0.0)),
+                                   (tokens, targets))
+    grads = jax.tree.map(lambda g: g / M, gacc)
+    return loss_acc / M, grads
+
+
 def pipeline_afab_loss(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
     """Differentiable pipelined loss. tokens/targets: [M, mbs, S_local].
     Returns the mean microbatch loss, identical (via pp-psum) on all stages."""

@@ -34,7 +34,7 @@ from jax.tree_util import tree_flatten_with_path, tree_unflatten
 
 from picotron_tpu.config import Config
 from picotron_tpu.models import llama
-from picotron_tpu.parallel.pp import pipeline_1f1b, pipeline_afab
+from picotron_tpu.parallel.pp import no_pipeline, pipeline_1f1b, pipeline_afab
 from picotron_tpu.topology import Topology, batch_pspec
 
 
@@ -133,8 +133,13 @@ def build_train_step(cfg: Config, topo: Topology):
     def _step(params, opt_state, tokens, targets):
         stage_fn = lambda p, h, tok, tgt: llama.stage_apply(p, h, tok, tgt, cos, sin, cfg)
         h_shape = (tokens.shape[1], tokens.shape[2], cfg.model.hidden_size)
-        schedule = pipeline_1f1b if (engine == "1f1b") else pipeline_afab
-        loss, grads = schedule(stage_fn, params, tokens, targets, pp, h_shape, dt)
+        if pp == 1:
+            acc_dt = dt if cfg.training.grad_accum_dtype == "param" else jnp.float32
+            loss, grads = no_pipeline(stage_fn, params, tokens, targets,
+                                      h_shape, dt, acc_dt)
+        else:
+            schedule = pipeline_1f1b if (engine == "1f1b") else pipeline_afab
+            loss, grads = schedule(stage_fn, params, tokens, targets, pp, h_shape, dt)
 
         # grad sync: mean over the fused dp×cp group (data_parallel.py:47,83),
         # psum over pp for stage-replicated params, cast fp32 -> param dtype

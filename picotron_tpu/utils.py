@@ -23,6 +23,13 @@ TPU_PEAK_FLOPS = {
 H100_PEAK_FLOPS = 989.5e12  # the reference's denominator (utils.py:42)
 
 
+def on_tpu() -> bool:
+    """Trace-time backend check gating the Pallas (Mosaic) fast paths: only
+    an actual TPU backend qualifies — GPU must not be routed into kernels
+    lowered for Mosaic."""
+    return jax.default_backend() == "tpu"
+
+
 def peak_flops_per_chip(device=None) -> float | None:
     device = device or jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()

@@ -1,0 +1,106 @@
+"""Pallas kernels vs their XLA oracles, via the TPU interpreter on CPU.
+
+The reference validates its fast paths against pure-torch formulations
+(LlamaRMSNorm vs TritonRMSNorm, SDPA vs flash-attn — model.py:147-157,191);
+here the Pallas flash-attention and RMSNorm kernels are checked against
+ops.attention.sdpa and ops.rmsnorm.rms_norm in interpret mode.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.pallas import tpu as pltpu
+
+from picotron_tpu.ops.attention import sdpa
+from picotron_tpu.ops.pallas.flash_attention import (
+    flash_attention,
+    flash_attention_with_lse,
+)
+from picotron_tpu.ops.pallas.rmsnorm import rms_norm_pallas
+from picotron_tpu.ops.rmsnorm import rms_norm
+
+
+def _qkv(b=2, s=256, h=2, d=64, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, s, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_matches_sdpa(causal):
+    q, k, v = _qkv()
+    scale = 0.125
+    with pltpu.force_tpu_interpret_mode():
+        got = flash_attention(q, k, v, scale, causal=causal, block_q=128,
+                              block_k=128)
+    want = sdpa(q, k, v, scale, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_lse_matches_block_attention():
+    from picotron_tpu.ops.attention import _causal_mask, block_attention
+
+    q, k, v = _qkv(s=128)
+    scale = 0.125
+    with pltpu.force_tpu_interpret_mode():
+        out, lse = flash_attention_with_lse(q, k, v, scale, causal=True,
+                                            block_q=128, block_k=128)
+    mask = _causal_mask(q.shape[1], k.shape[1], 0)
+    want_out, want_lse = block_attention(q, k, v, scale, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want_out),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want_lse),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_grads_match_sdpa():
+    q, k, v = _qkv(s=128)
+    scale = 0.125
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, scale, causal=True, block_q=64,
+                              block_k=64)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_ref(q, k, v):
+        out = sdpa(q, k, v, scale, causal=True)
+        return jnp.sum(out * jnp.cos(out))
+
+    with pltpu.force_tpu_interpret_mode():
+        g_got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=5e-5, atol=5e-5, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rmsnorm_matches_reference(dtype):
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 96, 128)).astype(dtype)
+    w = jax.random.normal(jax.random.PRNGKey(1), (128,)).astype(dtype)
+    with pltpu.force_tpu_interpret_mode():
+        got = rms_norm_pallas(x, w, 1e-5)
+    want = rms_norm(x, w, 1e-5)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), rtol=1e-2 if
+                               dtype == jnp.bfloat16 else 1e-6, atol=1e-2 if
+                               dtype == jnp.bfloat16 else 1e-6)
+
+
+def test_rmsnorm_grads_match_reference():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 64, 128))
+    w = jax.random.normal(jax.random.PRNGKey(1), (128,)) + 1.0
+
+    def loss_pallas(x, w):
+        return jnp.sum(jnp.sin(rms_norm_pallas(x, w, 1e-5)))
+
+    def loss_ref(x, w):
+        return jnp.sum(jnp.sin(rms_norm(x, w, 1e-5)))
+
+    with pltpu.force_tpu_interpret_mode():
+        gx, gw = jax.grad(loss_pallas, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=5e-5, atol=5e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=5e-5, atol=5e-5)
