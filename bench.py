@@ -20,16 +20,10 @@ import jax
 
 
 def smollm_cfg(mbs: int, seq: int, on_tpu: bool):
-    from picotron_tpu.config import Config
+    from picotron_tpu.config import SMOLLM_1_7B, Config
 
     if on_tpu:
-        model = dict(
-            name="HuggingFaceTB/SmolLM-1.7B", num_hidden_layers=24,
-            num_attention_heads=32, num_key_value_heads=32, hidden_size=2048,
-            intermediate_size=8192, vocab_size=49152,
-            max_position_embeddings=2048, dtype="bfloat16",
-            attention_impl="auto",
-        )
+        model = dict(SMOLLM_1_7B)
     else:  # CPU smoke path so the bench always prints a line
         model = dict(
             name="tiny", num_hidden_layers=4, num_attention_heads=8,
@@ -74,24 +68,27 @@ def main():
     from picotron_tpu.utils import on_tpu as _on_tpu
     on_tpu = _on_tpu()
     from picotron_tpu.models import llama
-    from picotron_tpu.utils import flops_per_token, peak_flops_per_chip
+    from picotron_tpu.utils import get_mfu, peak_flops_per_chip
+
+    import gc
 
     last_err = None
     for mbs in ((8, 4, 2, 1) if on_tpu else (2,)):
         cfg = smollm_cfg(mbs=mbs, seq=2048 if on_tpu else 128, on_tpu=on_tpu)
+        oom = False
         try:
             tok_s = run(cfg)
             break
         except Exception as e:  # OOM at this batch size: try smaller
-            import gc
-
             msg = str(e).lower()
             last_err = msg
             if "resource_exhausted" not in msg and "out of memory" not in msg:
                 raise
-            # drop the traceback (it pins the failed attempt's device arrays
-            # via frame references) before allocating the next attempt
-            e = None
+            oom = True
+        if oom:
+            # outside the handler the exception/traceback (which pins the
+            # failed attempt's device arrays via frame refs) is dead, so the
+            # collect actually frees HBM before the next attempt
             jax.clear_caches()
             gc.collect()
     else:
@@ -99,15 +96,14 @@ def main():
 
     m = cfg.model
     n_params = llama.num_params(m)
-    fpt = flops_per_token(n_params, m.num_hidden_layers, m.hidden_size,
-                          cfg.training.seq_length)
     peak = peak_flops_per_chip()
     if peak is None:  # CPU: report raw throughput, no MFU baseline claim
         print(json.dumps({"metric": "tokens_per_sec_cpu_smoke",
                           "value": round(tok_s, 1), "unit": "tokens/s",
                           "vs_baseline": 0.0}))
         return
-    mfu = 100.0 * fpt * tok_s / peak
+    mfu = get_mfu(tok_s, n_params, m.num_hidden_layers, m.hidden_size,
+                  cfg.training.seq_length, peak)
     print(json.dumps({"metric": "smollm_1.7b_mfu_1chip",
                       "value": round(mfu, 2), "unit": "%",
                       "vs_baseline": round(mfu / 50.0, 3)}))

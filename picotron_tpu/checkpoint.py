@@ -1,0 +1,310 @@
+"""Checkpointing: sharded training checkpoints + HF safetensors interop.
+
+The reference has two mechanisms (picotron/checkpoint.py):
+
+1. **Training checkpoints** — per-(tp_rank, pp_rank) ``.pth`` files whose names
+   encode the topology (checkpoint.py:242-244), written only by the dp/cp-rank-0
+   replica (checkpoint.py:250-253), holding model + optimizer + step + tokens
+   (checkpoint.py:254-260), resumed under the assumption of identical topology
+   (checkpoint.py:263). On TPU this collapses into an **orbax** sharded
+   checkpoint of the global jax pytrees: each host writes only the shards it
+   owns (the dp/cp-rank-0-writes rule is automatic for replicated shards),
+   and restore can *change topology* — the saved arrays are global, so loading
+   under a different mesh just re-shards them. Step/tokens ride along as JSON.
+
+2. **HF safetensors bootstrap** — per-rank selective reads of a (possibly
+   sharded) safetensors model with a picotron⇄HF name map (checkpoint.py:
+   213-230) and per-tensor TP slicing (adjust_tensor_size, checkpoint.py:
+   150-211). Here the name map becomes ``load_hf_safetensors`` /
+   ``save_hf_safetensors`` converting between HF's per-layer (out,in) 2-D
+   tensors and our layer-stacked (in,out) pytree; TP/PP slicing needs no code —
+   ``jax.device_put`` against the param shardings moves each device's shard.
+   The reference's meta-device init context (checkpoint.py:15-48) is replaced
+   by ``jax.eval_shape`` + jit with out_shardings (see train_step.init_state).
+
+Note the reference deliberately re-randomizes after loading (checkpoint.py:
+99-100 — HF files serve as shape templates for pre-training). We keep actual
+value loading, and ``init_state(..., hf_path=...)`` callers can re-init if they
+want reference semantics; the untied-lm_head rule is preserved: a missing
+``lm_head.weight`` (tied embeddings) gets a fresh random head
+(checkpoint.py:88-91, note at :138).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from picotron_tpu.config import ModelConfig
+from picotron_tpu.models import llama
+from picotron_tpu.topology import Topology, named_shardings
+
+# --------------------------------------------------------------------------- #
+# training checkpoints (orbax)
+# --------------------------------------------------------------------------- #
+
+
+class CheckpointManager:
+    """Save/resume of (params, opt_state, step, tokens).
+
+    The surface of the reference's CheckpointManager (checkpoint.py:232-278):
+    ``save_checkpoint(..., step, tokens)`` every ``save_frequency`` steps and
+    ``load_checkpoint`` returning (step, trained_tokens) — topology-portable
+    because orbax stores global arrays, not per-rank shards-with-names.
+    """
+
+    def __init__(self, save_dir: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(save_dir)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True, enable_async_checkpointing=False
+        )
+        self.manager = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, params, opt_state, trained_tokens: int) -> None:
+        ocp = self._ocp
+        self.manager.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+                meta=ocp.args.JsonSave(
+                    {"step": step, "trained_tokens": int(trained_tokens)}
+                ),
+            ),
+        )
+        self.manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def load(self, params_like, opt_state_like, step: Optional[int] = None):
+        """Restore into the shardings/dtypes of the given example trees
+        (live arrays or ShapeDtypeStructs). Returns
+        (params, opt_state, step, trained_tokens)."""
+        ocp = self._ocp
+        step = self.manager.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {self.directory}")
+
+        def as_abstract(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+                tree,
+            )
+
+        restored = self.manager.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(as_abstract(params_like)),
+                opt_state=ocp.args.StandardRestore(as_abstract(opt_state_like)),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        meta = restored["meta"]
+        return (
+            restored["params"],
+            restored["opt_state"],
+            int(meta["step"]),
+            int(meta["trained_tokens"]),
+        )
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+# --------------------------------------------------------------------------- #
+# HF safetensors interop
+# --------------------------------------------------------------------------- #
+
+# our stacked-tree leaf -> (HF per-layer template, transpose?) — the analogue
+# of the reference's name map table (checkpoint.py:213-230). HF linear weights
+# are (out_features, in_features); ours are (in, out), hence transpose=True.
+_LAYER_MAP = {
+    "attn_norm": ("model.layers.{i}.input_layernorm.weight", False),
+    "wq": ("model.layers.{i}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{i}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{i}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{i}.self_attn.o_proj.weight", True),
+    "mlp_norm": ("model.layers.{i}.post_attention_layernorm.weight", False),
+    "w_gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{i}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{i}.mlp.down_proj.weight", True),
+}
+_TOP_MAP = {
+    "embed": ("model.embed_tokens.weight", False),
+    "final_norm": ("model.norm.weight", False),
+    "lm_head": ("lm_head.weight", True),
+}
+
+
+class _SafetensorsReader:
+    """Uniform reader over a single ``model.safetensors`` or a sharded
+    ``model.safetensors.index.json`` directory (the two layouts the reference
+    handles at checkpoint.py:62-86)."""
+
+    def __init__(self, path: str):
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self._handles: dict[str, Any] = {}
+        if os.path.isfile(path):
+            self.index = None
+            self._single = path
+            with safe_open(path, framework="np") as f:
+                self.names = set(f.keys())
+        else:
+            index_file = os.path.join(path, "model.safetensors.index.json")
+            single = os.path.join(path, "model.safetensors")
+            if os.path.exists(index_file):
+                with open(index_file) as f:
+                    self.index = json.load(f)["weight_map"]
+                self._dir = path
+                self._single = None
+                self.names = set(self.index)
+            elif os.path.exists(single):
+                self.index = None
+                self._single = single
+                with safe_open(single, framework="np") as f:
+                    self.names = set(f.keys())
+            else:
+                raise FileNotFoundError(
+                    f"no model.safetensors[.index.json] under {path}"
+                )
+
+    def _file_for(self, name: str) -> str:
+        if self.index is None:
+            return self._single
+        return os.path.join(self._dir, self.index[name])
+
+    def get(self, name: str) -> np.ndarray:
+        fpath = self._file_for(name)
+        if fpath not in self._handles:
+            self._handles[fpath] = self._safe_open(fpath, framework="np").__enter__()
+        return self._handles[fpath].get_tensor(name)
+
+    def close(self) -> None:
+        for h in self._handles.values():
+            h.__exit__(None, None, None)
+        self._handles.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def load_hf_safetensors(
+    path: str,
+    m: ModelConfig,
+    topo: Optional[Topology] = None,
+    dtype: Optional[str] = None,
+) -> llama.Params:
+    """Build our parameter pytree from an HF-format Llama checkpoint.
+
+    ``path`` is a ``.safetensors`` file or a directory holding one (optionally
+    sharded with an index). When ``topo`` is given, leaves are placed with the
+    model's param shardings (TP slices / PP stage slices land on their devices
+    — the role of adjust_tensor_size + per-rank selective reads in the
+    reference, checkpoint.py:150-211).
+
+    Memory note: the full tree is materialized in host RAM before device_put
+    (fine through ~10B params on standard hosts). Multi-host bootstrap of
+    larger models should read per-host slices via safetensors ``get_slice``
+    against each host's addressable shards — not needed for the reference's
+    model ladder (SmolLM-1.7B, Llama-2-7B)."""
+    dt = jnp.dtype(dtype or m.dtype)
+    L = m.num_hidden_layers
+
+    with _SafetensorsReader(path) as reader:
+
+        def grab(tmpl: str, transpose: bool, i: Optional[int] = None) -> np.ndarray:
+            t = reader.get(tmpl.format(i=i))
+            return np.ascontiguousarray(t.T if transpose else t)
+
+        params: llama.Params = {
+            "embed": grab(*_TOP_MAP["embed"]),
+            "layers": {
+                k: np.stack([grab(tmpl, tr, i) for i in range(L)])
+                for k, (tmpl, tr) in _LAYER_MAP.items()
+            },
+            "final_norm": grab(*_TOP_MAP["final_norm"]),
+        }
+        if "lm_head.weight" in reader.names:
+            params["lm_head"] = grab(*_TOP_MAP["lm_head"])
+        else:
+            # tied embeddings: the reference always creates a fresh untied head
+            # (checkpoint.py:88-91); we untie by copying the embedding
+            # transpose, which preserves the tied model's function.
+            params["lm_head"] = np.ascontiguousarray(params["embed"].T)
+
+    params = jax.tree.map(lambda x: jnp.asarray(x, dt), params)
+    if topo is not None:
+        params = jax.tree.map(
+            jax.device_put, params, named_shardings(topo, llama.param_pspecs(m)))
+    return params
+
+
+def save_hf_safetensors(params: llama.Params, path: str) -> None:
+    """Export our pytree to a single HF-format safetensors file (inverse of
+    the reference's import direction — it only reads; export makes the
+    bootstrap test a round trip)."""
+    from safetensors.numpy import save_file
+
+    out: dict[str, np.ndarray] = {}
+
+    def put(name: str, arr, transpose: bool):
+        a = np.asarray(jax.device_get(arr))
+        out[name] = np.ascontiguousarray(a.T if transpose else a)
+
+    for k, (tmpl, tr) in _TOP_MAP.items():
+        put(tmpl, params[k], tr)
+    L = params["layers"]["wq"].shape[0]
+    for k, (tmpl, tr) in _LAYER_MAP.items():
+        for i in range(L):
+            put(tmpl.format(i=i), params["layers"][k][i], tr)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    save_file(out, path)
+
+
+def download_model(name: str, out_dir: str) -> str:
+    """HF snapshot of the safetensors files (reference utils.py:100-115).
+    Offline environments: point configs at a local directory instead."""
+    from huggingface_hub import snapshot_download
+
+    return snapshot_download(
+        repo_id=name,
+        allow_patterns=["*.safetensors", "*.json"],
+        local_dir=out_dir,
+    )
+
+
+def model_config_from_hf(path_or_name: str) -> dict:
+    """Read an HF config.json into our ModelConfig field names (the reference
+    drives model shape from AutoConfig, create_config.py:51-54)."""
+    cfg_path = (
+        path_or_name
+        if path_or_name.endswith(".json")
+        else os.path.join(path_or_name, "config.json")
+    )
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            hf = json.load(f)
+    else:
+        from transformers import AutoConfig
+
+        hf = AutoConfig.from_pretrained(path_or_name).to_dict()
+    keys = [
+        "num_hidden_layers", "num_attention_heads", "num_key_value_heads",
+        "hidden_size", "intermediate_size", "vocab_size", "rms_norm_eps",
+        "rope_theta", "max_position_embeddings",
+    ]
+    return {k: hf[k] for k in keys if k in hf}

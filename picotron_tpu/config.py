@@ -104,6 +104,17 @@ class LoggingConfig:
     log_frequency: int = 1
 
 
+# The flagship benchmark model (reference README.md:7 headline:
+# SmolLM-1.7B at ~50% MFU on 8xH100). Shared by bench.py and the driver
+# entry so both always measure the same model.
+SMOLLM_1_7B = dict(
+    name="HuggingFaceTB/SmolLM-1.7B", num_hidden_layers=24,
+    num_attention_heads=32, num_key_value_heads=32, hidden_size=2048,
+    intermediate_size=8192, vocab_size=49152, max_position_embeddings=2048,
+    dtype="bfloat16", attention_impl="auto",
+)
+
+
 @dataclass
 class Config:
     distributed: DistributedConfig = field(default_factory=DistributedConfig)

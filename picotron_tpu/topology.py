@@ -19,7 +19,7 @@ from dataclasses import dataclass
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 MESH_AXES = ("dp", "pp", "cp", "tp")
 
@@ -66,6 +66,14 @@ def build_topology(dp: int, pp: int, cp: int, tp: int, devices=None) -> Topology
 def topology_from_config(cfg, devices=None) -> Topology:
     d = cfg.distributed
     return build_topology(d.dp_size, d.pp_size, d.cp_size, d.tp_size, devices=devices)
+
+
+def named_shardings(topo: Topology, pspecs):
+    """Map a PartitionSpec pytree to NamedShardings on this topology's mesh."""
+    return jax.tree.map(
+        lambda s: NamedSharding(topo.mesh, s), pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def batch_pspec() -> P:

@@ -35,7 +35,7 @@ from jax.tree_util import tree_flatten_with_path, tree_unflatten
 from picotron_tpu.config import Config
 from picotron_tpu.models import llama
 from picotron_tpu.parallel.pp import no_pipeline, pipeline_1f1b, pipeline_afab
-from picotron_tpu.topology import Topology, batch_pspec
+from picotron_tpu.topology import Topology, batch_pspec, named_shardings
 
 
 def build_optimizer(cfg: Config) -> optax.GradientTransformation:
@@ -95,20 +95,14 @@ def init_state(cfg: Config, topo: Topology, seed: int | None = None):
     per-rank materialization (checkpoint.py:15-48, 50-102)."""
     seed = cfg.training.seed if seed is None else seed
     pspecs = llama.param_pspecs(cfg.model)
-    shardings = jax.tree.map(
-        lambda s: NamedSharding(topo.mesh, s), pspecs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    shardings = named_shardings(topo, pspecs)
     key = jax.random.PRNGKey(seed)
     params = jax.jit(partial(llama.init_params, m=cfg.model), out_shardings=shardings)(key)
 
     optimizer = build_optimizer(cfg)
     o_shape = jax.eval_shape(optimizer.init, params)
     ospecs = opt_pspecs(o_shape, pspecs)
-    oshardings = jax.tree.map(
-        lambda s: NamedSharding(topo.mesh, s), ospecs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    oshardings = named_shardings(topo, ospecs)
     opt_state = jax.jit(optimizer.init, out_shardings=oshardings)(params)
     return params, opt_state
 

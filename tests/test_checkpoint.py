@@ -1,0 +1,161 @@
+"""Checkpoint subsystem tests (SURVEY.md §5.4).
+
+Covers what the reference never unit-tested: training-checkpoint save/resume
+(reference checkpoint.py:242-278) including resume-under-a-different-topology
+(unsupported in the reference — "Assume the topology is the same",
+checkpoint.py:263 — but free with global sharded arrays), and the HF
+safetensors name-map round trip (checkpoint.py:213-230).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from picotron_tpu import checkpoint as ckpt
+from picotron_tpu import train_step as ts
+from picotron_tpu.data import MicroBatchDataLoader
+from picotron_tpu.models import llama
+from picotron_tpu.topology import topology_from_config
+
+from conftest import make_config
+
+
+def _train(cfg, topo, params, opt_state, loader, steps):
+    step = ts.build_train_step(cfg, topo)
+    loss = None
+    for _ in range(steps):
+        tokens, targets = ts.shard_batch(next(loader), topo)
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    return params, opt_state, loss
+
+
+def test_save_resume_bitwise(tiny_model_kwargs, tmp_path):
+    """Train 2 steps, checkpoint, train 3 more; vs. resume-from-checkpoint
+    and train the same 3: identical final loss."""
+    cfg = make_config(tiny_model_kwargs, dp=2, tp=2, acc=1)
+    topo = topology_from_config(cfg)
+    params, opt_state = ts.init_state(cfg, topo)
+    loader = MicroBatchDataLoader(cfg)
+    params, opt_state, _ = _train(cfg, topo, params, opt_state, loader, 2)
+
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(2, params, opt_state, trained_tokens=2 * cfg.tokens_per_step)
+
+    # continue original
+    batches = [next(loader) for _ in range(3)]
+    step = ts.build_train_step(cfg, topo)
+    p1, o1 = params, opt_state
+    for b in batches:
+        tok, tgt = ts.shard_batch(b, topo)
+        p1, o1, loss_orig = step(p1, o1, tok, tgt)
+
+    # resume path: fresh state objects, restore, replay same batches
+    p2, o2 = ts.init_state(cfg, topo, seed=123)  # different seed: must be overwritten
+    p2, o2, got_step, got_tokens = mgr.load(p2, o2)
+    assert got_step == 2
+    assert got_tokens == 2 * cfg.tokens_per_step
+    for b in batches:
+        tok, tgt = ts.shard_batch(b, topo)
+        p2, o2, loss_res = step(p2, o2, tok, tgt)
+
+    assert float(loss_orig) == float(loss_res)
+    mgr.close()
+
+
+def test_resume_under_different_topology(tiny_model_kwargs, tmp_path):
+    """Save under dp=8, restore under tp=2/cp=2/dp=2 — the topology-change
+    resharding the reference cannot do (checkpoint.py:263)."""
+    cfg_a = make_config(tiny_model_kwargs, dp=8, mbs=1)
+    topo_a = topology_from_config(cfg_a)
+    params_a, opt_a = ts.init_state(cfg_a, topo_a)
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, params_a, opt_a, trained_tokens=17)
+
+    cfg_b = make_config(tiny_model_kwargs, dp=2, tp=2, cp=2, mbs=4)
+    topo_b = topology_from_config(cfg_b)
+    params_b, opt_b = ts.init_state(cfg_b, topo_b, seed=999)
+    params_b, opt_b, step_no, tokens = mgr.load(params_b, opt_b)
+    assert (step_no, tokens) == (1, 17)
+
+    # values equal regardless of layout
+    for a, b in zip(jax.tree.leaves(params_a), jax.tree.leaves(params_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restored arrays carry topology-B shardings, ready for the B train step
+    loader = MicroBatchDataLoader(cfg_b)
+    tok, tgt = ts.shard_batch(next(loader), topo_b)
+    step = ts.build_train_step(cfg_b, topo_b)
+    _, _, loss = step(params_b, opt_b, tok, tgt)
+    assert np.isfinite(float(loss))
+    mgr.close()
+
+
+def test_hf_safetensors_roundtrip(tiny_model_kwargs, tmp_path):
+    """Export to HF naming, re-import, require exact tree equality and an
+    identical forward — validates both directions of the name map
+    (reference checkpoint.py:213-230) and the (out,in)↔(in,out) transpose."""
+    cfg = make_config(tiny_model_kwargs, tp=1)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg.model)
+    sft = str(tmp_path / "model.safetensors")
+    ckpt.save_hf_safetensors(params, sft)
+
+    topo = topology_from_config(cfg)
+    loaded = ckpt.load_hf_safetensors(sft, cfg.model, topo)
+    assert jax.tree.structure(params) == jax.tree.structure(loaded)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hf_import_sharded_and_tied(tiny_model_kwargs, tmp_path):
+    """Sharded index layout + tied-embeddings fallback: a checkpoint without
+    lm_head.weight gets the embedding transpose as an untied head
+    (reference always creates a fresh untied head, checkpoint.py:88-91)."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    cfg = make_config(tiny_model_kwargs)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg.model)
+    full = {}
+    ckpt.save_hf_safetensors(params, str(tmp_path / "tmp.safetensors"))
+    from safetensors import safe_open
+
+    with safe_open(str(tmp_path / "tmp.safetensors"), framework="np") as f:
+        for k in f.keys():
+            full[k] = f.get_tensor(k)
+    del full["lm_head.weight"]  # tie
+
+    # split across two shard files with an index
+    names = sorted(full)
+    half = len(names) // 2
+    shards = {"model-00001.safetensors": names[:half],
+              "model-00002.safetensors": names[half:]}
+    d = tmp_path / "sharded"
+    d.mkdir()
+    weight_map = {}
+    for fname, ks in shards.items():
+        save_file({k: full[k] for k in ks}, str(d / fname))
+        weight_map.update({k: fname for k in ks})
+    with open(d / "model.safetensors.index.json", "w") as f:
+        json.dump({"weight_map": weight_map}, f)
+
+    loaded = ckpt.load_hf_safetensors(str(d), cfg.model)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["lm_head"]), np.asarray(params["embed"]).T)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["layers"]["wq"]), np.asarray(params["layers"]["wq"]))
+
+
+def test_model_config_from_hf(tmp_path):
+    import json
+
+    hf = dict(num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+              hidden_size=32, intermediate_size=64, vocab_size=128,
+              rms_norm_eps=1e-6, rope_theta=5000.0, max_position_embeddings=64,
+              architectures=["LlamaForCausalLM"])
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(hf))
+    got = ckpt.model_config_from_hf(str(p))
+    assert got["hidden_size"] == 32 and got["rope_theta"] == 5000.0
+    assert "architectures" not in got
