@@ -94,7 +94,10 @@ class DatasetConfig:
 class CheckpointConfig:
     save_dir: str = "checkpoints"
     save_frequency: int = 0  # 0 = disabled
-    load_path: str = ""
+    load_path: str = ""  # orbax checkpoint dir to resume from
+    # HF-format safetensors file/dir to initialize weights from before training
+    # (the reference's bootstrap path, checkpoint.py:50-102)
+    hf_bootstrap_path: str = ""
 
 
 @dataclass
@@ -102,6 +105,11 @@ class LoggingConfig:
     use_wandb: bool = False
     run_name: str = "picotron-tpu"
     log_frequency: int = 1
+    # capture a jax.profiler trace for steps [profile_start, profile_stop)
+    # into profile_dir (SURVEY.md §5.1 rebuild note); 0 = off
+    profile_start: int = 0
+    profile_stop: int = 0
+    profile_dir: str = "profiles"
 
 
 # The flagship benchmark model (reference README.md:7 headline:

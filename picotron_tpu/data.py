@@ -96,6 +96,15 @@ class MicroBatchDataLoader:
                     remove_columns=ds.column_names)
         return np.concatenate([np.asarray(x, np.int32) for x in ds["ids"]])
 
+    def skip_steps(self, n_steps: int) -> None:
+        """Advance the cursor past n_steps global batches (resume support: the
+        reference replays the dataset from the top after resume since only
+        step/tokens are checkpointed, train.py:214-215; skipping is strictly
+        better and costs an index update)."""
+        total = n_steps * self.grad_acc * self.rows_per_step
+        wraps, self._cursor = divmod(self._cursor + total, len(self.samples))
+        self._epoch += wraps
+
     def __iter__(self) -> Iterator[dict]:
         return self
 

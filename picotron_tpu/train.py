@@ -1,0 +1,179 @@
+"""Training entry point: ``python -m picotron_tpu.train --config exp.json``.
+
+The TPU single-controller collapse of the reference's ``train.py`` (:57-281).
+What torchrun + rendezvous + per-rank env vars did there is one process here:
+the config names a (dp, pp, cp, tp) topology, the mesh is built over the
+visible devices, and one jitted shard_map program runs the whole 4D step.
+
+Per-step log line carries the same fields the reference prints
+(train.py:247-259): step, loss, global batch size, tokens/s, tokens/s/chip,
+trained tokens, MFU, device memory — which is exactly what the
+extract_metrics CLI scrapes (extract_metrics.py:55-68). wandb logging is
+opt-in with the same run-name convention (train.py:132-150); a jax.profiler
+trace window replaces the reference's absent profiler (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+
+def _ensure_devices(cfg) -> None:
+    """use_cpu runs (the reference's Gloo path, train.py:83) need the virtual
+    CPU device count pinned before a backend exists."""
+    if cfg.distributed.use_cpu:
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={cfg.world_size} "
+            + os.environ.get("XLA_FLAGS", "")
+        )
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _wandb_init(cfg):
+    """Run name convention from the reference: {name}_{tokens-per-step}_
+    {topology} (train.py:132-143)."""
+    import wandb
+
+    from picotron_tpu.utils import to_readable_format
+
+    d = cfg.distributed
+    run_name = (
+        f"{cfg.logging.run_name}_{to_readable_format(cfg.tokens_per_step)}"
+        f"_dp{d.dp_size}_tp{d.tp_size}_pp{d.pp_size}_cp{d.cp_size}"
+    )
+    wandb.init(name=run_name, config=cfg.to_dict())
+    return wandb
+
+
+def train(cfg, max_steps_override: Optional[int] = None):
+    """Run the training loop; returns (final_step, trained_tokens, last_loss)."""
+    import jax
+
+    from picotron_tpu import checkpoint as ckpt_mod
+    from picotron_tpu import train_step as ts
+    from picotron_tpu import utils
+    from picotron_tpu.data import MicroBatchDataLoader
+    from picotron_tpu.models import llama
+    from picotron_tpu.topology import topology_from_config
+
+    t0_setup = time.perf_counter()
+    topo = topology_from_config(cfg)
+    m, t, c, lg = cfg.model, cfg.training, cfg.checkpoint, cfg.logging
+    utils.set_all_seed(t.seed)
+
+    loader = MicroBatchDataLoader(cfg)
+    params, opt_state = ts.init_state(cfg, topo)
+    if c.hf_bootstrap_path:
+        params = ckpt_mod.load_hf_safetensors(c.hf_bootstrap_path, m, topo)
+    step_fn = ts.build_train_step(cfg, topo)
+
+    manager = None
+    if c.save_frequency > 0 or c.load_path:
+        manager = ckpt_mod.CheckpointManager(c.load_path or c.save_dir)
+
+    step, trained_tokens = 0, 0
+    if c.load_path:
+        params, opt_state, step, trained_tokens = manager.load(params, opt_state)
+        loader.skip_steps(step)
+        print(f"resumed from {c.load_path} at step {step} "
+              f"({utils.to_readable_format(trained_tokens)} tokens)")
+        if c.load_path != c.save_dir and c.save_frequency > 0:
+            manager.close()
+            manager = ckpt_mod.CheckpointManager(c.save_dir)
+
+    wandb = _wandb_init(cfg) if lg.use_wandb else None
+    n_params = llama.num_params(m)
+    peak = utils.peak_flops_per_chip()
+    n_chips = topo.world_size
+    max_steps = max_steps_override or t.total_train_steps
+    print(f"model {m.name}: {utils.to_readable_format(n_params)} params | "
+          f"mesh dp={topo.dp_size} pp={topo.pp_size} cp={topo.cp_size} "
+          f"tp={topo.tp_size} on {n_chips} x {jax.devices()[0].device_kind} | "
+          f"global batch {cfg.global_batch_size} "
+          f"({utils.to_readable_format(cfg.tokens_per_step)} tokens/step) | "
+          f"setup {time.perf_counter() - t0_setup:.1f}s")
+
+    loss = float("nan")
+    while step < max_steps and (t.max_tokens is None or trained_tokens < t.max_tokens):
+        if lg.profile_start and step == lg.profile_start:
+            jax.profiler.start_trace(lg.profile_dir)
+        t_start = time.perf_counter()
+        tokens, targets = ts.shard_batch(next(loader), topo)
+        params, opt_state, loss_arr = step_fn(params, opt_state, tokens, targets)
+        loss = float(jax.block_until_ready(loss_arr))
+        dt_step = time.perf_counter() - t_start
+
+        step += 1
+        trained_tokens += cfg.tokens_per_step
+        if lg.profile_stop and step == lg.profile_stop:
+            jax.profiler.stop_trace()
+
+        tok_s = cfg.tokens_per_step / dt_step
+        tok_s_chip = tok_s / n_chips
+        mfu = utils.get_mfu(tok_s_chip, n_params, m.num_hidden_layers,
+                            m.hidden_size, t.seq_length, peak)
+        mem = utils.device_memory_gb()
+        if step % lg.log_frequency == 0:
+            parts = [
+                f"Step: {step:<5d}",
+                f"Loss: {loss:6.4f}",
+                f"Global batch size: {utils.to_readable_format(cfg.tokens_per_step)}",
+                f"Tokens/s: {utils.to_readable_format(tok_s)}",
+                f"Tokens/s/chip: {utils.to_readable_format(tok_s_chip)}",
+                f"Tokens: {utils.to_readable_format(trained_tokens)}",
+            ]
+            if mfu is not None:
+                parts.append(f"MFU: {mfu:.2f}%")
+            if mem is not None:
+                parts.append(f"Memory usage: {mem:.2f}GB")
+            print(" | ".join(parts), flush=True)
+        if wandb is not None and step % lg.log_frequency == 0:
+            wandb.log({"loss": loss, "tokens_per_sec": tok_s,
+                       "tokens_per_sec_per_chip": tok_s_chip,
+                       "trained_tokens": trained_tokens,
+                       **({"mfu": mfu} if mfu is not None else {}),
+                       **({"memory_gb": mem} if mem is not None else {})},
+                      step=step)
+
+        if manager is not None and c.save_frequency > 0 and step % c.save_frequency == 0:
+            manager.save(step, params, opt_state, trained_tokens)
+
+    if manager is not None:
+        if c.save_frequency > 0 and step % c.save_frequency != 0:
+            manager.save(step, params, opt_state, trained_tokens)
+        manager.close()
+    if wandb is not None:
+        wandb.finish()
+    return step, trained_tokens, loss
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="picotron-tpu trainer (one JSON config per experiment, "
+                    "reference train.py:57-63)")
+    parser.add_argument("--config", required=True, help="path to config.json")
+    parser.add_argument("--max-steps", type=int, default=None,
+                        help="override training.total_train_steps")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        raw = json.load(f)
+    from picotron_tpu.config import Config
+
+    cfg = Config.from_dict(raw)
+    _ensure_devices(cfg)
+    step, tokens, loss = train(cfg, max_steps_override=args.max_steps)
+    print(f"done: {step} steps, {tokens} tokens, final loss {loss:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+#!/usr/bin/env python
+"""Convenience shim: ``python train.py --config exp.json`` from the repo root
+(the reference's entry-point UX) — the real trainer is picotron_tpu.train."""
+
+import sys
+
+from picotron_tpu.train import main
+
+if __name__ == "__main__":
+    sys.exit(main())
