@@ -1,0 +1,7 @@
+#!/usr/bin/env python
+"""Repo-root shim matching the reference UX: ``python create_config.py --dp 2 ...``."""
+
+from picotron_tpu.tools.create_config import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,7 @@
+#!/usr/bin/env python
+"""Repo-root shim matching the reference UX: ``python extract_metrics.py <sweep_dir>``."""
+
+from picotron_tpu.tools.extract_metrics import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,2 @@
+"""Experiment tooling CLIs (the reference's L6 layer, SURVEY.md §1):
+create_config / submit_jobs / extract_metrics."""

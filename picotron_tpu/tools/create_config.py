@@ -1,0 +1,245 @@
+"""Experiment config generator: ``python create_config.py --dp 2 --tp 2 ...``.
+
+Re-build of the reference's ``create_config.py`` (:40-136): copy
+``template/base_config.json``, override the distributed/model/training fields
+from CLI flags, compute and print the global batch size (:71-73), and write
+``<out_dir>/<exp_name>/config.json`` (:78-83). Model shape defaults come from
+HF ``AutoConfig`` when the hub is reachable (:51-54); because TPU pods are
+often air-gapped there is also a built-in shape table for the models the
+reference benchmarks, so the generator works fully offline. The reference's
+trailing safetensors download (:134) becomes opt-in ``--download`` (it needs
+network and is not required for pre-training from scratch).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+# Known model shapes so config generation works with zero egress.
+# Values mirror each model's HF config.json.
+KNOWN_MODEL_SHAPES = {
+    "HuggingFaceTB/SmolLM-135M": dict(
+        num_hidden_layers=30, num_attention_heads=9, num_key_value_heads=3,
+        hidden_size=576, intermediate_size=1536, vocab_size=49152,
+        rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=2048),
+    "HuggingFaceTB/SmolLM-360M": dict(
+        num_hidden_layers=32, num_attention_heads=15, num_key_value_heads=5,
+        hidden_size=960, intermediate_size=2560, vocab_size=49152,
+        rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=2048),
+    "HuggingFaceTB/SmolLM-1.7B": dict(
+        num_hidden_layers=24, num_attention_heads=32, num_key_value_heads=32,
+        hidden_size=2048, intermediate_size=8192, vocab_size=49152,
+        rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=2048),
+    "meta-llama/Llama-2-7b-hf": dict(
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+        hidden_size=4096, intermediate_size=11008, vocab_size=32000,
+        rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=4096),
+    "meta-llama/Meta-Llama-3-8B": dict(
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        hidden_size=4096, intermediate_size=14336, vocab_size=128256,
+        rms_norm_eps=1e-5, rope_theta=500000.0, max_position_embeddings=8192),
+}
+# Instruct variants share the base shapes.
+for _base in list(KNOWN_MODEL_SHAPES):
+    KNOWN_MODEL_SHAPES[_base + "-Instruct"] = KNOWN_MODEL_SHAPES[_base]
+
+TEMPLATE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "template", "base_config.json")
+
+
+# Shape fields a config must resolve one way or another; everything else
+# (rms_norm_eps, rope_theta, ...) has sane template defaults.
+REQUIRED_SHAPE_FIELDS = (
+    "num_hidden_layers", "num_attention_heads", "num_key_value_heads",
+    "hidden_size", "intermediate_size", "vocab_size",
+)
+
+
+def model_shape_defaults(model_name: str, overrides: dict) -> dict:
+    """Shape fields for a model: built-in table first, HF AutoConfig as the
+    online fallback (the reference always fetches, create_config.py:51-54).
+    A fully-overridden unknown model needs neither — the air-gapped path."""
+    if model_name in KNOWN_MODEL_SHAPES:
+        return dict(KNOWN_MODEL_SHAPES[model_name])
+    if all(overrides.get(k) is not None for k in REQUIRED_SHAPE_FIELDS):
+        return {}
+    try:
+        from transformers import AutoConfig
+
+        hf = AutoConfig.from_pretrained(model_name)
+        return dict(
+            num_hidden_layers=hf.num_hidden_layers,
+            num_attention_heads=hf.num_attention_heads,
+            num_key_value_heads=getattr(
+                hf, "num_key_value_heads", hf.num_attention_heads),
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            vocab_size=hf.vocab_size,
+            rms_norm_eps=getattr(hf, "rms_norm_eps", 1e-5),
+            rope_theta=getattr(hf, "rope_theta", 10000.0),
+            max_position_embeddings=hf.max_position_embeddings,
+        )
+    except Exception as e:  # pragma: no cover - network-dependent
+        missing = [k for k in REQUIRED_SHAPE_FIELDS if overrides.get(k) is None]
+        raise SystemExit(
+            f"model {model_name!r} is not in the built-in shape table and "
+            f"AutoConfig fetch failed ({e}); pass explicit "
+            + " ".join(f"--{k}" for k in missing)) from e
+
+
+def create_single_config(
+    out_dir: str,
+    exp_name: str,
+    *,
+    tp: int = 1, cp: int = 1, dp: int = 1, pp: int = 1,
+    pp_engine: str = "1f1b",
+    model_name: str = "HuggingFaceTB/SmolLM-360M-Instruct",
+    num_hidden_layers: Optional[int] = None,
+    num_attention_heads: Optional[int] = None,
+    num_key_value_heads: Optional[int] = None,
+    hidden_size: Optional[int] = None,
+    intermediate_size: Optional[int] = None,
+    vocab_size: Optional[int] = None,
+    grad_acc_steps: int = 1,
+    mbs: int = 1,
+    seq_len: int = 1024,
+    subset_name: Optional[str] = None,
+    dataset_name: Optional[str] = None,
+    use_wandb: bool = False,
+    use_cpu: bool = False,
+    learning_rate: Optional[float] = None,
+    total_train_steps: Optional[int] = None,
+    seed: Optional[int] = None,
+    template_path: str = TEMPLATE_PATH,
+    exist_ok: bool = False,
+) -> str:
+    """Write <out_dir>/<exp_name>/config.json; returns its path."""
+    with open(template_path) as f:
+        content = json.load(f)
+
+    d = content["distributed"]
+    d.update(tp_size=tp, cp_size=cp, dp_size=dp, pp_size=pp,
+             pp_engine=pp_engine, use_cpu=use_cpu)
+
+    m = content["model"]
+    m["name"] = model_name
+    # Explicit overrides win over fetched/known shapes (reference
+    # create_config.py:55-60); a fully-overridden unknown model never
+    # touches the network.
+    overrides = {k: v for k, v in dict(
+        num_hidden_layers=num_hidden_layers,
+        num_attention_heads=num_attention_heads,
+        num_key_value_heads=num_key_value_heads,
+        hidden_size=hidden_size,
+        intermediate_size=intermediate_size,
+        vocab_size=vocab_size,
+    ).items() if v is not None}
+    m.update(model_shape_defaults(model_name, overrides))
+    m.update(overrides)
+
+    t = content["training"]
+    t.update(gradient_accumulation_steps=grad_acc_steps,
+             micro_batch_size=mbs, seq_length=seq_len)
+    if seq_len > m["max_position_embeddings"]:
+        m["max_position_embeddings"] = seq_len
+    if learning_rate is not None:
+        t["learning_rate"] = learning_rate
+    if total_train_steps is not None:
+        t["total_train_steps"] = total_train_steps
+    if seed is not None:
+        t["seed"] = seed
+
+    if dataset_name is not None:
+        content["dataset"]["name"] = dataset_name
+    if subset_name is not None:
+        content["dataset"]["subset_name"] = subset_name
+    content["logging"]["use_wandb"] = use_wandb
+    content["logging"]["run_name"] = exp_name
+
+    gbs = mbs * grad_acc_steps * dp
+    print(f"global batch size: {gbs} samples, {gbs * seq_len} tokens "
+          f"(mbs {mbs} x grad_acc {grad_acc_steps} x dp {dp})")
+
+    # Validate before writing so a bad topology fails here, not at launch.
+    from picotron_tpu.config import Config
+
+    Config.from_dict(content)
+
+    run_path = os.path.join(out_dir, exp_name)
+    os.makedirs(run_path, exist_ok=exist_ok)
+    cfg_path = os.path.join(run_path, "config.json")
+    with open(cfg_path, "w") as f:
+        json.dump(content, f, indent=2)
+    return cfg_path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # Flag surface mirrors the reference (create_config.py:86-107).
+    p = argparse.ArgumentParser(description="Create experiment config.json files")
+    p.add_argument("--out_dir", type=str, default="tmp")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--cp", type=int, default=1)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--pp_engine", type=str, default="1f1b")
+    p.add_argument("--model_name", type=str,
+                   default="HuggingFaceTB/SmolLM-360M-Instruct")
+    p.add_argument("--num_hidden_layers", type=int, default=None)
+    p.add_argument("--num_attention_heads", type=int, default=None)
+    p.add_argument("--num_key_value_heads", type=int, default=None)
+    p.add_argument("--hidden_size", type=int, default=None)
+    p.add_argument("--intermediate_size", type=int, default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--grad_acc_steps", type=int, default=1)
+    p.add_argument("--mbs", type=int, default=1)
+    p.add_argument("--seq_len", type=int, default=1024)
+    p.add_argument("--dataset_name", type=str, default=None)
+    p.add_argument("--subset_name", type=str, default=None)
+    p.add_argument("--exp_name", type=str, default="dummy_exp")
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--total_train_steps", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--use_wandb", action="store_true")
+    p.add_argument("--use_cpu", action="store_true")
+    p.add_argument("--template", type=str, default=TEMPLATE_PATH)
+    p.add_argument("--overwrite", action="store_true",
+                   help="allow regenerating into an existing experiment dir")
+    p.add_argument("--download", action="store_true",
+                   help="also download the model's safetensors from HF "
+                        "(needs network; reference create_config.py:134)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    path = create_single_config(
+        out_dir=args.out_dir, exp_name=args.exp_name,
+        tp=args.tp, cp=args.cp, dp=args.dp, pp=args.pp,
+        pp_engine=args.pp_engine, model_name=args.model_name,
+        num_hidden_layers=args.num_hidden_layers,
+        num_attention_heads=args.num_attention_heads,
+        num_key_value_heads=args.num_key_value_heads,
+        hidden_size=args.hidden_size,
+        intermediate_size=args.intermediate_size,
+        vocab_size=args.vocab_size,
+        grad_acc_steps=args.grad_acc_steps, mbs=args.mbs, seq_len=args.seq_len,
+        dataset_name=args.dataset_name, subset_name=args.subset_name,
+        use_wandb=args.use_wandb, use_cpu=args.use_cpu,
+        learning_rate=args.lr, total_train_steps=args.total_train_steps,
+        seed=args.seed, template_path=args.template, exist_ok=args.overwrite,
+    )
+    print(f"config created: {path}")
+    if args.download:
+        from picotron_tpu.checkpoint import download_model
+
+        download_model(args.model_name, "./hf_model_safetensors/")
+        print("safetensors downloaded")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

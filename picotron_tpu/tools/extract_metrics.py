@@ -1,0 +1,162 @@
+"""Scrape per-step training logs into CSV benchmark tables.
+
+Re-build of the reference's ``extract_metrics.py`` (:1-210): regex-parse the
+throughput fields out of each run's log, drop the first 3 steps as compile/
+cache warmup and average the rest (:82-89), write a per-run ``metrics.csv``
+and a sweep-level ``global_metrics.csv`` whose topology columns are parsed
+from the run-folder naming convention ``...dp2_tp4_pp2_cp1_mbs1_ga8_sl2048...``
+(:8-23,:147-195). The log-line grammar is what ``picotron_tpu.train`` prints
+(train.py log line; reference train.py:247-259) — ``Tokens/s/chip`` instead
+of ``Tokens/s/GPU``, plus optional ``MFU:`` and ``Memory usage:`` fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import glob
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+_SUFFIX = {"T": 1e12, "B": 1e9, "M": 1e6, "K": 1e3}
+
+
+def from_readable_format(s: str) -> float:
+    """'1.23M' -> 1230000.0 (inverse of utils.to_readable_format)."""
+    s = s.strip().upper()
+    if s and s[-1] in _SUFFIX:
+        return float(s[:-1]) * _SUFFIX[s[-1]]
+    return float(s)
+
+
+def parse_folder_name(folder_name: str) -> dict:
+    """Pull topology numbers out of a run-dir name (reference :8-23), with a
+    'cp' field added since CP is part of this framework's sweep axis set."""
+    out = {}
+    for key, col in (("dp", "dp"), ("tp", "tp"), ("pp", "pp"), ("cp", "cp"),
+                     ("mbs", "micro_batch_size"), ("ga", "grad_acc"),
+                     ("sl", "seq_len")):
+        m = re.search(rf"{key}(\d+)", folder_name)
+        out[col] = int(m.group(1)) if m else None
+    return out
+
+
+LINE_RE = re.compile(
+    r"Step:\s*(?P<step>\d+).*?"
+    r"Loss:\s*(?P<loss>[\d.]+(?:e[+-]?\d+)?).*?"
+    r"Tokens/s:\s*(?P<tok_s>[\d.]+[KMBT]?)\s*\|\s*"
+    r"Tokens/s/chip:\s*(?P<tok_s_chip>[\d.]+[KMBT]?)"
+)
+MFU_RE = re.compile(r"MFU:\s*([\d.]+)%")
+MEM_RE = re.compile(r"Memory usage:\s*([\d.]+)GB")
+
+
+def parse_log_line(line: str) -> Optional[dict]:
+    m = LINE_RE.search(line)
+    if not m:
+        return None
+    mfu = MFU_RE.search(line)
+    mem = MEM_RE.search(line)
+    return {
+        "step": int(m.group("step")),
+        "loss": float(m.group("loss")),
+        "tokens_per_sec": from_readable_format(m.group("tok_s")),
+        "tokens_per_sec_per_chip": from_readable_format(m.group("tok_s_chip")),
+        "mfu_pct": float(mfu.group(1)) if mfu else None,
+        "memory_gb": float(mem.group(1)) if mem else None,
+    }
+
+
+def parse_log_file(path: str) -> list[dict]:
+    rows = []
+    with open(path, errors="replace") as f:
+        for line in f:
+            row = parse_log_line(line)
+            if row:
+                rows.append(row)
+    return rows
+
+
+WARMUP_STEPS = 3  # reference extract_metrics.py:82-89
+
+
+def summarize(rows: list[dict]) -> Optional[dict]:
+    """Mean over steps after dropping the first WARMUP_STEPS (compile +
+    cache-fill on TPU; CUDA-graph/alloc warmup in the reference)."""
+    rows = rows[WARMUP_STEPS:]
+    if not rows:
+        return None
+
+    def mean_of(key):
+        vals = [r[key] for r in rows if r[key] is not None]
+        return float(np.mean(vals)) if vals else None
+
+    return {
+        "num_steps": len(rows),
+        "final_loss": rows[-1]["loss"],
+        "tokens_per_sec": mean_of("tokens_per_sec"),
+        "tokens_per_sec_per_chip": mean_of("tokens_per_sec_per_chip"),
+        "mfu_pct": mean_of("mfu_pct"),
+        "memory_gb": mean_of("memory_gb"),
+    }
+
+
+def find_log(run_dir: str) -> Optional[str]:
+    for pat in ("log.out", "*.out", "*.log"):
+        hits = sorted(glob.glob(os.path.join(run_dir, pat)))
+        if hits:
+            return hits[0]
+    return None
+
+
+def _write_csv(path: str, rows: list[dict]) -> None:
+    if not rows:
+        return
+    with open(path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+
+
+def extract(inp_dir: str) -> list[dict]:
+    """Per run dir: metrics.csv with per-step rows; at the sweep root:
+    global_metrics.csv with one summary row per run (reference :147-195)."""
+    global_rows = []
+    for root, _dirs, files in sorted(os.walk(inp_dir)):
+        has_log = find_log(root)
+        if not has_log:
+            continue
+        rows = parse_log_file(has_log)
+        if not rows:
+            continue
+        _write_csv(os.path.join(root, "metrics.csv"), rows)
+        summary = summarize(rows)
+        if summary is None:
+            print(f"{root}: fewer than {WARMUP_STEPS + 1} steps, skipped")
+            continue
+        name = os.path.basename(os.path.normpath(root))
+        global_rows.append({"run": name, **parse_folder_name(name), **summary})
+    _write_csv(os.path.join(inp_dir, "global_metrics.csv"), global_rows)
+    return global_rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="Extract metrics from training logs")
+    p.add_argument("inp_dir", help="sweep directory containing run subdirs")
+    args = p.parse_args(argv)
+    rows = extract(args.inp_dir)
+    for r in rows:
+        tsc = r["tokens_per_sec_per_chip"]
+        mfu = f"{r['mfu_pct']:.2f}%" if r["mfu_pct"] is not None else "n/a"
+        print(f"{r['run']}: {tsc:,.0f} tokens/s/chip, MFU {mfu}, "
+              f"final loss {r['final_loss']:.4f} over {r['num_steps']} steps")
+    print(f"wrote {os.path.join(args.inp_dir, 'global_metrics.csv')} "
+          f"({len(rows)} runs)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,7 @@
+#!/usr/bin/env python
+"""Repo-root shim matching the reference UX: ``python submit_jobs.py --inp_dir sweeps/``."""
+
+from picotron_tpu.tools.submit_jobs import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())
