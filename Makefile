@@ -1,0 +1,20 @@
+# picotron_tpu build/test entry points.
+NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
+NATIVE_SRC := picotron_tpu/native/dataloader.cc
+
+.PHONY: native test bench clean
+
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): $(NATIVE_SRC)
+	mkdir -p $(dir $@)
+	g++ -O3 -shared -fPIC -std=c++17 $< -o $@
+
+test: native
+	python -m pytest tests/ -x -q
+
+bench: native
+	python bench.py
+
+clean:
+	rm -rf picotron_tpu/native/_build

@@ -23,22 +23,35 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from picotron_tpu import native
 from picotron_tpu.config import Config
 
 
 def synthetic_corpus(vocab_size: int, length: int, seed: int) -> np.ndarray:
     """Deterministic, learnable token stream: a noisy affine bigram chain
     (next = a*t + b mod V, with occasional random jumps) so loss curves fall
-    measurably below ln(V) once the model learns the transitions."""
+    measurably below ln(V) once the model learns the transitions.
+
+    All random draws come from numpy's PCG64; only the loop-carried
+    recurrence runs in the native kernel when available, so the native and
+    Python paths are bitwise identical."""
     rng = np.random.default_rng(seed)
     a = int(rng.integers(1, vocab_size))
     b = int(rng.integers(0, vocab_size))
     toks = np.empty(length, dtype=np.int32)
     toks[0] = rng.integers(0, vocab_size)
     jumps = rng.random(length) < 0.05
+    # NOTE: int64 draw (numpy's default) — Generator.integers consumes a
+    # different stream per dtype, and the corpus for a given seed is part of
+    # the resume/baseline contract.
     jump_vals = rng.integers(0, vocab_size, length)
-    for i in range(1, length):
-        toks[i] = jump_vals[i] if jumps[i] else (a * int(toks[i - 1]) + b) % vocab_size
+    if native.available():
+        native.affine_chain(toks, jumps.view(np.uint8), jump_vals,
+                            a, b, vocab_size)
+    else:
+        for i in range(1, length):
+            toks[i] = (jump_vals[i] if jumps[i]
+                       else (a * int(toks[i - 1]) + b) % vocab_size)
     return toks
 
 
@@ -75,6 +88,14 @@ class MicroBatchDataLoader:
             raise ValueError("dataset too small for one global batch")
         self._epoch = 0
         self._cursor = 0
+        # DistributedSampler(shuffle=False) hands sample i to dp rank i % dp
+        # (reference data.py:40-45); row-major [dp, mbs] layout after this
+        # permutation puts each rank's rows contiguous for the 'dp' sharding.
+        perm = (np.arange(self.rows_per_step)
+                .reshape(self.micro_batch_size, self.dp_size).T.reshape(-1))
+        self._batch_offsets = (
+            np.arange(self.grad_acc, dtype=np.int64)[:, None] * self.rows_per_step
+            + perm[None, :]).reshape(-1)
 
     @staticmethod
     def _load_hf_stream(cfg: Config, tokenizer) -> np.ndarray:
@@ -108,29 +129,21 @@ class MicroBatchDataLoader:
     def __iter__(self) -> Iterator[dict]:
         return self
 
-    def _next_rows(self, n: int) -> np.ndarray:
-        """n consecutive global samples, wrapping epochs (data.py:118-137)."""
-        out = []
-        while n > 0:
-            take = min(n, len(self.samples) - self._cursor)
-            out.append(self.samples[self._cursor : self._cursor + take])
-            self._cursor += take
-            n -= take
-            if self._cursor == len(self.samples):
-                self._cursor = 0
-                self._epoch += 1
-        return np.concatenate(out, 0)
-
     def __next__(self) -> dict:
+        """One global batch of consecutive samples, wrapping epochs
+        (reference data.py:118-137), assembled by the native gather kernel
+        when available (numpy fallback is bitwise identical)."""
         M, R = self.grad_acc, self.rows_per_step
-        rows = self._next_rows(M * R)
-        # DistributedSampler(shuffle=False) hands sample i to dp rank i % dp
-        # (data.py:40-45); row-major [dp, mbs] layout after this gather puts
-        # each rank's rows contiguous for the 'dp' sharding.
-        rows = rows.reshape(M, R, self.seq_length + 1)
-        idx = np.arange(R).reshape(self.micro_batch_size, self.dp_size).T.reshape(-1)
-        rows = rows[:, idx]
-        return {
-            "input_ids": np.ascontiguousarray(rows[:, :, :-1]),
-            "target_ids": np.ascontiguousarray(rows[:, :, 1:]),
-        }
+        n = len(self.samples)
+        abs_idx = (self._cursor + self._batch_offsets) % n
+        wraps, self._cursor = divmod(self._cursor + M * R, n)
+        self._epoch += wraps
+        if native.available():
+            inp, tgt = native.gather_batch(self.samples, abs_idx)
+        else:
+            rows = self.samples[abs_idx]
+            inp = np.ascontiguousarray(rows[:, :-1])
+            tgt = np.ascontiguousarray(rows[:, 1:])
+        shape = (M, R, self.seq_length)
+        return {"input_ids": inp.reshape(shape),
+                "target_ids": tgt.reshape(shape)}

@@ -1,0 +1,49 @@
+// Native data-loader kernels for picotron_tpu.
+//
+// The reference framework's performance-critical pieces are all native code
+// (SURVEY.md §2.2): CUDA flash-attn, Triton RMSNorm, NCCL, and — on the data
+// side — HF's Rust tokenizers. The TPU rebuild keeps that split: device math
+// lives in Pallas/XLA, and the host-side data hot loops live here, compiled
+// with g++ and bound via ctypes (picotron_tpu/native/__init__.py). Each entry
+// point has a bitwise-identical numpy fallback in picotron_tpu/data.py; tests
+// (tests/test_native.py) assert exact equality between the two paths.
+//
+// Build: `make native` at the repo root, or automatically at first import.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Sequential affine bigram chain: toks[i] = jumps[i] ? jump_vals[i]
+//                                          : (a * toks[i-1] + b) % vocab.
+// The random draws (jumps mask, jump values, a, b, toks[0]) are produced by
+// numpy's PCG64 on the Python side so native and fallback paths are bitwise
+// identical; only the loop-carried recurrence — the part Python can't
+// vectorize — runs here.
+void affine_chain(int32_t* toks, const uint8_t* jumps,
+                  const int64_t* jump_vals, int64_t length,
+                  int64_t a, int64_t b, int64_t vocab) {
+  int64_t prev = toks[0];
+  for (int64_t i = 1; i < length; ++i) {
+    prev = jumps[i] ? jump_vals[i] : (a * prev + b) % vocab;
+    toks[i] = static_cast<int32_t>(prev);
+  }
+}
+
+// Assemble one global batch: for each output row r, copy the shifted
+// input/target views of packed sample `indices[r]` (length `chunk`,
+// yielding chunk-1 tokens each) into contiguous [n_rows, chunk-1] buffers.
+// Replaces a reshape + fancy-index + two ascontiguousarray copies per step.
+void gather_batch(const int32_t* samples, int64_t chunk,
+                  const int64_t* indices, int64_t n_rows,
+                  int32_t* input_ids, int32_t* target_ids) {
+  const int64_t out_w = chunk - 1;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const int32_t* src = samples + indices[r] * chunk;
+    std::memcpy(input_ids + r * out_w, src, out_w * sizeof(int32_t));
+    std::memcpy(target_ids + r * out_w, src + 1, out_w * sizeof(int32_t));
+  }
+}
+
+}  // extern "C"

@@ -46,9 +46,11 @@ KNOWN_MODEL_SHAPES = {
 for _base in list(KNOWN_MODEL_SHAPES):
     KNOWN_MODEL_SHAPES[_base + "-Instruct"] = KNOWN_MODEL_SHAPES[_base]
 
+# Canonical templates ship inside the package (picotron_tpu/templates/) so
+# pip-installed entry points work; the repo-root template/ dir symlinks here.
 TEMPLATE_PATH = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "template", "base_config.json")
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "templates", "base_config.json")
 
 
 # Shape fields a config must resolve one way or another; everything else

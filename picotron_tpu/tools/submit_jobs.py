@@ -44,27 +44,30 @@ class Status(enum.Enum):
 
 
 # Log patterns -> terminal status (TPU re-expression of the grep table in
-# reference base_job.slurm:82-94).
+# reference base_job.slurm:82-94). Only patterns that are definitive on a
+# *failed* run belong here — benign allocator/retry lines ("Attempting to
+# reserve", "Timed out waiting ... retrying") appear on healthy runs too.
 OOM_PATTERNS = (
     "RESOURCE_EXHAUSTED",
     "Out of memory",
     "out of memory",
     "OOM when allocating",
-    "Attempting to reserve",  # XLA allocator exhaustion preamble
 )
 TIMEOUT_PATTERNS = (
     "DEADLINE_EXCEEDED",
     "DUE TO TIME LIMIT",
     "collective operation timed out",
-    "Timed out waiting",
 )
 
 
 def classify_log(log_text: str, exit_code: Optional[int]) -> Status:
-    # Exit code wins: XLA prints allocator/retry lines ("Attempting to
-    # reserve", "Timed out waiting ... retrying") on runs that then succeed.
+    # Exit code wins: warning substrings on a successful run are benign.
     if exit_code == 0:
         return Status.COMPLETED
+    # exit_code None = the launcher killed the job at its wall-clock limit;
+    # that is a timeout regardless of what the log accumulated.
+    if exit_code is None:
+        return Status.TIMEOUT
     for pat in OOM_PATTERNS:
         if pat in log_text:
             return Status.OOM
@@ -118,8 +121,8 @@ class Scheduler:
         self.backend = backend
         self.qos = qos
         self.template_path = template_path or os.path.join(
-            os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))), "template", "base_job.slurm")
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "templates", "base_job.slurm")
         self.jobs = self._discover()
 
     def _discover(self) -> list[Job]:
@@ -190,8 +193,14 @@ class Scheduler:
         if dependency:
             cmd.append(f"--dependency=afterany:{dependency}")
         cmd.append(script)
-        out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+        # PENDING before sbatch: the job script writes "running" at startup,
+        # and writing after submission could overwrite that on a fast start.
         job.set_status(Status.PENDING)
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+        except subprocess.SubprocessError:
+            job.set_status(Status.INIT)
+            raise
         job_id = out.stdout.strip().split()[-1]
         return job_id
 

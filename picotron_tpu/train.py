@@ -37,6 +37,23 @@ def _ensure_devices(cfg) -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+def _maybe_init_distributed() -> None:
+    """Join a multi-host mesh when launched by the pod/slurm template
+    (template/base_job.slurm exports these; the analogue of torchrun's
+    RANK/WORLD_SIZE rendezvous, reference train.py:83-94). One JAX process
+    per host; after initialize(), jax.devices() spans every host's chips."""
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]),
+    )
+
+
 def _wandb_init(cfg):
     """Run name convention from the reference: {name}_{tokens-per-step}_
     {topology} (train.py:132-143)."""
@@ -170,6 +187,7 @@ def main(argv=None):
 
     cfg = Config.from_dict(raw)
     _ensure_devices(cfg)
+    _maybe_init_distributed()
     step, tokens, loss = train(cfg, max_steps_override=args.max_steps)
     print(f"done: {step} steps, {tokens} tokens, final loss {loss:.4f}")
     return 0
