@@ -50,7 +50,13 @@ class ModelConfig:
     use_pallas_rmsnorm: Optional[bool] = None  # None = auto (TPU only)
     # gather logits over tp before the loss (reference tensor_parallel.py:48-50
     # gather_output=True); False = vocab-parallel cross-entropy (faster).
+    # Only consulted by eval-time forward_logits; the training loss path is
+    # picked by loss_impl.
     gather_logits: bool = True
+    # training loss: "auto" (= fused), "fused" (row-chunked linear+CE, never
+    # materializes fp32 logits), "gathered" (reference-parity
+    # all-gather + plain CE), "vocab_parallel" (local logits, psum'd stats).
+    loss_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -72,7 +78,13 @@ class TrainingConfig:
     micro_batch_size: int = 1
     gradient_accumulation_steps: int = 1
     max_tokens: Optional[int] = None
-    # "full": remat every decoder layer (jax.checkpoint); "none": store all.
+    # Optimizer steps fused into one device dispatch (lax.scan over stacked
+    # batches). >1 removes per-step host latency; losses are still reported
+    # per step. Checkpoint/log boundaries snap to multiples of this.
+    steps_per_call: int = 1
+    # "full": remat every decoder layer (jax.checkpoint); "none": store all;
+    # "save_attn": remat layers but keep flash-attention out+LSE (the
+    # backward never re-runs the attention forward kernel).
     remat: str = "full"
     # dtype gradients accumulate in across microbatches: "float32" (the
     # reference's main_grad policy, data_parallel.py:66,81) or "param"
@@ -178,6 +190,14 @@ class Config:
         if m.attention_impl not in ("auto", "sdpa", "flash"):
             raise ValueError(
                 f"unknown attention_impl {m.attention_impl!r} (auto|sdpa|flash)")
+        if m.loss_impl not in ("auto", "fused", "gathered", "vocab_parallel"):
+            raise ValueError(
+                f"unknown loss_impl {m.loss_impl!r} "
+                "(auto|fused|gathered|vocab_parallel)")
+        if t.steps_per_call < 1:
+            raise ValueError("steps_per_call must be >= 1")
+        if t.remat not in ("none", "full", "save_attn"):
+            raise ValueError(f"unknown remat {t.remat!r} (none|full|save_attn)")
         if t.grad_accum_dtype not in ("float32", "param"):
             raise ValueError(
                 f"unknown grad_accum_dtype {t.grad_accum_dtype!r} (float32|param)")

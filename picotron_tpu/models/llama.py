@@ -40,6 +40,7 @@ from jax.sharding import PartitionSpec as P
 from picotron_tpu.config import Config, ModelConfig
 from picotron_tpu.ops.attention import sdpa
 from picotron_tpu.ops.cross_entropy import (
+    cross_entropy_fused,
     cross_entropy_gathered,
     cross_entropy_vocab_parallel,
 )
@@ -186,29 +187,60 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
 
 
 def layers_forward(stacked, h, cos, sin, cfg: Config):
-    """Scan over the locally-held layer stack (this stage's contiguous slice)."""
+    """Scan over the locally-held layer stack (this stage's contiguous slice).
+
+    remat modes (training.remat):
+    - "none": save every intermediate (XLA default) — fastest, most memory;
+    - "full": jax.checkpoint per layer — recompute the whole layer forward
+      during backward, save only layer-boundary activations;
+    - "save_attn": per-layer checkpoint with a policy that keeps the flash-
+      attention output + LSE (named inside the kernel's VJP,
+      ops/pallas/flash_attention.py) — the backward recomputes the cheap
+      norm/matmul chain but never re-runs the flash forward kernel, for
+      ~(S*H + S) extra bf16/fp32 floats per layer."""
 
     def body(h, lp):
         return decoder_layer(lp, h, cos, sin, cfg), None
 
-    if cfg.training.remat == "full":
+    remat = cfg.training.remat
+    if remat == "full":
         body = jax.checkpoint(body)
+    elif remat == "save_attn":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
     h, _ = lax.scan(body, h, stacked)
     return h
+
+
+def _head_input(params, h, cfg: Config):
+    """Final norm + tp copy — the shared prefix of logits and loss paths."""
+    return tp_copy(_norm(h, params["final_norm"], cfg))
 
 
 def head_logits(params, h, cfg: Config):
     """Final norm + untied LM head (the reference always creates a fresh
     untied head, checkpoint.py:88-91); logits stay vocab-sharded."""
-    x = _norm(h, params["final_norm"], cfg)
-    x = tp_copy(x)
-    return x @ params["lm_head"]
+    return _head_input(params, h, cfg) @ params["lm_head"]
 
 
-def _loss(logits_local, targets, m: ModelConfig):
-    if m.gather_logits:
-        return cross_entropy_gathered(logits_local, targets)
-    return cross_entropy_vocab_parallel(logits_local, targets)
+def loss_from_hidden(params, h, targets, cfg: Config):
+    """Final norm -> LM head -> mean CE, by the configured loss_impl:
+    - "fused" (default): row-chunked fused linear+CE — full fp32 logits are
+      never materialized (ops/cross_entropy.py:cross_entropy_fused);
+    - "gathered": reference-parity path — logits gathered over 'tp' then
+      plain CE (tensor_parallel.py:48-50, train.py:46-49);
+    - "vocab_parallel": materialized local logits, psum'd CE statistics."""
+    impl = cfg.model.loss_impl
+    if impl == "auto":
+        impl = "fused"
+    x = _head_input(params, h, cfg)
+    if impl == "fused":
+        return cross_entropy_fused(x, params["lm_head"], targets)
+    logits = x @ params["lm_head"]
+    if impl == "gathered":
+        return cross_entropy_gathered(logits, targets)
+    return cross_entropy_vocab_parallel(logits, targets)
 
 
 def rope_tables(cfg: Config):
@@ -242,8 +274,7 @@ def stage_apply(params, h_recv, tokens, targets, cos, sin, cfg: Config):
     s_local = tokens.shape[-1]
     cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local)
     h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)
-    logits = head_logits(params, h, cfg)
-    loss = _loss(logits, targets, cfg.model)
+    loss = loss_from_hidden(params, h, targets, cfg)
     return h, jnp.where(is_last, loss, 0.0)
 
 

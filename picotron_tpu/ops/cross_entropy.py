@@ -12,8 +12,12 @@ log-sum-exp and target logit with a pmax/psum pair over 'tp' instead
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 
 def cross_entropy_gathered(logits_local, targets, tp_axis: str = "tp"):
@@ -48,3 +52,120 @@ def cross_entropy_vocab_parallel(logits_local, targets, tp_axis: str = "tp"):
     picked = jnp.take_along_axis(logits32, safe_ids[..., None], axis=-1)[..., 0]
     target_logit = jax.lax.psum(jnp.where(in_range, picked, 0.0), tp_axis)
     return jnp.mean(logz - target_logit)
+
+
+# --------------------------------------------------------------------------- #
+# fused linear + cross-entropy (row-chunked, logits never fully materialized)
+# --------------------------------------------------------------------------- #
+
+
+def _chunk_logz(x_c, w, t_c, tp_axis):
+    """Per-chunk fp32 (logz [tc], target_logit [tc]); collectives over the
+    vocab-sharded axis as in cross_entropy_vocab_parallel."""
+    logits = (x_c @ w).astype(jnp.float32)  # [tc, Vl]
+    v_local = logits.shape[-1]
+    vocab_start = lax.axis_index(tp_axis) * v_local
+    local_max = jnp.max(logits, axis=-1)
+    global_max = lax.pmax(lax.stop_gradient(local_max), tp_axis)
+    sumexp = jnp.sum(jnp.exp(logits - global_max[:, None]), axis=-1)
+    logz = global_max + jnp.log(lax.psum(sumexp, tp_axis))
+    local_ids = t_c - vocab_start
+    in_range = (local_ids >= 0) & (local_ids < v_local)
+    safe_ids = jnp.clip(local_ids, 0, v_local - 1)
+    picked = jnp.take_along_axis(logits, safe_ids[:, None], axis=-1)[:, 0]
+    target_logit = lax.psum(jnp.where(in_range, picked, 0.0), tp_axis)
+    return logz, target_logit
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def cross_entropy_fused(x, w, targets, tp_axis: str = "tp",
+                        chunk_rows: int = 1024):
+    """Mean CE of ``x @ w`` without materializing the [T, V] logits.
+
+    x: [B, S, H] (already tp-copied), w: [H, V/tp] vocab-sharded LM head,
+    targets: [B, S] global ids. Rows are processed in chunks of
+    ``chunk_rows``; the backward recomputes each chunk's logits (one extra
+    head matmul, ~2·T·H·V FLOPs — a few % of a training step) instead of
+    keeping fp32 logits + softmax + dlogits alive, which at Llama vocab
+    sizes is multiple GB of HBM. The TPU analogue of fused CE losses used
+    on GPU (the reference just calls F.cross_entropy on gathered logits,
+    train.py:46-49 — same value, very different memory).
+
+    Gradient note: the returned dx is this shard's partial (local vocab
+    columns only); the surrounding ``tp_copy``'s backward psum completes it,
+    exactly as for a column-parallel linear."""
+    loss, _ = _fused_fwd_impl(x, w, targets, tp_axis, chunk_rows)
+    return loss
+
+
+def _chunks(x2, t, chunk_rows):
+    """Split rows into ceil(T/chunk) chunks, zero-padding the tail; the
+    returned fp32 mask marks real rows (padding must contribute neither loss
+    nor gradient). Without padding a non-divisible T would silently fall back
+    to one full-size chunk — the exact fp32-logits blowup this path avoids."""
+    T = x2.shape[0]
+    tc = min(chunk_rows, T)
+    n = -(-T // tc)
+    pad = n * tc - T
+    if pad:
+        x2 = jnp.concatenate([x2, jnp.zeros((pad, x2.shape[1]), x2.dtype)])
+        t = jnp.concatenate([t, jnp.zeros((pad,), t.dtype)])
+    mask = (jnp.arange(n * tc) < T).astype(jnp.float32)
+    return x2.reshape(n, tc, -1), t.reshape(n, tc), mask.reshape(n, tc), n
+
+
+def _fused_fwd_impl(x, w, targets, tp_axis, chunk_rows):
+    H = x.shape[-1]
+    x2, t = x.reshape(-1, H), targets.reshape(-1)
+    T = x2.shape[0]
+    xc, tc, mc, _ = _chunks(x2, t, chunk_rows)
+
+    def body(acc, inp):
+        x_c, t_c, m_c = inp
+        logz, tl = _chunk_logz(x_c, w, t_c, tp_axis)
+        return acc + jnp.sum((logz - tl) * m_c), logz
+
+    total, logz_all = lax.scan(body, jnp.zeros((), jnp.float32), (xc, tc, mc))
+    return total / T, logz_all.reshape(-1)
+
+
+def _fused_fwd(x, w, targets, tp_axis, chunk_rows):
+    loss, logz = _fused_fwd_impl(x, w, targets, tp_axis, chunk_rows)
+    return loss, (x, w, targets, logz)
+
+
+def _fused_bwd(tp_axis, chunk_rows, res, g):
+    x, w, targets, logz = res
+    H = x.shape[-1]
+    x2, t = x.reshape(-1, H), targets.reshape(-1)
+    T = x2.shape[0]
+    xc, tc, mc, n = _chunks(x2, t, chunk_rows)
+    lzc = logz.reshape(n, -1)
+    v_local = w.shape[-1]
+    scale = (g / T).astype(jnp.float32)
+
+    def body(dw_acc, inp):
+        x_c, t_c, m_c, logz_c = inp
+        logits = (x_c @ w).astype(jnp.float32)
+        p = jnp.exp(logits - logz_c[:, None])
+        vocab_start = lax.axis_index(tp_axis) * v_local
+        local_ids = t_c - vocab_start
+        in_range = (local_ids >= 0) & (local_ids < v_local)
+        onehot = (jax.nn.one_hot(jnp.clip(local_ids, 0, v_local - 1),
+                                 v_local, dtype=jnp.float32)
+                  * in_range[:, None].astype(jnp.float32))
+        dlog = ((p - onehot) * (scale * m_c)[:, None]).astype(w.dtype)
+        dx_c = lax.dot_general(dlog, w, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        dw_acc = dw_acc + lax.dot_general(
+            x_c, dlog, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dw_acc, dx_c.astype(x.dtype)
+
+    dw, dxc = lax.scan(body, jnp.zeros(w.shape, jnp.float32), (xc, tc, mc, lzc))
+    dx = dxc.reshape(-1, H)[:T].reshape(x.shape)
+    dt = np.zeros(targets.shape, jax.dtypes.float0)
+    return dx, dw.astype(w.dtype), dt
+
+
+cross_entropy_fused.defvjp(_fused_fwd, _fused_bwd)

@@ -90,7 +90,9 @@ def train(cfg, max_steps_override: Optional[int] = None):
     params, opt_state = ts.init_state(cfg, topo)
     if c.hf_bootstrap_path:
         params = ckpt_mod.load_hf_safetensors(c.hf_bootstrap_path, m, topo)
-    step_fn = ts.build_train_step(cfg, topo)
+    spc = t.steps_per_call
+    step_fn = ts.build_train_step(cfg, topo, multi_step=spc)
+    step_fn_single = step_fn if spc == 1 else None  # lazily built for the tail
 
     manager = None
     if c.save_frequency > 0 or c.load_path:
@@ -119,52 +121,82 @@ def train(cfg, max_steps_override: Optional[int] = None):
           f"setup {time.perf_counter() - t0_setup:.1f}s")
 
     loss = float("nan")
+    last_saved_step = step
+    profiling = False
     while step < max_steps and (t.max_tokens is None or trained_tokens < t.max_tokens):
-        if lg.profile_start and step == lg.profile_start:
+        # Profiler window snaps to dispatch boundaries (a dispatch is spc
+        # steps): start/stop when the loop-top step crosses the marks.
+        if lg.profile_start and not profiling and step >= lg.profile_start:
             jax.profiler.start_trace(lg.profile_dir)
-        t_start = time.perf_counter()
-        tokens, targets = ts.shard_batch(next(loader), topo)
-        params, opt_state, loss_arr = step_fn(params, opt_state, tokens, targets)
-        loss = float(jax.block_until_ready(loss_arr))
-        dt_step = time.perf_counter() - t_start
-
-        step += 1
-        trained_tokens += cfg.tokens_per_step
-        if lg.profile_stop and step == lg.profile_stop:
+            profiling = True
+        if profiling and lg.profile_stop and step >= lg.profile_stop:
             jax.profiler.stop_trace()
+            profiling = False
+        t_start = time.perf_counter()
+        step_before = step
+        # spc optimizer steps per device dispatch; a tail shorter than spc
+        # (by step count OR token budget) would trigger a recompile at a new
+        # stack shape — run those steps singly instead.
+        steps_left = max_steps - step
+        if t.max_tokens is not None:
+            tokens_left = t.max_tokens - trained_tokens
+            steps_left = min(steps_left, -(-tokens_left // cfg.tokens_per_step))
+        k = spc if steps_left >= spc else 1
+        if k > 1:
+            tokens, targets = ts.shard_batch_stack(
+                [next(loader) for _ in range(k)], topo)
+            params, opt_state, loss_arr = step_fn(params, opt_state, tokens, targets)
+            losses = [float(x) for x in jax.block_until_ready(loss_arr)]
+        else:
+            tokens, targets = ts.shard_batch(next(loader), topo)
+            if step_fn_single is None:
+                step_fn_single = ts.build_train_step(cfg, topo)
+            params, opt_state, loss_arr = step_fn_single(
+                params, opt_state, tokens, targets)
+            losses = [float(jax.block_until_ready(loss_arr))]
+        dt_call = time.perf_counter() - t_start
 
-        tok_s = cfg.tokens_per_step / dt_step
-        tok_s_chip = tok_s / n_chips
-        mfu = utils.get_mfu(tok_s_chip, n_params, m.num_hidden_layers,
-                            m.hidden_size, t.seq_length, peak)
-        mem = utils.device_memory_gb()
-        if step % lg.log_frequency == 0:
-            parts = [
-                f"Step: {step:<5d}",
-                f"Loss: {loss:6.4f}",
-                f"Global batch size: {utils.to_readable_format(cfg.tokens_per_step)}",
-                f"Tokens/s: {utils.to_readable_format(tok_s)}",
-                f"Tokens/s/chip: {utils.to_readable_format(tok_s_chip)}",
-                f"Tokens: {utils.to_readable_format(trained_tokens)}",
-            ]
-            if mfu is not None:
-                parts.append(f"MFU: {mfu:.2f}%")
-            if mem is not None:
-                parts.append(f"Memory usage: {mem:.2f}GB")
-            print(" | ".join(parts), flush=True)
-        if wandb is not None and step % lg.log_frequency == 0:
-            wandb.log({"loss": loss, "tokens_per_sec": tok_s,
-                       "tokens_per_sec_per_chip": tok_s_chip,
-                       "trained_tokens": trained_tokens,
-                       **({"mfu": mfu} if mfu is not None else {}),
-                       **({"memory_gb": mem} if mem is not None else {})},
-                      step=step)
+        for i, loss in enumerate(losses):
+            step += 1
+            trained_tokens += cfg.tokens_per_step
+            tok_s = k * cfg.tokens_per_step / dt_call
+            tok_s_chip = tok_s / n_chips
+            mfu = utils.get_mfu(tok_s_chip, n_params, m.num_hidden_layers,
+                                m.hidden_size, t.seq_length, peak)
+            mem = utils.device_memory_gb()
+            if step % lg.log_frequency == 0:
+                parts = [
+                    f"Step: {step:<5d}",
+                    f"Loss: {loss:6.4f}",
+                    f"Global batch size: {utils.to_readable_format(cfg.tokens_per_step)}",
+                    f"Tokens/s: {utils.to_readable_format(tok_s)}",
+                    f"Tokens/s/chip: {utils.to_readable_format(tok_s_chip)}",
+                    f"Tokens: {utils.to_readable_format(trained_tokens)}",
+                ]
+                if mfu is not None:
+                    parts.append(f"MFU: {mfu:.2f}%")
+                if mem is not None:
+                    parts.append(f"Memory usage: {mem:.2f}GB")
+                print(" | ".join(parts), flush=True)
+            if wandb is not None and step % lg.log_frequency == 0:
+                wandb.log({"loss": loss, "tokens_per_sec": tok_s,
+                           "tokens_per_sec_per_chip": tok_s_chip,
+                           "trained_tokens": trained_tokens,
+                           **({"mfu": mfu} if mfu is not None else {}),
+                           **({"memory_gb": mem} if mem is not None else {})},
+                          step=step)
 
-        if manager is not None and c.save_frequency > 0 and step % c.save_frequency == 0:
+        # Save at group boundaries only: params here are the end-of-group
+        # state, so the recorded step must be the end-of-group step.
+        if (manager is not None and c.save_frequency > 0
+                and step // c.save_frequency > step_before // c.save_frequency):
             manager.save(step, params, opt_state, trained_tokens)
+            last_saved_step = step
 
+    if profiling:
+        jax.profiler.stop_trace()
     if manager is not None:
-        if c.save_frequency > 0 and step % c.save_frequency != 0:
+        if c.save_frequency > 0 and step != last_saved_step:
             manager.save(step, params, opt_state, trained_tokens)
         manager.close()
     if wandb is not None:

@@ -107,10 +107,12 @@ def init_state(cfg: Config, topo: Topology, seed: int | None = None):
     return params, opt_state
 
 
-def build_train_step(cfg: Config, topo: Topology):
+def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
     """Returns jitted (params, opt_state, tokens, targets) ->
     (params, opt_state, loss). tokens/targets are [M, mbs*dp, seq] int32,
-    sharded (None, 'dp', 'cp')."""
+    sharded (None, 'dp', 'cp'). With multi_step=K the returned function runs
+    K optimizer steps per call over stacked [K, M, mbs*dp, seq] batches
+    (shard with shard_batch_stack) and returns per-step losses [K]."""
     mesh = topo.mesh
     pp = cfg.distributed.pp_size
     engine = cfg.distributed.pp_engine
@@ -157,10 +159,38 @@ def build_train_step(cfg: Config, topo: Topology):
         out_specs=(pspecs, ospecs, P()),
         check_vma=False,
     )
-    return jax.jit(step, donate_argnums=(0, 1))
+    if multi_step == 1:
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # On-device training loop: scan `step` over `multi_step` stacked batches
+    # in ONE dispatch. Removes per-step host round-trips (launch latency +
+    # the loss fetch the reference pays every step, train.py:242), which on
+    # a remote/tunneled TPU is tens of ms per step. Returns per-step losses.
+    def multi(params, opt_state, tokens, targets):
+        def body(carry, batch):
+            p, o = carry
+            p, o, loss = step(p, o, batch[0], batch[1])
+            return (p, o), loss
+
+        (params, opt_state), losses = lax.scan(
+            body, (params, opt_state), (tokens, targets))
+        return params, opt_state, losses
+
+    return jax.jit(multi, donate_argnums=(0, 1))
 
 
 def shard_batch(batch, topo: Topology):
     """Place a host numpy batch onto the mesh with (None, 'dp', 'cp')."""
     sh = NamedSharding(topo.mesh, batch_pspec())
     return jax.device_put(batch["input_ids"], sh), jax.device_put(batch["target_ids"], sh)
+
+
+def shard_batch_stack(batches, topo: Topology):
+    """Stack K host batches to [K, M, mbs*dp, seq] sharded (None,None,'dp','cp')
+    for a multi_step train function."""
+    import numpy as np
+
+    sh = NamedSharding(topo.mesh, P(None, *batch_pspec()))
+    toks = np.stack([b["input_ids"] for b in batches])
+    tgts = np.stack([b["target_ids"] for b in batches])
+    return jax.device_put(toks, sh), jax.device_put(tgts, sh)

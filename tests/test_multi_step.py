@@ -1,0 +1,87 @@
+"""Multi-step (on-device lax.scan training loop) vs single-step equivalence:
+K fused steps must produce the same per-step losses and the same final state
+as K separate dispatches (picotron_tpu/train_step.py build_train_step)."""
+
+import jax
+import numpy as np
+
+from picotron_tpu import train_step as ts
+from picotron_tpu.data import MicroBatchDataLoader
+from picotron_tpu.topology import topology_from_config
+
+
+def test_multi_step_matches_single(cfg_factory):
+    cfg = cfg_factory(dp=2, seq=32, mbs=2)
+    topo = topology_from_config(cfg)
+    K, rounds = 3, 2
+
+    p1, o1 = ts.init_state(cfg, topo)
+    step1 = ts.build_train_step(cfg, topo)
+    loader = MicroBatchDataLoader(cfg)
+    losses1 = []
+    for _ in range(K * rounds):
+        tok, tgt = ts.shard_batch(next(loader), topo)
+        p1, o1, l = step1(p1, o1, tok, tgt)
+        losses1.append(float(l))
+
+    p2, o2 = ts.init_state(cfg, topo)
+    stepK = ts.build_train_step(cfg, topo, multi_step=K)
+    loader = MicroBatchDataLoader(cfg)
+    losses2 = []
+    for _ in range(rounds):
+        tok, tgt = ts.shard_batch_stack([next(loader) for _ in range(K)], topo)
+        p2, o2, ls = stepK(p2, o2, tok, tgt)
+        losses2.extend(float(x) for x in ls)
+
+    np.testing.assert_allclose(losses2, losses1, rtol=2e-5, atol=1e-6)
+    flat1 = jax.tree.leaves(p1)
+    flat2 = jax.tree.leaves(p2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-5, atol=1e-6)
+
+
+def test_train_max_tokens_caps_group(cfg_factory):
+    """A token budget smaller than one spc-group must not overtrain: the
+    trainer falls back to single steps near the budget."""
+    from picotron_tpu.train import train
+
+    cfg = cfg_factory(seq=32, mbs=2, total_train_steps=100)
+    cfg.training.steps_per_call = 4
+    cfg.training.max_tokens = 2 * cfg.tokens_per_step
+    step, tokens, _ = train(cfg)
+    assert step == 2
+    assert tokens == cfg.training.max_tokens
+
+
+def test_train_saves_once_with_steps_per_call(cfg_factory, tmp_path):
+    """steps_per_call=4 with save_frequency=5 and 8 steps: the boundary save
+    at step 8 must not be duplicated by the end-of-run save."""
+    from picotron_tpu.checkpoint import CheckpointManager
+    from picotron_tpu.train import train
+
+    cfg = cfg_factory(seq=32, mbs=2, total_train_steps=8)
+    cfg.training.steps_per_call = 4
+    cfg.checkpoint.save_dir = str(tmp_path / "ck")
+    cfg.checkpoint.save_frequency = 5
+    step, _, _ = train(cfg)
+    assert step == 8
+    mgr = CheckpointManager(cfg.checkpoint.save_dir)
+    assert mgr.latest_step() == 8
+    mgr.close()
+
+
+def test_train_cli_steps_per_call(cfg_factory, tmp_path, capsys):
+    """The trainer with steps_per_call=2 logs every step and trains to the
+    same token count; a non-multiple total exercises the single-step tail."""
+    from picotron_tpu.train import train
+
+    cfg = cfg_factory(seq=32, mbs=2, total_train_steps=5)
+    cfg.training.steps_per_call = 2
+    step, tokens, loss = train(cfg)
+    assert step == 5
+    assert tokens == 5 * cfg.tokens_per_step
+    out = capsys.readouterr().out
+    for s in range(1, 6):
+        assert f"Step: {s}" in out
+    assert np.isfinite(loss)
