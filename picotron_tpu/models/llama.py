@@ -136,11 +136,13 @@ def embed_lookup(w, tokens):
 
 def _attention(q, k, v, cfg: Config):
     scale = 1.0 / math.sqrt(cfg.model.head_dim)
-    if cfg.distributed.cp_size > 1:
-        return ring_attention(q, k, v, scale, "cp", cfg.distributed.cp_size, True)
     impl = cfg.model.attention_impl
     if impl == "auto":
         impl = "flash" if on_tpu() else "sdpa"
+    if cfg.distributed.cp_size > 1:
+        # ring with Pallas flash blocks on TPU, XLA einsum blocks elsewhere
+        return ring_attention(q, k, v, scale, "cp", cfg.distributed.cp_size,
+                              True, impl == "flash")
     if impl == "flash":
         from picotron_tpu.ops.pallas.flash_attention import flash_attention
 

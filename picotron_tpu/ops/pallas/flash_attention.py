@@ -294,6 +294,27 @@ def flash_attention(q, k, v, scale: float | None = None, causal: bool = True,
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
+def flash_block_grads(q, k, v, out, lse, dout, scale: float,
+                      causal: bool = True,
+                      block_q: int | None = None,
+                      block_k: int | None = None):
+    """Gradients of one attention block given an externally-merged (global)
+    out/lse — the ring-attention backward building block (the ring re-derives
+    each block's true share of the global softmax as exp(s - lse_global),
+    reference context_parallel.py:112-155). All of q/k/v/out/dout are
+    [B, S, H, D]; lse is [B, S, H] fp32. Returns (dq, dk, dv)."""
+    b, s, h, d = q.shape
+    block_q = block_q or DEFAULT_BLOCK_Q
+    block_k = block_k or DEFAULT_BLOCK_K
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    lse_c = lse.transpose(0, 2, 1).reshape(b * h, s)
+    dq, dk, dv = _bwd(scale, causal, block_q, block_k,
+                      (fold(q), fold(k), fold(v), fold(out), lse_c),
+                      fold(dout))
+    unfold = lambda x: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
 def flash_attention_with_lse(q, k, v, scale: float | None = None,
                              causal: bool = True,
                              block_q: int | None = None,

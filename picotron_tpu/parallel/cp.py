@@ -23,8 +23,12 @@ the full schedule, masking skipped blocks, so the wall-clock matches the
 reference's slowest (last) rank. Zigzag is the first post-parity optimization.
 
 Unlike the reference (pure-torch block math, TODO for flash at
-context_parallel.py:22-23), the inner block runs through ops.block_attention,
-which XLA fuses; a Pallas block kernel can be swapped in transparently.
+context_parallel.py:22-23), the inner block can run through the Pallas flash
+kernel (``use_flash=True``, the TPU path): per ring step a ``lax.switch``
+picks the causal-diagonal kernel, the unmasked kernel, or a skip — so
+skipped blocks genuinely cost nothing, and the [S_local, S_local] score
+matrix never exists in HBM. The XLA ``block_attention`` einsum path remains
+for CPU and as the numerics oracle.
 """
 
 from __future__ import annotations
@@ -51,15 +55,51 @@ def _block_mask(s_q: int, s_k: int, src, rank, causal: bool):
     return jnp.where(src < rank, full, jnp.where(src == rank, tri, none))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def ring_attention(q, k, v, scale: float, axis: str, axis_size: int, causal: bool):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def ring_attention(q, k, v, scale: float, axis: str, axis_size: int,
+                   causal: bool, use_flash: bool = False):
     """q, k, v: [B, S_local, H, D] (kv heads already GQA-repeated, as the
-    reference repeats before the ring, model.py:141-142). Returns [B,S,H,D]."""
-    out, _ = _ring_fwd_impl(q, k, v, scale, axis, axis_size, causal)
+    reference repeats before the ring, model.py:141-142). Returns [B,S,H,D].
+    use_flash selects the Pallas block kernel (TPU) over the XLA einsum."""
+    out, _ = _ring_fwd_impl(q, k, v, scale, axis, axis_size, causal, use_flash)
     return out
 
 
-def _ring_fwd_impl(q, k, v, scale, axis, n, causal):
+def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash):
+    """One ring block -> (out [B,S,H,D] fp32, lse [B,S,H] fp32), with skipped
+    blocks returning lse=-inf (identity under the merge)."""
+    b, s, h, d = q.shape
+    if not use_flash:
+        mask = _block_mask(s, s, src, rank, causal)
+        blk_out, blk_lse = block_attention(q, kt, vt, scale, mask)
+        if causal:
+            valid = src <= rank
+            blk_out = jnp.where(valid, blk_out, 0.0)
+            blk_lse = jnp.where(valid, blk_lse, NEG_INF)
+        return blk_out.astype(jnp.float32), blk_lse
+
+    from picotron_tpu.ops.pallas.flash_attention import flash_attention_with_lse
+
+    def full(_):
+        o, l = flash_attention_with_lse(q, kt, vt, scale, causal=False)
+        return o.astype(jnp.float32), l
+
+    def diag(_):
+        o, l = flash_attention_with_lse(q, kt, vt, scale, causal=True)
+        return o.astype(jnp.float32), l
+
+    def skip(_):
+        return (jnp.zeros((b, s, h, d), jnp.float32),
+                jnp.full((b, s, h), NEG_INF, jnp.float32))
+
+    if not causal:
+        return full(None)
+    # 0 = skip (src > rank), 1 = unmasked (src < rank), 2 = diagonal causal
+    idx = jnp.where(src == rank, 2, jnp.where(src < rank, 1, 0))
+    return lax.switch(idx, [skip, full, diag], None)
+
+
+def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash):
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     b, s, h, d = q.shape
@@ -70,17 +110,14 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal):
         kv, out, lse = carry
         kt, vt = kv
         src = (rank - t) % n
-        mask = _block_mask(s, s, src, rank, causal)
-        blk_out, blk_lse = block_attention(q, kt, vt, scale, mask)
+        blk_out, blk_lse = _block_fwd(q, kt, vt, scale, src, rank, causal,
+                                      use_flash)
         # LSE merge (reference context_parallel.py:170-171):
         #   out <- out - sigmoid(blk_lse - lse) * (out - blk_out)
         #   lse <- logaddexp(lse, blk_lse)
         w = jax.nn.sigmoid(blk_lse - lse)[..., None]
-        merged_out = out - w * (out - blk_out)
-        merged_lse = jnp.logaddexp(lse, blk_lse)
-        valid = jnp.logical_not(causal) | (src <= rank)
-        out = jnp.where(valid, merged_out, out)
-        lse = jnp.where(valid, merged_lse, lse)
+        out = out - w * (out - blk_out)
+        lse = jnp.logaddexp(lse, blk_lse)
         kv = lax.ppermute(kv, axis, perm)
         return (kv, out, lse), None
 
@@ -89,24 +126,66 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal):
     return out.astype(q.dtype), lse
 
 
-def _ring_fwd(q, k, v, scale, axis, n, causal):
-    out, lse = _ring_fwd_impl(q, k, v, scale, axis, n, causal)
+def _ring_fwd(q, k, v, scale, axis, n, causal, use_flash):
+    out, lse = _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash)
     return out, (q, k, v, out, lse)
 
 
-def _ring_bwd(scale, axis, n, causal, res, dout):
+def _block_bwd_einsum(q, kt, vt, dout, out_unused, lse, D, scale, src, rank,
+                      causal):
+    """One block's (dq, dk, dv) via XLA einsums; P re-derived from the final
+    LSE: exp(scores - lse) is each block's true share of the global softmax
+    (context_parallel.py:112-128)."""
+    s = q.shape[1]
+    mask = _block_mask(s, s, src, rank, causal)
+    q32 = q.astype(jnp.float32)
+    do32 = dout.astype(jnp.float32)
+    k32 = kt.astype(jnp.float32)
+    v32 = vt.astype(jnp.float32)
+    lse_t = lse.transpose(0, 2, 1)[..., None]  # [B, H, Sq, 1]
+    D_t = D.transpose(0, 2, 1)[..., None]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k32) * scale
+    p = jnp.where(mask[None, None], jnp.exp(scores - lse_t), 0.0)
+    dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v32)
+    ds = p * (dp - D_t) * scale
+    dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, k32)
+    dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q32)
+    return dq_blk, dk_blk, dv_blk
+
+
+def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal):
+    """One block's (dq, dk, dv) via the Pallas backward kernels fed the
+    globally-merged out/lse (skip branch costs nothing at runtime)."""
+    from picotron_tpu.ops.pallas.flash_attention import flash_block_grads
+
+    f32 = lambda t: tuple(x.astype(jnp.float32) for x in t)
+
+    def full(_):
+        return f32(flash_block_grads(q, kt, vt, out, lse, dout, scale, False))
+
+    def diag(_):
+        return f32(flash_block_grads(q, kt, vt, out, lse, dout, scale, True))
+
+    def skip(_):
+        z = jnp.zeros(q.shape, jnp.float32)
+        return z, z, z
+
+    if not causal:
+        return full(None)
+    idx = jnp.where(src == rank, 2, jnp.where(src < rank, 1, 0))
+    return lax.switch(idx, [skip, full, diag], None)
+
+
+def _ring_bwd(scale, axis, n, causal, use_flash, res, dout):
     q, k, v, out, lse = res
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     b, s, h, d = q.shape
 
-    q32 = q.astype(jnp.float32)
-    do32 = dout.astype(jnp.float32)
     # D_i = sum_j dO_ij * O_ij (softmax backward rowsum, the reference's manual
     # 6-step derivation, context_parallel.py:130-155)
-    D = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # [B, S, H]
-    D_t = D.transpose(0, 2, 1)[..., None]  # [B, H, Sq, 1]
-    lse_t = lse.transpose(0, 2, 1)[..., None]  # [B, H, Sq, 1]
+    D = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
     dq0 = jnp.zeros((b, s, h, d), jnp.float32)
     dkv0 = (jnp.zeros((b, s, h, d), jnp.float32), jnp.zeros((b, s, h, d), jnp.float32))
@@ -116,19 +195,12 @@ def _ring_bwd(scale, axis, n, causal, res, dout):
         kt, vt = kv
         dk_acc, dv_acc = dkv
         src = (rank - t) % n
-        mask = _block_mask(s, s, src, rank, causal)
-
-        k32 = kt.astype(jnp.float32)
-        v32 = vt.astype(jnp.float32)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k32) * scale
-        # P re-derived from the final LSE: exp(scores - lse) is each block's
-        # true share of the global softmax (context_parallel.py:112-128).
-        p = jnp.where(mask[None, None], jnp.exp(scores - lse_t), 0.0)
-        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v32)
-        ds = p * (dp - D_t) * scale
-        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, k32)
-        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q32)
+        if use_flash:
+            dq_blk, dk_blk, dv_blk = _block_bwd_flash(
+                q, kt, vt, dout, out, lse, scale, src, rank, causal)
+        else:
+            dq_blk, dk_blk, dv_blk = _block_bwd_einsum(
+                q, kt, vt, dout, out, lse, D, scale, src, rank, causal)
 
         dq = dq + dq_blk
         # accumulators travel the ring with their kv chunk and arrive home

@@ -122,16 +122,19 @@ def train(cfg, max_steps_override: Optional[int] = None):
 
     loss = float("nan")
     last_saved_step = step
-    profiling = False
+    profiling = profile_done = False
     while step < max_steps and (t.max_tokens is None or trained_tokens < t.max_tokens):
         # Profiler window snaps to dispatch boundaries (a dispatch is spc
-        # steps): start/stop when the loop-top step crosses the marks.
-        if lg.profile_start and not profiling and step >= lg.profile_start:
-            jax.profiler.start_trace(lg.profile_dir)
-            profiling = True
+        # steps): stop is checked before start so a window narrower than one
+        # dispatch still traces one full dispatch; the done latch makes the
+        # window fire exactly once.
         if profiling and lg.profile_stop and step >= lg.profile_stop:
             jax.profiler.stop_trace()
-            profiling = False
+            profiling, profile_done = False, True
+        if (lg.profile_start and not profiling and not profile_done
+                and step >= lg.profile_start):
+            jax.profiler.start_trace(lg.profile_dir)
+            profiling = True
         t_start = time.perf_counter()
         step_before = step
         # spc optimizer steps per device dispatch; a tail shorter than spc
@@ -156,14 +159,19 @@ def train(cfg, max_steps_override: Optional[int] = None):
             losses = [float(jax.block_until_ready(loss_arr))]
         dt_call = time.perf_counter() - t_start
 
+        # Throughput is per dispatch (identical for every step in the group);
+        # mfu/memory are computed lazily, once, and only if a step logs.
+        tok_s = k * cfg.tokens_per_step / dt_call
+        tok_s_chip = tok_s / n_chips
+        stats = None
         for i, loss in enumerate(losses):
             step += 1
             trained_tokens += cfg.tokens_per_step
-            tok_s = k * cfg.tokens_per_step / dt_call
-            tok_s_chip = tok_s / n_chips
-            mfu = utils.get_mfu(tok_s_chip, n_params, m.num_hidden_layers,
-                                m.hidden_size, t.seq_length, peak)
-            mem = utils.device_memory_gb()
+            if step % lg.log_frequency == 0 and stats is None:
+                stats = (utils.get_mfu(tok_s_chip, n_params, m.num_hidden_layers,
+                                       m.hidden_size, t.seq_length, peak),
+                         utils.device_memory_gb())
+            mfu, mem = stats if stats is not None else (None, None)
             if step % lg.log_frequency == 0:
                 parts = [
                     f"Step: {step:<5d}",
