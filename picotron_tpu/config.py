@@ -29,6 +29,11 @@ class DistributedConfig:
     dp_size: int = 1
     pp_engine: str = "1f1b"  # "afab" | "1f1b"   (reference train.py:223-229)
     use_cpu: bool = False  # run on host CPU devices (reference gloo path, train.py:83)
+    # Zigzag context-parallel layout: each cp rank owns sequence chunks
+    # (r, 2n-1-r), balancing causal ring-attention work across ranks. False =
+    # contiguous chunks, faithful to the reference (its zigzag TODO:
+    # tests/test_dataloader.py:136).
+    cp_zigzag: bool = False
 
 
 @dataclass
@@ -168,6 +173,10 @@ class Config:
         d, m, t = self.distributed, self.model, self.training
         if t.seq_length % d.cp_size != 0:
             raise ValueError(f"seq_length {t.seq_length} % cp_size {d.cp_size} != 0")
+        if d.cp_zigzag and t.seq_length % (2 * d.cp_size) != 0:
+            raise ValueError(
+                f"cp_zigzag needs seq_length % (2*cp_size) == 0, got "
+                f"{t.seq_length} % {2 * d.cp_size}")
         if m.num_attention_heads % d.tp_size != 0:
             raise ValueError(f"num_attention_heads {m.num_attention_heads} % tp_size {d.tp_size} != 0")
         if m.num_key_value_heads % d.tp_size != 0:

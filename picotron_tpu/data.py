@@ -96,6 +96,15 @@ class MicroBatchDataLoader:
         self._batch_offsets = (
             np.arange(self.grad_acc, dtype=np.int64)[:, None] * self.rows_per_step
             + perm[None, :]).reshape(-1)
+        # Zigzag CP: permute the sequence axis so that the contiguous 'cp'
+        # shard of the permuted sequence owns original chunks (r, 2n-1-r)
+        # (parallel/cp.py::zigzag_perm). Loss is a token mean, so the
+        # permutation is training-invariant.
+        self._seq_perm = None
+        if d.cp_zigzag and d.cp_size > 1:
+            from picotron_tpu.parallel.cp import zigzag_perm
+
+            self._seq_perm = zigzag_perm(t.seq_length, d.cp_size)
 
     @staticmethod
     def _load_hf_stream(cfg: Config, tokenizer) -> np.ndarray:
@@ -145,5 +154,8 @@ class MicroBatchDataLoader:
             inp = np.ascontiguousarray(rows[:, :-1])
             tgt = np.ascontiguousarray(rows[:, 1:])
         shape = (M, R, self.seq_length)
-        return {"input_ids": inp.reshape(shape),
-                "target_ids": tgt.reshape(shape)}
+        inp, tgt = inp.reshape(shape), tgt.reshape(shape)
+        if self._seq_perm is not None:
+            inp = np.ascontiguousarray(inp[:, :, self._seq_perm])
+            tgt = np.ascontiguousarray(tgt[:, :, self._seq_perm])
+        return {"input_ids": inp, "target_ids": tgt}

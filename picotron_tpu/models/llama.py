@@ -142,7 +142,8 @@ def _attention(q, k, v, cfg: Config):
     if cfg.distributed.cp_size > 1:
         # ring with Pallas flash blocks on TPU, XLA einsum blocks elsewhere
         return ring_attention(q, k, v, scale, "cp", cfg.distributed.cp_size,
-                              True, impl == "flash")
+                              True, impl == "flash",
+                              cfg.distributed.cp_zigzag)
     if impl == "flash":
         from picotron_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -252,10 +253,24 @@ def rope_tables(cfg: Config):
         jnp.dtype(cfg.model.dtype))
 
 
-def slice_rope_for_cp(cos, sin, s_local):
-    """Each cp rank's chunk of the angle tables (reference model.py:201,
-    context_parallel.py:189-195)."""
-    start = lax.axis_index("cp") * s_local
+def slice_rope_for_cp(cos, sin, s_local, cfg: Config):
+    """Each cp rank's rows of the angle tables, matching its token positions
+    (reference model.py:201, context_parallel.py:189-195). Zigzag ranks own
+    two non-adjacent chunks -> two dynamic slices."""
+    rank = lax.axis_index("cp")
+    if cfg.distributed.cp_zigzag and cfg.distributed.cp_size > 1:
+        n = cfg.distributed.cp_size
+        h = s_local // 2
+        early = rank * h
+        late = (2 * n - 1 - rank) * h
+
+        def take(t):
+            return jnp.concatenate(
+                [lax.dynamic_slice_in_dim(t, early, h, 0),
+                 lax.dynamic_slice_in_dim(t, late, h, 0)], axis=0)
+
+        return take(cos), take(sin)
+    start = rank * s_local
     return (lax.dynamic_slice_in_dim(cos, start, s_local, 0),
             lax.dynamic_slice_in_dim(sin, start, s_local, 0))
 
@@ -274,7 +289,7 @@ def stage_apply(params, h_recv, tokens, targets, cos, sin, cfg: Config):
     emb = embed_lookup(params["embed"], tokens).astype(dt)
     h = jnp.where(is_first, emb, h_recv)
     s_local = tokens.shape[-1]
-    cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local)
+    cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
     h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)
     loss = loss_from_hidden(params, h, targets, cfg)
     return h, jnp.where(is_last, loss, 0.0)
@@ -287,7 +302,7 @@ def forward_logits(params, tokens, cfg: Config, gather: bool = True):
     dt = jnp.dtype(cfg.model.dtype)
     h = embed_lookup(params["embed"], tokens).astype(dt)
     s_local = tokens.shape[-1]
-    cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local)
+    cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
     h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)
     logits = head_logits(params, h, cfg)
     return tp_gather(logits) if gather else logits

@@ -103,27 +103,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k):
-    """Returns (out [BH,S,D], lse [BH,S,LANE] broadcast layout, fp32)."""
-    bh, s, d = q.shape
-    bq = _pick_block(s, block_q)
-    bk = _pick_block(s, block_k)
-    grid = (bh, s // bq)
+    """Returns (out [BH,Sq,D], lse [BH,Sq,LANE] broadcast layout, fp32).
+    Sq and Sk may differ (ring-attention half blocks); causal requires
+    Sq == Sk (aligned positions)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    assert not causal or sq == sk
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    grid = (bh, sq // bq)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_q=bq, block_k=bk,
                           causal=causal),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, LANE), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, LANE), jnp.float32),
         ],
     )(q, k, v)
     return out, lse
@@ -207,49 +211,50 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 def _bwd(scale, causal, block_q, block_k, res, dout):
     q, k, v, out, lse_c = res
-    bh, s, d = q.shape
-    # Residuals carry the compact [BH, S] LSE (the broadcast LANE layout is
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    # Residuals carry the compact [BH, Sq] LSE (the broadcast LANE layout is
     # 128x larger, which matters when a remat policy saves it); re-broadcast
     # to the Mosaic-tileable layout here, transiently.
-    lse = jnp.broadcast_to(lse_c[:, :, None], (bh, s, LANE))
-    bq = _pick_block(s, block_q)
-    bk = _pick_block(s, block_k)
+    lse = jnp.broadcast_to(lse_c[:, :, None], (bh, sq, LANE))
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk,
                           causal=causal),
-        grid=(bh, s // bq),
+        grid=(bh, sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, LANE), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
     )(q, k, v, out, dout, lse)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq,
                           block_k=bk, causal=causal),
-        grid=(bh, s // bk),
+        grid=(bh, sk // bk),
         in_specs=[
-            pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, s, LANE), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, LANE), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
     )(q, k, v, out, dout, lse)
     return dq, dk, dv
@@ -301,17 +306,18 @@ def flash_block_grads(q, k, v, out, lse, dout, scale: float,
     """Gradients of one attention block given an externally-merged (global)
     out/lse — the ring-attention backward building block (the ring re-derives
     each block's true share of the global softmax as exp(s - lse_global),
-    reference context_parallel.py:112-155). All of q/k/v/out/dout are
-    [B, S, H, D]; lse is [B, S, H] fp32. Returns (dq, dk, dv)."""
-    b, s, h, d = q.shape
+    reference context_parallel.py:112-155). q/out/dout are [B, Sq, H, D],
+    k/v are [B, Sk, H, D] (Sq != Sk allowed for ring half-blocks, non-causal
+    only); lse is [B, Sq, H] fp32. Returns (dq, dk, dv)."""
+    b, sq, h, d = q.shape
     block_q = block_q or DEFAULT_BLOCK_Q
     block_k = block_k or DEFAULT_BLOCK_K
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    lse_c = lse.transpose(0, 2, 1).reshape(b * h, s)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    lse_c = lse.transpose(0, 2, 1).reshape(b * h, sq)
     dq, dk, dv = _bwd(scale, causal, block_q, block_k,
                       (fold(q), fold(k), fold(v), fold(out), lse_c),
                       fold(dout))
-    unfold = lambda x: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    unfold = lambda x: x.reshape(b, h, x.shape[1], d).transpose(0, 2, 1, 3)
     return unfold(dq), unfold(dk), unfold(dv)
 
 
@@ -319,14 +325,15 @@ def flash_attention_with_lse(q, k, v, scale: float | None = None,
                              causal: bool = True,
                              block_q: int | None = None,
                              block_k: int | None = None):
-    """Forward-only variant returning (out [B,S,H,D], lse [B,S,H]) — the
-    building block for ring attention's LSE merge."""
+    """Forward-only variant returning (out [B,Sq,H,D], lse [B,Sq,H]) — the
+    building block for ring attention's LSE merge. Sq != Sk allowed
+    (non-causal only)."""
     b, s, h, d = q.shape
     block_q = block_q or DEFAULT_BLOCK_Q
     block_k = block_k or DEFAULT_BLOCK_K
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     out, lse = _fwd(fold(q), fold(k), fold(v), float(scale), causal,
                     block_q, block_k)
     return (out.reshape(b, h, s, d).transpose(0, 2, 1, 3),

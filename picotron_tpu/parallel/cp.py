@@ -43,39 +43,70 @@ from picotron_tpu.ops.attention import NEG_INF, block_attention
 from picotron_tpu.utils import collective_scan_unroll
 
 
-def _block_mask(s_q: int, s_k: int, src, rank, causal: bool):
-    """True = attend. src/rank are traced cp indices; contiguous chunking means
-    src < rank -> keys strictly before queries (attend all), src == rank ->
-    diagonal causal block, src > rank -> keys after queries (skip)."""
+def chunk_positions(idx, s_local: int, n: int, zigzag: bool):
+    """Global token positions held by cp index ``idx`` (traced ok).
+    Contiguous: [idx*S_l, (idx+1)*S_l). Zigzag: the sequence is cut into 2n
+    chunks and rank r owns chunks (r, 2n-1-r) — the standard load-balanced
+    layout for causal ring attention (the reference acknowledges the
+    contiguous imbalance at tests/test_dataloader.py:136 and leaves zigzag
+    as a TODO)."""
+    if not zigzag:
+        return idx * s_local + jnp.arange(s_local)
+    h = s_local // 2
+    return jnp.concatenate([idx * h + jnp.arange(h),
+                            (2 * n - 1 - idx) * h + jnp.arange(h)])
+
+
+def zigzag_perm(seq_length: int, n: int) -> "np.ndarray":
+    """Host-side permutation: position j of the permuted sequence holds
+    original token perm[j]; contiguous shard r of the permuted sequence then
+    owns exactly chunks (r, 2n-1-r) of the original."""
+    import numpy as np
+
+    h = seq_length // (2 * n)
+    order = []
+    for r in range(n):
+        order.extend(range(r * h, (r + 1) * h))
+        order.extend(range((2 * n - 1 - r) * h, (2 * n - r) * h))
+    return np.asarray(order, dtype=np.int64)
+
+
+def _block_mask(s_q: int, s_k: int, src, rank, causal: bool, n: int,
+                zigzag: bool):
+    """True = attend: global position of query >= global position of key.
+    For contiguous chunking this reduces to the reference's 3-way rule
+    (src < rank full, src == rank diagonal, src > rank skip,
+    context_parallel.py:36)."""
     if not causal:
         return jnp.ones((s_q, s_k), dtype=bool)
-    tri = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
-    full = jnp.ones_like(tri)
-    none = jnp.zeros_like(tri)
-    return jnp.where(src < rank, full, jnp.where(src == rank, tri, none))
+    qpos = chunk_positions(rank, s_q, n, zigzag)
+    kpos = chunk_positions(src, s_k, n, zigzag)
+    return qpos[:, None] >= kpos[None, :]
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def ring_attention(q, k, v, scale: float, axis: str, axis_size: int,
-                   causal: bool, use_flash: bool = False):
+                   causal: bool, use_flash: bool = False,
+                   zigzag: bool = False):
     """q, k, v: [B, S_local, H, D] (kv heads already GQA-repeated, as the
     reference repeats before the ring, model.py:141-142). Returns [B,S,H,D].
-    use_flash selects the Pallas block kernel (TPU) over the XLA einsum."""
-    out, _ = _ring_fwd_impl(q, k, v, scale, axis, axis_size, causal, use_flash)
+    use_flash selects the Pallas block kernel (TPU) over the XLA einsum;
+    zigzag expects the zigzag_perm() sequence layout and balances causal
+    work across ranks."""
+    out, _ = _ring_fwd_impl(q, k, v, scale, axis, axis_size, causal,
+                            use_flash, zigzag)
     return out
 
 
-def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash):
+def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag):
     """One ring block -> (out [B,S,H,D] fp32, lse [B,S,H] fp32), with skipped
-    blocks returning lse=-inf (identity under the merge)."""
+    (sub-)blocks returning lse=-inf rows (identity under the merge)."""
     b, s, h, d = q.shape
     if not use_flash:
-        mask = _block_mask(s, s, src, rank, causal)
+        mask = _block_mask(s, s, src, rank, causal, n, zigzag)
         blk_out, blk_lse = block_attention(q, kt, vt, scale, mask)
-        if causal:
-            valid = src <= rank
-            blk_out = jnp.where(valid, blk_out, 0.0)
-            blk_lse = jnp.where(valid, blk_lse, NEG_INF)
+        # fully-masked rows carry lse ~ NEG_INF + log(s): tiny enough that
+        # the sigmoid merge weight is exactly 0 against any real lse
         return blk_out.astype(jnp.float32), blk_lse
 
     from picotron_tpu.ops.pallas.flash_attention import flash_attention_with_lse
@@ -85,6 +116,8 @@ def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash):
         return o.astype(jnp.float32), l
 
     def diag(_):
+        # zigzag local pair (r, 2n-1-r) is position-monotonic, so the
+        # diagonal step is a plain causal block in both layouts
         o, l = flash_attention_with_lse(q, kt, vt, scale, causal=True)
         return o.astype(jnp.float32), l
 
@@ -92,14 +125,34 @@ def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash):
         return (jnp.zeros((b, s, h, d), jnp.float32),
                 jnp.full((b, s, h), NEG_INF, jnp.float32))
 
+    def early(_):
+        # zigzag, src < rank: every query sees only the source's early half
+        o, l = flash_attention_with_lse(q, kt[:, : s // 2], vt[:, : s // 2],
+                                        scale, causal=False)
+        return o.astype(jnp.float32), l
+
+    def late(_):
+        # zigzag, src > rank: only this rank's late half sees the source
+        # (its whole chunk pair); early-half rows merge as identity
+        o, l = flash_attention_with_lse(q[:, s // 2:], kt, vt, scale,
+                                        causal=False)
+        return (jnp.concatenate(
+                    [jnp.zeros((b, s // 2, h, d), jnp.float32),
+                     o.astype(jnp.float32)], axis=1),
+                jnp.concatenate(
+                    [jnp.full((b, s // 2, h), NEG_INF, jnp.float32), l],
+                    axis=1))
+
     if not causal:
         return full(None)
-    # 0 = skip (src > rank), 1 = unmasked (src < rank), 2 = diagonal causal
+    # 0 = src > rank, 1 = src < rank, 2 = diagonal
     idx = jnp.where(src == rank, 2, jnp.where(src < rank, 1, 0))
+    if zigzag:
+        return lax.switch(idx, [late, early, diag], None)
     return lax.switch(idx, [skip, full, diag], None)
 
 
-def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash):
+def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag):
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     b, s, h, d = q.shape
@@ -111,7 +164,7 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash):
         kt, vt = kv
         src = (rank - t) % n
         blk_out, blk_lse = _block_fwd(q, kt, vt, scale, src, rank, causal,
-                                      use_flash)
+                                      use_flash, n, zigzag)
         # LSE merge (reference context_parallel.py:170-171):
         #   out <- out - sigmoid(blk_lse - lse) * (out - blk_out)
         #   lse <- logaddexp(lse, blk_lse)
@@ -126,18 +179,19 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash):
     return out.astype(q.dtype), lse
 
 
-def _ring_fwd(q, k, v, scale, axis, n, causal, use_flash):
-    out, lse = _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash)
+def _ring_fwd(q, k, v, scale, axis, n, causal, use_flash, zigzag):
+    out, lse = _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash,
+                              zigzag)
     return out, (q, k, v, out, lse)
 
 
 def _block_bwd_einsum(q, kt, vt, dout, out_unused, lse, D, scale, src, rank,
-                      causal):
+                      causal, n, zigzag):
     """One block's (dq, dk, dv) via XLA einsums; P re-derived from the final
     LSE: exp(scores - lse) is each block's true share of the global softmax
     (context_parallel.py:112-128)."""
     s = q.shape[1]
-    mask = _block_mask(s, s, src, rank, causal)
+    mask = _block_mask(s, s, src, rank, causal, n, zigzag)
     q32 = q.astype(jnp.float32)
     do32 = dout.astype(jnp.float32)
     k32 = kt.astype(jnp.float32)
@@ -154,11 +208,13 @@ def _block_bwd_einsum(q, kt, vt, dout, out_unused, lse, D, scale, src, rank,
     return dq_blk, dk_blk, dv_blk
 
 
-def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal):
+def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal,
+                     zigzag):
     """One block's (dq, dk, dv) via the Pallas backward kernels fed the
     globally-merged out/lse (skip branch costs nothing at runtime)."""
     from picotron_tpu.ops.pallas.flash_attention import flash_block_grads
 
+    b, s, h, d = q.shape
     f32 = lambda t: tuple(x.astype(jnp.float32) for x in t)
 
     def full(_):
@@ -171,13 +227,31 @@ def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal):
         z = jnp.zeros(q.shape, jnp.float32)
         return z, z, z
 
+    def early(_):
+        # zigzag, src < rank: all queries x source's early kv half
+        dq, dk_h, dv_h = f32(flash_block_grads(
+            q, kt[:, : s // 2], vt[:, : s // 2], out, lse, dout, scale, False))
+        zpad = jnp.zeros((b, s - s // 2, h, d), jnp.float32)
+        return (dq, jnp.concatenate([dk_h, zpad], axis=1),
+                jnp.concatenate([dv_h, zpad], axis=1))
+
+    def late(_):
+        # zigzag, src > rank: late query half x full source kv
+        dq_h, dk, dv = f32(flash_block_grads(
+            q[:, s // 2:], kt, vt, out[:, s // 2:], lse[:, s // 2:],
+            dout[:, s // 2:], scale, False))
+        zpad = jnp.zeros((b, s // 2, h, d), jnp.float32)
+        return jnp.concatenate([zpad, dq_h], axis=1), dk, dv
+
     if not causal:
         return full(None)
     idx = jnp.where(src == rank, 2, jnp.where(src < rank, 1, 0))
+    if zigzag:
+        return lax.switch(idx, [late, early, diag], None)
     return lax.switch(idx, [skip, full, diag], None)
 
 
-def _ring_bwd(scale, axis, n, causal, use_flash, res, dout):
+def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, res, dout):
     q, k, v, out, lse = res
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -197,10 +271,11 @@ def _ring_bwd(scale, axis, n, causal, use_flash, res, dout):
         src = (rank - t) % n
         if use_flash:
             dq_blk, dk_blk, dv_blk = _block_bwd_flash(
-                q, kt, vt, dout, out, lse, scale, src, rank, causal)
+                q, kt, vt, dout, out, lse, scale, src, rank, causal, zigzag)
         else:
             dq_blk, dk_blk, dv_blk = _block_bwd_einsum(
-                q, kt, vt, dout, out, lse, D, scale, src, rank, causal)
+                q, kt, vt, dout, out, lse, D, scale, src, rank, causal, n,
+                zigzag)
 
         dq = dq + dq_blk
         # accumulators travel the ring with their kv chunk and arrive home

@@ -79,8 +79,12 @@ def no_pipeline(stage_fn, params, tokens, targets, h_shape, h_dtype,
         gacc = jax.tree.map(lambda a, gi: a + gi.astype(acc_dtype), gacc, g)
         return (gacc, loss_acc + loss.astype(jnp.float32)), None
 
+    # unroll on CPU: the stage body can contain ring-attention ppermutes,
+    # which race across scan iterations in the XLA CPU runtime
+    # (utils.collective_scan_unroll)
     (gacc, loss_acc), _ = lax.scan(body, (gacc0, jnp.float32(0.0)),
-                                   (tokens, targets))
+                                   (tokens, targets),
+                                   unroll=collective_scan_unroll())
     grads = jax.tree.map(lambda g: g / M, gacc)
     return loss_acc / M, grads
 

@@ -172,8 +172,14 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
             p, o, loss = step(p, o, batch[0], batch[1])
             return (p, o), loss
 
+        # unroll on CPU: the step body contains ppermutes, and the XLA CPU
+        # runtime's collective rendezvous races across scan iterations
+        # (utils.collective_scan_unroll)
+        from picotron_tpu.utils import collective_scan_unroll
+
         (params, opt_state), losses = lax.scan(
-            body, (params, opt_state), (tokens, targets))
+            body, (params, opt_state), (tokens, targets),
+            unroll=collective_scan_unroll())
         return params, opt_state, losses
 
     return jax.jit(multi, donate_argnums=(0, 1))

@@ -50,6 +50,11 @@ TOPOLOGIES = [
     dict(dp=2, pp=2, cp=2, acc=2, engine="1f1b"),
     dict(dp=2, pp=2, tp=2, acc=2, engine="1f1b"),
     dict(pp=2, cp=2, tp=2, acc=2, engine="1f1b"),
+    # zigzag CP: permuted sequence layout must not change the loss (token
+    # mean is permutation-invariant; rope/mask follow the true positions)
+    dict(cp=2, zigzag=True),
+    dict(cp=4, zigzag=True),
+    dict(dp=2, cp=2, tp=2, zigzag=True),
 ]
 
 

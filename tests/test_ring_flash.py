@@ -10,15 +10,25 @@ einsum-path topology-equivalence tests in test_parallel.py; einsum and
 flash paths share the merge/backward glue tested here.
 """
 
+from contextlib import nullcontext
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.experimental.pallas import tpu as pltpu
 
 from picotron_tpu.ops.attention import sdpa
 from picotron_tpu.ops.pallas.flash_attention import (
     flash_attention_with_lse,
     flash_block_grads,
+)
+from picotron_tpu.parallel.cp import (
+    _block_bwd_einsum,
+    _block_bwd_flash,
+    _block_fwd,
+    chunk_positions,
+    zigzag_perm,
 )
 
 B, S, H, D = 2, 256, 2, 64  # two 128-token chunks
@@ -83,3 +93,75 @@ def test_two_chunk_flash_decomposition_matches_full():
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(dv1_g), np.asarray(ref_dv[:, C:]),
                                rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------- zigzag layout ------------------------------- #
+
+N = 2  # cp ranks; S=256 -> 4 chunks of 64, rank r owns chunks (r, 2N-1-r).
+# _block_fwd/_block_bwd_* take src/rank as plain values and use no collective,
+# so the whole zigzag ring schedule can be simulated on one device.
+
+
+def _zig_local(x, r):
+    pos = np.asarray(chunk_positions(r, S // N, N, True))
+    return x[:, pos]
+
+
+def _simulate_rank_fwd(r, q, k, v, use_flash):
+    ql = _zig_local(q, r)
+    out = jnp.zeros(ql.shape, jnp.float32)
+    lse = jnp.full(ql.shape[:3], -1e30, jnp.float32)
+    for t in range(N):
+        src = (r - t) % N
+        kl, vl = _zig_local(k, src), _zig_local(v, src)
+        bo, bl = _block_fwd(ql, kl, vl, SCALE, jnp.int32(src), jnp.int32(r),
+                            True, use_flash, N, True)
+        w = jax.nn.sigmoid(bl - lse)[..., None]
+        out = out - w * (out - bo)
+        lse = jnp.logaddexp(lse, bl)
+    return out, lse
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_zigzag_blocks_match_full_attention(use_flash):
+    q, k, v = _qkv()
+    ref = np.asarray(sdpa(q, k, v, SCALE, causal=True))
+    ctx = pltpu.force_tpu_interpret_mode() if use_flash else nullcontext()
+    with ctx:
+        for r in range(N):
+            out, _ = _simulate_rank_fwd(r, q, k, v, use_flash)
+            pos = np.asarray(chunk_positions(r, S // N, N, True))
+            np.testing.assert_allclose(np.asarray(out), ref[:, pos],
+                                       rtol=3e-5, atol=3e-5)
+
+
+def test_zigzag_flash_bwd_matches_einsum_bwd():
+    q, k, v = _qkv()
+    with pltpu.force_tpu_interpret_mode():
+        for r in range(N):
+            ql = _zig_local(q, r)
+            out, lse = _simulate_rank_fwd(r, q, k, v, False)
+            dout = (2.0 * out).astype(q.dtype)
+            D = jnp.sum(dout.astype(jnp.float32) * out, axis=-1)
+            for src in range(N):
+                kl, vl = _zig_local(k, src), _zig_local(v, src)
+                fe = _block_bwd_einsum(ql, kl, vl, dout, out, lse, D, SCALE,
+                                       jnp.int32(src), jnp.int32(r), True, N,
+                                       True)
+                ff = _block_bwd_flash(ql, kl, vl, dout,
+                                      out.astype(q.dtype), lse, SCALE,
+                                      jnp.int32(src), jnp.int32(r), True, True)
+                for a, b in zip(ff, fe):
+                    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                               rtol=1e-4, atol=1e-4)
+
+
+def test_zigzag_perm_inverse():
+    perm = zigzag_perm(S, N)
+    assert sorted(perm.tolist()) == list(range(S))
+    # contiguous shard r of the permuted sequence = positions chunk_positions(r)
+    for r in range(N):
+        sl = S // N
+        np.testing.assert_array_equal(
+            perm[r * sl:(r + 1) * sl],
+            np.asarray(chunk_positions(r, sl, N, True)))
