@@ -41,7 +41,7 @@ def smollm_cfg(mbs: int, seq: int, on_tpu: bool):
     })
 
 
-def run(cfg, calls=4, warmup=1, steps_per_call=8):
+def run(cfg, calls=4, warmup=1, steps_per_call=16):
     """Time multi-step calls (K optimizer steps fused into one dispatch via
     lax.scan — an on-device training loop, so per-step host latency doesn't
     pollute the measurement); first `warmup` calls (compile + cache) skipped."""

@@ -114,9 +114,16 @@ class MicroBatchDataLoader:
             from transformers import AutoTokenizer
 
             tokenizer = AutoTokenizer.from_pretrained(cfg.model.name)
-        ds = datasets.load_dataset(
-            cfg.dataset.name, cfg.dataset.subset_name, split=cfg.dataset.split
-        )
+        name = cfg.dataset.name
+        if name.endswith((".json", ".jsonl", ".txt", ".csv")):
+            # local files work air-gapped: dataset.name is a path (or glob)
+            fmt = {"jsonl": "json", "txt": "text"}.get(
+                name.rsplit(".", 1)[-1], name.rsplit(".", 1)[-1])
+            ds = datasets.load_dataset(fmt, data_files=name,
+                                       split=cfg.dataset.split)
+        else:
+            ds = datasets.load_dataset(
+                name, cfg.dataset.subset_name, split=cfg.dataset.split)
         col = cfg.dataset.text_column
 
         def tok(batch):

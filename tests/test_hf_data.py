@@ -1,0 +1,68 @@
+"""HF-datasets data path without network: local text/json files + an
+injected tokenizer exercise the tokenize -> pack -> batch pipeline
+(reference data.py:57-100 semantics)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from picotron_tpu.data import MicroBatchDataLoader
+from tests.conftest import make_config
+
+
+class ToyTokenizer:
+    """Whitespace 'tokenizer' with a fixed small vocab (hash-bucketed)."""
+
+    def __init__(self, vocab_size):
+        self.vocab_size = vocab_size
+
+    def __call__(self, texts):
+        ids = [[hash(w) % self.vocab_size for w in t.split()] for t in texts]
+        return {"input_ids": ids}
+
+
+@pytest.fixture
+def json_corpus(tmp_path):
+    rng = np.random.default_rng(0)
+    rows = [{"text": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 64))}
+            for _ in range(200)]
+    path = tmp_path / "corpus.json"
+    path.write_text(json.dumps(rows))
+    return str(path)
+
+
+def test_local_json_dataset_loads_and_packs(tiny_model_kwargs, json_corpus):
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg.dataset.name = json_corpus
+    tok = ToyTokenizer(cfg.model.vocab_size)
+    loader = MicroBatchDataLoader(cfg, tokenizer=tok)
+    batch = next(loader)
+    assert batch["input_ids"].shape == (1, 2, 32)
+    assert batch["input_ids"].dtype == np.int32
+    assert batch["input_ids"].max() < cfg.model.vocab_size
+    # shifted-view contract: target[t] == input[t+1] within a packed sample
+    np.testing.assert_array_equal(batch["input_ids"][0, :, 1:],
+                                  batch["target_ids"][0, :, :-1])
+
+
+def test_local_json_dataset_trains(tiny_model_kwargs, json_corpus):
+    from picotron_tpu.train import train
+
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2, total_train_steps=2)
+    cfg.dataset.name = json_corpus
+
+    # the trainer builds the loader itself; inject the toy tokenizer by
+    # patching AutoTokenizer resolution is overkill — instead run the loader
+    # path directly through train_step
+    from picotron_tpu import train_step as ts
+    from picotron_tpu.topology import topology_from_config
+
+    topo = topology_from_config(cfg)
+    loader = MicroBatchDataLoader(cfg, tokenizer=ToyTokenizer(cfg.model.vocab_size))
+    params, opt_state = ts.init_state(cfg, topo)
+    step = ts.build_train_step(cfg, topo)
+    for _ in range(2):
+        tok_b, tgt = ts.shard_batch(next(loader), topo)
+        params, opt_state, loss = step(params, opt_state, tok_b, tgt)
+    assert np.isfinite(float(loss))
