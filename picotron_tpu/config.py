@@ -90,6 +90,9 @@ class TrainingConfig:
     # "full": remat every decoder layer (jax.checkpoint); "none": store all;
     # "save_attn": remat layers but keep flash-attention out+LSE (the
     # backward never re-runs the attention forward kernel).
+    # Applies to the AD engines (afab, pp=1); the 1f1b engine checkpoints at
+    # layer boundaries by construction — equivalent to "full" — and ignores
+    # this knob (models/llama.py::stage_fwd_save, docs/PP_COST.md).
     remat: str = "full"
     # dtype gradients accumulate in across microbatches: "float32" (the
     # reference's main_grad policy, data_parallel.py:66,81) or "param"

@@ -275,24 +275,138 @@ def slice_rope_for_cp(cos, sin, s_local, cfg: Config):
             lax.dynamic_slice_in_dim(sin, start, s_local, 0))
 
 
+def _stage_input(params, h_recv, tokens, cfg: Config):
+    """Stage input: the embedding on stage 0, the received activation
+    elsewhere. ``lax.cond`` so non-first stages never pay the vocab-parallel
+    embedding lookup (the reference instantiates the embedding only on stage
+    0, pipeline_parallel.py:12-15). The cond predicate depends only on the
+    'pp' index, so the tp psum inside runs uniformly across each tp group."""
+    dt = jnp.dtype(cfg.model.dtype)
+    if cfg.distributed.pp_size == 1:
+        return embed_lookup(params["embed"], tokens).astype(dt)
+    return lax.cond(
+        lax.axis_index("pp") == 0,
+        lambda: embed_lookup(params["embed"], tokens).astype(dt),
+        lambda: h_recv,
+    )
+
+
+def _stage_loss(params, h, targets, cfg: Config):
+    """Loss, computed only on the last stage (reference
+    pipeline_parallel.py:67-69, 97-100). ``lax.cond`` so earlier stages skip
+    the LM-head matmul — for SmolLM a 2048x49152 matmul, ~10% of model FLOPs."""
+    if cfg.distributed.pp_size == 1:
+        return loss_from_hidden(params, h, targets, cfg)
+    return lax.cond(
+        lax.axis_index("pp") == cfg.distributed.pp_size - 1,
+        lambda: loss_from_hidden(params, h, targets, cfg),
+        lambda: jnp.zeros((), jnp.float32),
+    )
+
+
 def stage_apply(params, h_recv, tokens, targets, cos, sin, cfg: Config):
     """The uniform per-pipeline-stage program. Returns (h_out, loss) where
     h_out is the activation sent downstream (pre-final-norm) and loss is
-    nonzero only on the last stage (reference computes loss only there,
-    pipeline_parallel.py:67-69, 97-100)."""
-    pp = cfg.distributed.pp_size
-    stage = lax.axis_index("pp")
-    is_first = stage == 0
-    is_last = stage == pp - 1
-    dt = jnp.dtype(cfg.model.dtype)
-
-    emb = embed_lookup(params["embed"], tokens).astype(dt)
-    h = jnp.where(is_first, emb, h_recv)
+    nonzero only on the last stage. Embedding and LM-head/loss are cond-gated
+    to their owning stages, so no stage wastes the other stages' FLOPs."""
+    h = _stage_input(params, h_recv, tokens, cfg)
     s_local = tokens.shape[-1]
     cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
     h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)
-    loss = loss_from_hidden(params, h, targets, cfg)
-    return h, jnp.where(is_last, loss, 0.0)
+    loss = _stage_loss(params, h, targets, cfg)
+    return h, loss
+
+
+def stage_fwd_save(params, h_recv, tokens, targets, cos, sin, cfg: Config):
+    """Forward for the manual-backward 1F1B engine: ``stage_apply`` that also
+    returns the activations ``stage_bwd`` needs — the input to every local
+    layer plus the final hidden state. This is the layer-granular
+    checkpointing set, so a stage's in-flight memory is L_local + 1 boundary
+    tensors per microbatch, never the full per-layer intermediates the
+    reference's no-remat 1F1B holds
+    (pipeline_parallel.py:46-52). Note the 1F1B engine is layer-remat *by
+    construction*: ``training.remat`` governs the AD engines (afab /
+    no_pipeline); here the backward always re-derives each layer's VJP from
+    its boundary (docs/PP_COST.md)."""
+    h = _stage_input(params, h_recv, tokens, cfg)
+    s_local = tokens.shape[-1]
+    cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
+
+    def body(h, lp):
+        return decoder_layer(lp, h, cos_l, sin_l, cfg), h
+
+    h_final, layer_inputs = lax.scan(body, h, params["layers"])
+    loss = _stage_loss(params, h_final, targets, cfg)
+    # h_final IS buffered (not rederived from layer_inputs[-1] inside the
+    # last-stage cond in stage_bwd): with cp>1 the rederiving decoder_layer
+    # would put ring-attention ppermutes inside a partially-executed
+    # conditional, which the XLA CPU runtime's global collective-permute
+    # rendezvous aborts on (utils.collective_scan_unroll). psums inside
+    # conds (embed/loss gating) are per-group rendezvous and safe.
+    return h_final, loss, {"layer_inputs": layer_inputs, "h_final": h_final}
+
+
+def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
+              cfg: Config):
+    """Manual backward for one stage: given the saved layer boundaries, the
+    downstream cotangent ``dh_out`` and the loss cotangent ``dloss``, return
+    (dparams, dh_prev). Each layer's backward re-derives its VJP from the
+    saved layer *input* — one forward recompute + backward per layer, i.e.
+    exactly remat="full" cost (3x fwd FLOPs), with no whole-stage forward
+    rebuild. Head/loss and embedding backwards are cond-gated to the owning
+    stages, mirroring ``stage_apply``."""
+    pp = cfg.distributed.pp_size
+    stage = lax.axis_index("pp")
+    dt = jnp.dtype(cfg.model.dtype)
+    s_local = tokens.shape[-1]
+    cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
+
+    # ---- head/loss backward (last stage only)
+    h_final = saved["h_final"]
+
+    def loss_head(fn_w, lm_w, h):
+        return loss_from_hidden({"final_norm": fn_w, "lm_head": lm_w}, h,
+                                targets, cfg)
+
+    def loss_vjp():
+        _, vjp = jax.vjp(loss_head, params["final_norm"], params["lm_head"],
+                         h_final)
+        return vjp(dloss)
+
+    d_fnorm, d_lmhead, dh_loss = lax.cond(
+        stage == pp - 1,
+        loss_vjp,
+        lambda: (jnp.zeros_like(params["final_norm"]),
+                 jnp.zeros_like(params["lm_head"]),
+                 jnp.zeros_like(h_final)),
+    )
+    dh = dh_out + dh_loss
+
+    # ---- layers backward: reverse scan re-deriving each layer's VJP from its
+    # saved input (ys keep xs order under reverse=True)
+    def layer_bwd(dh, xs):
+        lp, x = xs
+        _, vjp = jax.vjp(lambda lp, h: decoder_layer(lp, h, cos_l, sin_l, cfg),
+                         lp, x)
+        dlp, dx = vjp(dh)
+        return dx, dlp
+
+    dh, d_layers = lax.scan(layer_bwd, dh,
+                            (params["layers"], saved["layer_inputs"]),
+                            reverse=True)
+
+    # ---- embedding backward (first stage only)
+    def embed_vjp():
+        _, vjp = jax.vjp(
+            lambda w: embed_lookup(w, tokens).astype(dt), params["embed"])
+        return vjp(dh)[0]
+
+    d_embed = lax.cond(stage == 0, embed_vjp,
+                       lambda: jnp.zeros_like(params["embed"]))
+    dh_prev = jnp.where(stage == 0, jnp.zeros_like(dh), dh)
+    dparams = {"embed": d_embed, "layers": d_layers,
+               "final_norm": d_fnorm, "lm_head": d_lmhead}
+    return dparams, dh_prev
 
 
 def forward_logits(params, tokens, cfg: Config, gather: bool = True):

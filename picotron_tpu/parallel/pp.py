@@ -20,12 +20,15 @@ fixes tensor_shapes once, train.py:201).
   bf16 + large grad_acc; AFAB's role is the independent correctness oracle.
 
 - 1F1B: a manual schedule. Each tick runs one forward microbatch and one
-  backward microbatch on every stage (warmup/cooldown are masked), with the
-  backward re-deriving the stage VJP from a saved stage *input* (O(pp) ring
-  buffer — the 1F1B memory win, reference :86) and rematerializing the stage
-  forward. Gradients accumulate in float32, the reference's main_grad dtype
-  policy (data_parallel.py:66,81); the last microbatch's psum happens outside,
-  matching require_backward_grad_sync-on-last-micro (train.py:40-41).
+  backward microbatch on every stage (warmup/cooldown are masked). The
+  forward saves each microbatch's layer-boundary activations into an O(pp)
+  ring buffer (the 1F1B memory win, reference :86); the backward re-derives
+  each *layer's* VJP from its saved input — layer-granular remat, one layer
+  forward recompute + backward, no whole-stage forward rebuild (see
+  docs/PP_COST.md). Gradients accumulate in float32, the reference's
+  main_grad dtype policy (data_parallel.py:66,81); the last microbatch's psum
+  happens outside, matching require_backward_grad_sync-on-last-micro
+  (train.py:40-41).
 
 With pp_size == 1 both schedules degenerate to the plain gradient-accumulation
 loop over microbatches (the reference's non-PP train_step, train.py:29-55).
@@ -118,7 +121,8 @@ def pipeline_afab(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
     return loss, grads
 
 
-def pipeline_1f1b(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
+def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
+                  h_shape, h_dtype):
     """(loss, grads_fp32) via the interleaved one-forward-one-backward schedule.
 
     Tick t: stage s forwards microbatch ``t - s`` and backwards microbatch
@@ -127,42 +131,64 @@ def pipeline_1f1b(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
     the steady state of the reference's schedule (pipeline_parallel.py:86,
     :116-134). dh flows up the pipeline one tick behind the corresponding
     forward, via the reverse ppermute.
+
+    The forward half-tick runs ``stage_fwd`` which also emits a ``saved``
+    pytree (layer-boundary activations); a ring buffer holds the saved
+    pytrees of in-flight microbatches, and the backward half-tick hands the
+    matching slot to ``stage_bwd`` — a manual backward that re-derives each
+    *layer's* VJP from its saved input. A steady-state tick therefore costs
+    one stage forward + one layer-remat stage backward (≈ 3x fwd FLOPs),
+    never a whole-stage forward rebuild; see docs/PP_COST.md for the measured
+    FLOP accounting. This is the reference's residual-saving backward
+    (pipeline_parallel.py:46-52) re-done at layer-checkpoint granularity,
+    which is what a 7B-class model needs on TPU HBM anyway.
+
+    stage_fwd(params, h_recv, tok, tgt) -> (h_out, loss, saved)
+    stage_bwd(params, saved, tok, tgt, dh_out, dloss) -> (dparams, dh_prev)
     """
     M = tokens.shape[0]
     s = lax.axis_index("pp")
     is_last = s == pp_size - 1
     T = M + 2 * (pp_size - 1)
-    BUF = 2 * pp_size - 1  # max in-flight stage inputs = 2*pp - 2 - 2*s < BUF
+    BUF = 2 * pp_size - 1  # max in-flight microbatches = 2*pp - 2 - 2*s < BUF
     down, up = _down_perm(pp_size), _up_perm(pp_size)
 
     gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-    hbuf0 = jnp.zeros((BUF,) + tuple(h_shape), h_dtype)
     h0 = jnp.zeros(h_shape, h_dtype)
+    tok0, tgt0 = _take_mb(tokens, 0), _take_mb(targets, 0)
+    saved_shape = jax.eval_shape(
+        lambda p, h, tok, tgt: stage_fwd(p, h, tok, tgt)[2],
+        params, h0, tok0, tgt0)
+    sbuf0 = jax.tree.map(
+        lambda sh: jnp.zeros((BUF,) + tuple(sh.shape), sh.dtype), saved_shape)
 
     def tick(carry, t):
-        h_recv, dh_recv, hbuf, gacc, loss_acc = carry
+        h_recv, dh_recv, sbuf, gacc, loss_acc = carry
 
         # ---- forward half-tick
         mb_f = t - s
         fvalid = (mb_f >= 0) & (mb_f < M)
         mbf = jnp.clip(mb_f, 0, M - 1)
-        h_out, loss_mb = stage_fn(params, h_recv, _take_mb(tokens, mbf), _take_mb(targets, mbf))
+        h_out, loss_mb, saved = stage_fwd(
+            params, h_recv, _take_mb(tokens, mbf), _take_mb(targets, mbf))
         loss_acc = loss_acc + jnp.where(fvalid, loss_mb, 0.0)
-        # save this stage's *input* for the backward remat; guarded so bubble
-        # ticks can't clobber a slot still awaiting its backward
-        stored = lax.dynamic_update_index_in_dim(hbuf, h_recv, mbf % BUF, 0)
-        hbuf = jnp.where(fvalid, stored, hbuf)
+        # store this microbatch's boundaries; guarded so bubble ticks can't
+        # clobber a slot still awaiting its backward
+        sbuf = jax.tree.map(
+            lambda buf, v: jnp.where(
+                fvalid, lax.dynamic_update_index_in_dim(buf, v, mbf % BUF, 0),
+                buf),
+            sbuf, saved)
 
         # ---- backward half-tick
         mb_b = t - (2 * pp_size - 2 - s)
         bvalid = (mb_b >= 0) & (mb_b < M)
         mbb = jnp.clip(mb_b, 0, M - 1)
-        h_saved = _take_mb(hbuf, mbb % BUF)
+        saved_b = jax.tree.map(lambda buf: _take_mb(buf, mbb % BUF), sbuf)
         tok_b, tgt_b = _take_mb(tokens, mbb), _take_mb(targets, mbb)
-        _, vjp_fn = jax.vjp(lambda p, h: stage_fn(p, h, tok_b, tgt_b), params, h_saved)
         dh_out = jnp.where(is_last, jnp.zeros_like(dh_recv), dh_recv)
         dloss = jnp.where(is_last & bvalid, 1.0 / M, 0.0).astype(jnp.float32)
-        dparams, dh_prev = vjp_fn((dh_out, dloss))
+        dparams, dh_prev = stage_bwd(params, saved_b, tok_b, tgt_b, dh_out, dloss)
         gacc = jax.tree.map(
             lambda a, g: a + jnp.where(bvalid, g, 0).astype(jnp.float32), gacc, dparams
         )
@@ -171,10 +197,10 @@ def pipeline_1f1b(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
         # send-fwd/recv-bwd pairs; here XLA schedules both permutes together)
         h_next = lax.ppermute(h_out, "pp", down) if down else jnp.zeros_like(h_out)
         dh_next = lax.ppermute(dh_prev, "pp", up) if up else jnp.zeros_like(dh_prev)
-        return (h_next, dh_next, hbuf, gacc, loss_acc), None
+        return (h_next, dh_next, sbuf, gacc, loss_acc), None
 
-    carry0 = (h0, jnp.zeros(h_shape, h_dtype), hbuf0, gacc0, jnp.float32(0.0))
-    (h, dh, hbuf, gacc, loss_acc), _ = lax.scan(tick, carry0, jnp.arange(T),
+    carry0 = (h0, jnp.zeros(h_shape, h_dtype), sbuf0, gacc0, jnp.float32(0.0))
+    (h, dh, sbuf, gacc, loss_acc), _ = lax.scan(tick, carry0, jnp.arange(T),
                                                 unroll=collective_scan_unroll())
     loss = lax.psum(loss_acc, "pp") / M
     return loss, gacc

@@ -133,9 +133,16 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
             acc_dt = dt if cfg.training.grad_accum_dtype == "param" else jnp.float32
             loss, grads = no_pipeline(stage_fn, params, tokens, targets,
                                       h_shape, dt, acc_dt)
+        elif engine == "1f1b":
+            stage_fwd = lambda p, h, tok, tgt: llama.stage_fwd_save(
+                p, h, tok, tgt, cos, sin, cfg)
+            stage_bwd = lambda p, saved, tok, tgt, dh, dl: llama.stage_bwd(
+                p, saved, tok, tgt, dh, dl, cos, sin, cfg)
+            loss, grads = pipeline_1f1b(stage_fwd, stage_bwd, params, tokens,
+                                        targets, pp, h_shape, dt)
         else:
-            schedule = pipeline_1f1b if (engine == "1f1b") else pipeline_afab
-            loss, grads = schedule(stage_fn, params, tokens, targets, pp, h_shape, dt)
+            loss, grads = pipeline_afab(stage_fn, params, tokens, targets, pp,
+                                        h_shape, dt)
 
         # grad sync: mean over the fused dp×cp group (data_parallel.py:47,83),
         # psum over pp for stage-replicated params, cast fp32 -> param dtype

@@ -1,0 +1,33 @@
+"""Pipeline FLOP-cost guardrails (docs/PP_COST.md).
+
+The 1F1B backward must stay a layer-remat backward (3x fwd per stage), never
+a whole-stage forward rebuild (4x): per docs/PP_COST.md the per-device flops
+ratio 1F1B/AFAB at pp=2, M=4 is ~1.42 for the layer-remat backward and ~2.0
+for a rebuild-based one, so the assert at 1.75 separates the two regimes
+with margin for compiler drift.
+"""
+
+from conftest import make_config
+from picotron_tpu import train_step as ts
+from picotron_tpu.data import MicroBatchDataLoader
+from picotron_tpu.topology import topology_from_config
+
+
+def _step_flops(cfg):
+    topo = topology_from_config(cfg)
+    params, opt_state = ts.init_state(cfg, topo)
+    step = ts.build_train_step(cfg, topo)
+    loader = MicroBatchDataLoader(cfg)
+    tokens, targets = ts.shard_batch(next(loader), topo)
+    comp = step.lower(params, opt_state, tokens, targets).compile()
+    return comp.cost_analysis()["flops"]
+
+
+def test_1f1b_has_no_stage_forward_rebuild(tiny_model_kwargs):
+    kw = dict(pp=2, acc=4, mbs=2, seq=32)
+    f_afab = _step_flops(make_config(tiny_model_kwargs, engine="afab", **kw))
+    f_1f1b = _step_flops(make_config(tiny_model_kwargs, engine="1f1b", **kw))
+    ratio = f_1f1b / f_afab
+    assert 1.0 < ratio < 1.75, (
+        f"1F1B/AFAB flops ratio {ratio:.2f} outside the layer-remat regime "
+        f"(~1.4-1.6); ~2.0 means the whole-stage forward rebuild is back")
