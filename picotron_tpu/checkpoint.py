@@ -49,6 +49,16 @@ from picotron_tpu.topology import Topology, named_shardings
 # --------------------------------------------------------------------------- #
 
 
+def _padded_layout(L: int, pp: int) -> tuple[int, list[int]]:
+    """(padded rows, real-row positions) of the stacked layer axis for a
+    (num_hidden_layers, pp_size) pair — [L] with identity positions for even
+    splits, llama.pp_layer_layout otherwise."""
+    if L % pp == 0:
+        return L, list(range(L))
+    K, _, positions = llama.pp_layer_layout(L, pp)
+    return K * pp, positions
+
+
 class CheckpointManager:
     """Save/resume of (params, opt_state, step, tokens).
 
@@ -68,16 +78,21 @@ class CheckpointManager:
         )
         self.manager = ocp.CheckpointManager(self.directory, options=options)
 
-    def save(self, step: int, params, opt_state, trained_tokens: int) -> None:
+    def save(self, step: int, params, opt_state, trained_tokens: int,
+             layout: Optional[tuple[int, int]] = None) -> None:
+        """``layout`` = (num_hidden_layers, pp_size) of the saving run;
+        recorded in the metadata so a restore under a different uneven-pp
+        padding can remap the stacked layer rows (see ``load``)."""
         ocp = self._ocp
+        meta = {"step": step, "trained_tokens": int(trained_tokens)}
+        if layout is not None:
+            meta["num_hidden_layers"], meta["pp_size"] = int(layout[0]), int(layout[1])
         self.manager.save(
             step,
             args=ocp.args.Composite(
                 params=ocp.args.StandardSave(params),
                 opt_state=ocp.args.StandardSave(opt_state),
-                meta=ocp.args.JsonSave(
-                    {"step": step, "trained_tokens": int(trained_tokens)}
-                ),
+                meta=ocp.args.JsonSave(meta),
             ),
         )
         self.manager.wait_until_finished()
@@ -85,33 +100,97 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 
-    def load(self, params_like, opt_state_like, step: Optional[int] = None):
+    def _read_meta(self, step: int) -> dict:
+        ocp = self._ocp
+        return self.manager.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
+
+    def load(self, params_like, opt_state_like, step: Optional[int] = None,
+             layout: Optional[tuple[int, int]] = None):
         """Restore into the shardings/dtypes of the given example trees
         (live arrays or ShapeDtypeStructs). Returns
-        (params, opt_state, step, trained_tokens)."""
+        (params, opt_state, step, trained_tokens).
+
+        ``layout`` = (num_hidden_layers, pp_size) of the *restoring* run.
+        When the saved metadata records a different uneven-pp pad layout
+        (llama.pp_layer_layout), the stacked layer leaves (params['layers']
+        and the optimizer moments mirroring them) are restored to host
+        memory, their real rows remapped source-layout -> target-layout, and
+        the result placed against the example tree's shardings — so orbax
+        checkpoints stay topology-portable across uneven splits. Same-layout
+        restores (all even splits share the [L] layout) take the direct
+        sharded path."""
         ocp = self._ocp
         step = self.manager.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {self.directory}")
 
+        meta = self._read_meta(step)
+        remap = None
+        if layout is not None and "num_hidden_layers" in meta:
+            src = (int(meta["num_hidden_layers"]), int(meta["pp_size"]))
+            if src[0] != layout[0]:
+                raise ValueError(
+                    f"checkpoint has {src[0]} layers, config wants "
+                    f"{layout[0]}")
+            src_rows, src_pos = _padded_layout(*src)
+            tgt_rows, tgt_pos = _padded_layout(*layout)
+            if src_rows != tgt_rows or src_pos != tgt_pos:
+                remap = (src_rows, src_pos, tgt_pos)
+
+        def is_stacked(path) -> bool:
+            return any(
+                getattr(k, "key", getattr(k, "name", None)) == "layers"
+                for k in path)
+
         def as_abstract(tree):
-            return jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
-                tree,
-            )
+            from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+            flat, treedef = tree_flatten_with_path(tree)
+            out = []
+            for path, x in flat:
+                if remap is not None and is_stacked(path):
+                    # restore the saved (source-layout) shape on host
+                    out.append(jax.ShapeDtypeStruct(
+                        (remap[0],) + tuple(x.shape[1:]), x.dtype))
+                else:
+                    out.append(jax.ShapeDtypeStruct(
+                        x.shape, x.dtype,
+                        sharding=getattr(x, "sharding", None)))
+            return tree_unflatten(treedef, out)
 
         restored = self.manager.restore(
             step,
             args=ocp.args.Composite(
                 params=ocp.args.StandardRestore(as_abstract(params_like)),
                 opt_state=ocp.args.StandardRestore(as_abstract(opt_state_like)),
-                meta=ocp.args.JsonRestore(),
             ),
         )
-        meta = restored["meta"]
+        params, opt_state = restored["params"], restored["opt_state"]
+        if remap is not None:
+            src_rows, src_pos, tgt_pos = remap
+
+            def remap_tree(tree, like):
+                from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+                flat, treedef = tree_flatten_with_path(tree)
+                like_leaves = jax.tree.leaves(like)
+                out = []
+                for (path, x), ref in zip(flat, like_leaves):
+                    if is_stacked(path):
+                        a = np.asarray(jax.device_get(x))
+                        dst = np.zeros((ref.shape[0],) + a.shape[1:], a.dtype)
+                        dst[np.asarray(tgt_pos)] = a[np.asarray(src_pos)]
+                        sh = getattr(ref, "sharding", None)
+                        x = jax.device_put(dst, sh) if sh is not None else jnp.asarray(dst)
+                    out.append(x)
+                return tree_unflatten(treedef, out)
+
+            params = remap_tree(params, params_like)
+            opt_state = remap_tree(opt_state, opt_state_like)
         return (
-            restored["params"],
-            restored["opt_state"],
+            params,
+            opt_state,
             int(meta["step"]),
             int(meta["trained_tokens"]),
         )
@@ -223,6 +302,18 @@ def load_hf_safetensors(
     model ladder (SmolLM-1.7B, Llama-2-7B)."""
     dt = jnp.dtype(dtype or m.dtype)
     L = m.num_hidden_layers
+    pp = topo.pp_size if topo is not None else 1
+
+    def stack_layers(per_layer: list[np.ndarray]) -> np.ndarray:
+        """HF layer i -> its row in the (possibly padded) stacked axis
+        (pad rows of an uneven pp split are zeros)."""
+        rows, positions = _padded_layout(L, pp)
+        if rows == L:
+            return np.stack(per_layer)
+        out = np.zeros((rows,) + per_layer[0].shape, per_layer[0].dtype)
+        for g, pos in enumerate(positions):
+            out[pos] = per_layer[g]
+        return out
 
     with _SafetensorsReader(path) as reader:
 
@@ -233,7 +324,7 @@ def load_hf_safetensors(
         params: llama.Params = {
             "embed": grab(*_TOP_MAP["embed"]),
             "layers": {
-                k: np.stack([grab(tmpl, tr, i) for i in range(L)])
+                k: stack_layers([grab(tmpl, tr, i) for i in range(L)])
                 for k, (tmpl, tr) in _LAYER_MAP.items()
             },
             "final_norm": grab(*_TOP_MAP["final_norm"]),
@@ -253,10 +344,14 @@ def load_hf_safetensors(
     return params
 
 
-def save_hf_safetensors(params: llama.Params, path: str) -> None:
+def save_hf_safetensors(params: llama.Params, path: str,
+                        num_layers: Optional[int] = None,
+                        pp_size: int = 1) -> None:
     """Export our pytree to a single HF-format safetensors file (inverse of
     the reference's import direction — it only reads; export makes the
-    bootstrap test a round trip)."""
+    bootstrap test a round trip). For an uneven-pp padded stack, pass the
+    real ``num_layers`` and the ``pp_size`` it was padded for; only the real
+    rows are written, so the export is topology-free."""
     from safetensors.numpy import save_file
 
     out: dict[str, np.ndarray] = {}
@@ -267,10 +362,27 @@ def save_hf_safetensors(params: llama.Params, path: str) -> None:
 
     for k, (tmpl, tr) in _TOP_MAP.items():
         put(tmpl, params[k], tr)
-    L = params["layers"]["wq"].shape[0]
+    rows = params["layers"]["wq"].shape[0]
+    L = num_layers if num_layers is not None else rows
+    if num_layers is None:
+        # guard against silently exporting an uneven-pp padded stack: pad
+        # rows are exactly zero in every leaf (zero init, zero grads, zero
+        # adamw update), so an all-zero attn_norm row means padding
+        norms = np.asarray(jax.device_get(params["layers"]["attn_norm"]))
+        if not np.all(np.any(norms != 0, axis=-1)):
+            raise ValueError(
+                "layer stack contains all-zero (pad) rows — this model was "
+                "trained with an uneven pp split; pass num_layers= and "
+                "pp_size= so only real layers are exported")
+    exp_rows, positions = _padded_layout(L, pp_size)
+    if exp_rows != rows:
+        raise ValueError(
+            f"layer stack has {rows} rows but layout (num_layers={L}, "
+            f"pp_size={pp_size}) implies {exp_rows} — wrong num_layers/"
+            f"pp_size for this params tree")
     for k, (tmpl, tr) in _LAYER_MAP.items():
-        for i in range(L):
-            put(tmpl.format(i=i), params["layers"][k][i], tr)
+        for i, pos in enumerate(positions):
+            put(tmpl.format(i=i), params["layers"][k][pos], tr)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     save_file(out, path)
 

@@ -96,7 +96,9 @@ class TrainingConfig:
     remat: str = "full"
     # dtype gradients accumulate in across microbatches: "float32" (the
     # reference's main_grad policy, data_parallel.py:66,81) or "param"
-    # (param dtype; halves grad memory, useful single-chip).
+    # (param dtype; halves grad memory, useful single-chip). Only consulted
+    # when pp_size == 1 — both pipeline engines always accumulate fp32
+    # (validate() rejects "param" with pp_size > 1).
     grad_accum_dtype: str = "float32"
 
 
@@ -190,11 +192,13 @@ class Config:
             raise ValueError(f"vocab_size {m.vocab_size} % tp_size {d.tp_size} != 0")
         if m.hidden_size % m.num_attention_heads != 0:
             raise ValueError("hidden_size must be divisible by num_attention_heads")
-        if m.num_hidden_layers % d.pp_size != 0:
-            # The reference gives remainder layers to the earliest stages
-            # (pipeline_parallel.py:33-36); the SPMD pipeline needs equal
-            # stages, so we require divisibility instead.
-            raise ValueError(f"num_hidden_layers {m.num_hidden_layers} % pp_size {d.pp_size} != 0")
+        if m.num_hidden_layers < d.pp_size:
+            # Uneven splits are supported (remainder layers on the earliest
+            # stages, reference pipeline_parallel.py:33-36, via a masked
+            # padded layer stack — models/llama.py::pp_layer_layout), but
+            # every stage must hold at least one real layer.
+            raise ValueError(
+                f"num_hidden_layers {m.num_hidden_layers} < pp_size {d.pp_size}")
         if d.pp_size > 1 and t.gradient_accumulation_steps < 1:
             raise ValueError("pipeline parallelism needs >= 1 microbatch")
         if d.pp_engine not in ("afab", "1f1b"):

@@ -63,10 +63,37 @@ def _uniform(key, shape, fan_in, dtype):
     return jax.random.uniform(key, shape, jnp.float32, -bound, bound).astype(dtype)
 
 
-def init_params(key, m: ModelConfig) -> Params:
+def pp_layer_layout(L: int, pp: int):
+    """Uneven pipeline splits: stage layer counts + padded stack positions.
+
+    Remainder layers go to the earliest stages — the reference's distribution
+    rule (pipeline_parallel.py:33-36). The SPMD pipeline shards a stacked
+    layer axis over 'pp', which needs equal rows per stage, so the stack is
+    padded to K = ceil(L/pp) rows per stage and the pad rows are masked
+    identity layers (zero weights, skipped via a validity mask — FLOP waste
+    = (K*pp - L)/L, e.g. 1/32 for Llama-2-7B on pp=3).
+
+    Returns (K, counts, positions): counts[s] = real layers on stage s,
+    positions[g] = row of global layer g in the [K*pp] padded stack.
+    """
+    base, rem = divmod(L, pp)
+    counts = [base + (1 if s < rem else 0) for s in range(pp)]
+    K = base + (1 if rem else 0)
+    positions = []
+    for s, c in enumerate(counts):
+        positions += [s * K + i for i in range(c)]
+    return K, counts, positions
+
+
+def init_params(key, m: ModelConfig, pp_size: int = 1) -> Params:
     """Global (unsharded-shape) parameter pytree. Jit with out_shardings to
     materialize directly as sharded arrays — replaces the reference's
-    meta-device init + materialization dance (checkpoint.py:15-48, 50-102)."""
+    meta-device init + materialization dance (checkpoint.py:15-48, 50-102).
+
+    Real-layer weights are drawn with an [L, ...] leading axis regardless of
+    ``pp_size``, then scattered into the padded [K*pp, ...] stack when the
+    split is uneven — so the model function is identical across topologies
+    and the equivalence oracle holds for uneven splits too."""
     H, I, V, L = m.hidden_size, m.intermediate_size, m.vocab_size, m.num_hidden_layers
     D = m.head_dim
     Hq, Hkv = m.num_attention_heads * D, m.num_key_value_heads * D
@@ -74,19 +101,27 @@ def init_params(key, m: ModelConfig) -> Params:
     ks = {name: jax.random.fold_in(key, i) for i, name in enumerate(
         ["embed", "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"])}
     ones = lambda *shape: jnp.ones(shape, dt)
+    layers = {
+        "attn_norm": ones(L, H),
+        "wq": _uniform(ks["wq"], (L, H, Hq), H, dt),
+        "wk": _uniform(ks["wk"], (L, H, Hkv), H, dt),
+        "wv": _uniform(ks["wv"], (L, H, Hkv), H, dt),
+        "wo": _uniform(ks["wo"], (L, Hq, H), Hq, dt),
+        "mlp_norm": ones(L, H),
+        "w_gate": _uniform(ks["w_gate"], (L, H, I), H, dt),
+        "w_up": _uniform(ks["w_up"], (L, H, I), H, dt),
+        "w_down": _uniform(ks["w_down"], (L, I, H), I, dt),
+    }
+    if L % pp_size != 0:
+        K, _, positions = pp_layer_layout(L, pp_size)
+        idx = jnp.asarray(positions)
+        layers = {
+            k: jnp.zeros((K * pp_size,) + v.shape[1:], v.dtype).at[idx].set(v)
+            for k, v in layers.items()
+        }
     return {
         "embed": jax.random.normal(ks["embed"], (V, H), jnp.float32).astype(dt),
-        "layers": {
-            "attn_norm": ones(L, H),
-            "wq": _uniform(ks["wq"], (L, H, Hq), H, dt),
-            "wk": _uniform(ks["wk"], (L, H, Hkv), H, dt),
-            "wv": _uniform(ks["wv"], (L, H, Hkv), H, dt),
-            "wo": _uniform(ks["wo"], (L, Hq, H), Hq, dt),
-            "mlp_norm": ones(L, H),
-            "w_gate": _uniform(ks["w_gate"], (L, H, I), H, dt),
-            "w_up": _uniform(ks["w_up"], (L, H, I), H, dt),
-            "w_down": _uniform(ks["w_down"], (L, I, H), I, dt),
-        },
+        "layers": layers,
         "final_norm": ones(H),
         "lm_head": _uniform(ks["lm_head"], (H, V), H, dt),
     }
@@ -189,8 +224,30 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
     return h + tp_reduce(y @ lp["w_down"])
 
 
+def layer_valid_mask(stacked, cfg: Config):
+    """Validity mask for the scanned layer rows, or None when every row is a
+    real layer (even split). Two cases for uneven splits:
+    - rows == K (a stage's local slice inside the pipeline): row i is real
+      iff i < counts[stage], with the stage from ``lax.axis_index('pp')``;
+    - rows == K*pp (the full padded stack — eval paths like forward_logits
+      running on a mesh that holds the whole stack): position p is real iff
+      (p % K) < counts[p // K]."""
+    L, pp = cfg.model.num_hidden_layers, cfg.distributed.pp_size
+    if L % pp == 0:
+        return None
+    K, counts, _ = pp_layer_layout(L, pp)
+    rows = jax.tree.leaves(stacked)[0].shape[0]
+    if rows == K * pp:
+        return jnp.asarray([(p % K) < counts[p // K] for p in range(rows)])
+    base, rem = divmod(L, pp)
+    n_s = base + (lax.axis_index("pp") < rem)
+    return jnp.arange(K) < n_s
+
+
 def layers_forward(stacked, h, cos, sin, cfg: Config):
     """Scan over the locally-held layer stack (this stage's contiguous slice).
+    Pad rows of an uneven pipeline split are skipped via the validity mask
+    (h passes through unchanged, so their weights get zero gradients).
 
     remat modes (training.remat):
     - "none": save every intermediate (XLA default) — fastest, most memory;
@@ -201,9 +258,17 @@ def layers_forward(stacked, h, cos, sin, cfg: Config):
       ops/pallas/flash_attention.py) — the backward recomputes the cheap
       norm/matmul chain but never re-runs the flash forward kernel, for
       ~(S*H + S) extra bf16/fp32 floats per layer."""
+    valid = layer_valid_mask(stacked, cfg)
 
-    def body(h, lp):
-        return decoder_layer(lp, h, cos, sin, cfg), None
+    if valid is None:
+        def body(h, lp):
+            return decoder_layer(lp, h, cos, sin, cfg), None
+        xs = stacked
+    else:
+        def body(h, xs):
+            lp, v = xs
+            return jnp.where(v, decoder_layer(lp, h, cos, sin, cfg), h), None
+        xs = (stacked, valid)
 
     remat = cfg.training.remat
     if remat == "full":
@@ -212,7 +277,7 @@ def layers_forward(stacked, h, cos, sin, cfg: Config):
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.save_only_these_names(
                 "flash_out", "flash_lse"))
-    h, _ = lax.scan(body, h, stacked)
+    h, _ = lax.scan(body, h, xs)
     return h
 
 
@@ -275,33 +340,54 @@ def slice_rope_for_cp(cos, sin, s_local, cfg: Config):
             lax.dynamic_slice_in_dim(sin, start, s_local, 0))
 
 
+def _stage_gating() -> bool:
+    """Whether per-stage embed/loss gating uses ``lax.cond`` (true branch
+    executed only on the owning stage) or a compute-both ``jnp.where`` mask.
+
+    On TPU, collectives inside a cond taken by a subset of devices are safe
+    as long as every replica group is entirely inside or outside the branch —
+    true here, since the predicate depends only on the 'pp' index and the
+    gated collectives reduce over 'tp'. The XLA *CPU* runtime's in-process
+    rendezvous, however, intermittently aborts when a collective op is
+    reached by a subset of devices, so the CPU test/dryrun path masks with
+    ``where`` instead (the pre-gating semantics; the FLOP waste only matters
+    on real chips)."""
+    return on_tpu()
+
+
 def _stage_input(params, h_recv, tokens, cfg: Config):
     """Stage input: the embedding on stage 0, the received activation
-    elsewhere. ``lax.cond`` so non-first stages never pay the vocab-parallel
+    elsewhere — gated so non-first stages never pay the vocab-parallel
     embedding lookup (the reference instantiates the embedding only on stage
-    0, pipeline_parallel.py:12-15). The cond predicate depends only on the
-    'pp' index, so the tp psum inside runs uniformly across each tp group."""
+    0, pipeline_parallel.py:12-15)."""
     dt = jnp.dtype(cfg.model.dtype)
     if cfg.distributed.pp_size == 1:
         return embed_lookup(params["embed"], tokens).astype(dt)
-    return lax.cond(
-        lax.axis_index("pp") == 0,
-        lambda: embed_lookup(params["embed"], tokens).astype(dt),
-        lambda: h_recv,
-    )
+    if _stage_gating():
+        return lax.cond(
+            lax.axis_index("pp") == 0,
+            lambda: embed_lookup(params["embed"], tokens).astype(dt),
+            lambda: h_recv,
+        )
+    emb = embed_lookup(params["embed"], tokens).astype(dt)
+    return jnp.where(lax.axis_index("pp") == 0, emb, h_recv)
 
 
 def _stage_loss(params, h, targets, cfg: Config):
     """Loss, computed only on the last stage (reference
-    pipeline_parallel.py:67-69, 97-100). ``lax.cond`` so earlier stages skip
-    the LM-head matmul — for SmolLM a 2048x49152 matmul, ~10% of model FLOPs."""
-    if cfg.distributed.pp_size == 1:
+    pipeline_parallel.py:67-69, 97-100) — gated so earlier stages skip the
+    LM-head matmul (for SmolLM a 2048x49152 matmul, ~10% of model FLOPs)."""
+    pp = cfg.distributed.pp_size
+    if pp == 1:
         return loss_from_hidden(params, h, targets, cfg)
-    return lax.cond(
-        lax.axis_index("pp") == cfg.distributed.pp_size - 1,
-        lambda: loss_from_hidden(params, h, targets, cfg),
-        lambda: jnp.zeros((), jnp.float32),
-    )
+    if _stage_gating():
+        return lax.cond(
+            lax.axis_index("pp") == pp - 1,
+            lambda: loss_from_hidden(params, h, targets, cfg),
+            lambda: jnp.zeros((), jnp.float32),
+        )
+    loss = loss_from_hidden(params, h, targets, cfg)
+    return jnp.where(lax.axis_index("pp") == pp - 1, loss, 0.0)
 
 
 def stage_apply(params, h_recv, tokens, targets, cos, sin, cfg: Config):
@@ -331,11 +417,17 @@ def stage_fwd_save(params, h_recv, tokens, targets, cos, sin, cfg: Config):
     h = _stage_input(params, h_recv, tokens, cfg)
     s_local = tokens.shape[-1]
     cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
+    valid = layer_valid_mask(params["layers"], cfg)
 
-    def body(h, lp):
-        return decoder_layer(lp, h, cos_l, sin_l, cfg), h
-
-    h_final, layer_inputs = lax.scan(body, h, params["layers"])
+    if valid is None:
+        def body(h, lp):
+            return decoder_layer(lp, h, cos_l, sin_l, cfg), h
+        h_final, layer_inputs = lax.scan(body, h, params["layers"])
+    else:
+        def body(h, xs):
+            lp, v = xs
+            return jnp.where(v, decoder_layer(lp, h, cos_l, sin_l, cfg), h), h
+        h_final, layer_inputs = lax.scan(body, h, (params["layers"], valid))
     loss = _stage_loss(params, h_final, targets, cfg)
     # h_final IS buffered (not rederived from layer_inputs[-1] inside the
     # last-stage cond in stage_bwd): with cp>1 the rederiving decoder_layer
@@ -373,26 +465,40 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
                          h_final)
         return vjp(dloss)
 
-    d_fnorm, d_lmhead, dh_loss = lax.cond(
-        stage == pp - 1,
-        loss_vjp,
-        lambda: (jnp.zeros_like(params["final_norm"]),
-                 jnp.zeros_like(params["lm_head"]),
-                 jnp.zeros_like(h_final)),
-    )
+    if _stage_gating():
+        d_fnorm, d_lmhead, dh_loss = lax.cond(
+            stage == pp - 1,
+            loss_vjp,
+            lambda: (jnp.zeros_like(params["final_norm"]),
+                     jnp.zeros_like(params["lm_head"]),
+                     jnp.zeros_like(h_final)),
+        )
+    else:
+        # dloss is already masked to the last stage, and the vjp outputs are
+        # linear in dloss, so no further masking is needed
+        d_fnorm, d_lmhead, dh_loss = loss_vjp()
     dh = dh_out + dh_loss
 
     # ---- layers backward: reverse scan re-deriving each layer's VJP from its
-    # saved input (ys keep xs order under reverse=True)
+    # saved input (ys keep xs order under reverse=True). Pad rows of an
+    # uneven split mirror the forward's where-skip: cotangent passes through,
+    # the pad layer's grads are zeroed.
+    valid = layer_valid_mask(params["layers"], cfg)
+
     def layer_bwd(dh, xs):
-        lp, x = xs
+        lp, x, v = xs
         _, vjp = jax.vjp(lambda lp, h: decoder_layer(lp, h, cos_l, sin_l, cfg),
                          lp, x)
         dlp, dx = vjp(dh)
+        if valid is not None:
+            dlp = jax.tree.map(lambda g: jnp.where(v, g, 0), dlp)
+            dx = jnp.where(v, dx, dh)
         return dx, dlp
 
+    n_rows = jax.tree.leaves(params["layers"])[0].shape[0]
+    vmask = (jnp.ones(n_rows, bool) if valid is None else valid)
     dh, d_layers = lax.scan(layer_bwd, dh,
-                            (params["layers"], saved["layer_inputs"]),
+                            (params["layers"], saved["layer_inputs"], vmask),
                             reverse=True)
 
     # ---- embedding backward (first stage only)
@@ -401,8 +507,11 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
             lambda w: embed_lookup(w, tokens).astype(dt), params["embed"])
         return vjp(dh)[0]
 
-    d_embed = lax.cond(stage == 0, embed_vjp,
-                       lambda: jnp.zeros_like(params["embed"]))
+    if _stage_gating():
+        d_embed = lax.cond(stage == 0, embed_vjp,
+                           lambda: jnp.zeros_like(params["embed"]))
+    else:
+        d_embed = jnp.where(stage == 0, embed_vjp(), 0)
     dh_prev = jnp.where(stage == 0, jnp.zeros_like(dh), dh)
     dparams = {"embed": d_embed, "layers": d_layers,
                "final_norm": d_fnorm, "lm_head": d_lmhead}

@@ -15,9 +15,9 @@ fixes tensor_shapes once, train.py:201).
   ``jax.grad`` through the scan automatically yields the reversed
   (backward) pipeline — the transpose of ppermute is the opposite-direction
   ppermute. All-forward-then-all-backward memory (every in-flight microbatch's
-  activations stored), like the reference's AFAB (:71-72). Note: AD accumulates
-  microbatch grads in the *param dtype* — use 1F1B (fp32 accumulation) when
-  bf16 + large grad_acc; AFAB's role is the independent correctness oracle.
+  activations stored), like the reference's AFAB (:71-72). Microbatch grads
+  accumulate in float32 via the fp32-master-params cast trick (see
+  ``pipeline_afab``); AFAB's role is the independent correctness oracle.
 
 - 1F1B: a manual schedule. Each tick runs one forward microbatch and one
   backward microbatch on every stage (warmup/cooldown are masked). The
@@ -114,10 +114,27 @@ def pipeline_afab_loss(stage_fn, params, tokens, targets, pp_size, h_shape, h_dt
 
 
 def pipeline_afab(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
-    """(loss, grads) via autodiff through the forward pipeline."""
+    """(loss, grads_fp32) via autodiff through the forward pipeline.
+
+    Gradients accumulate across microbatch ticks in float32 — the reference's
+    main_grad policy (data_parallel.py:66,81) — via a dtype trick: the
+    differentiated function takes fp32 master params and casts them to the
+    compute dtype *inside* the scan body, so each tick's param cotangent is
+    cast-transposed to fp32 before the scan transpose sums it. With fp32
+    compute dtype the casts are identity and XLA removes them. Costs one
+    fp32 param copy; AFAB is the correctness oracle, 1F1B the production
+    engine."""
+    dtypes = jax.tree.map(lambda p: p.dtype, params)
+    params32 = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+
+    def cast_stage_fn(p32, h, tok, tgt):
+        p = jax.tree.map(lambda x, dt: x.astype(dt), p32, dtypes)
+        return stage_fn(p, h, tok, tgt)
+
     loss, grads = jax.value_and_grad(
-        lambda p: pipeline_afab_loss(stage_fn, p, tokens, targets, pp_size, h_shape, h_dtype)
-    )(params)
+        lambda p32: pipeline_afab_loss(cast_stage_fn, p32, tokens, targets,
+                                       pp_size, h_shape, h_dtype)
+    )(params32)
     return loss, grads
 
 
@@ -173,11 +190,13 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
             params, h_recv, _take_mb(tokens, mbf), _take_mb(targets, mbf))
         loss_acc = loss_acc + jnp.where(fvalid, loss_mb, 0.0)
         # store this microbatch's boundaries; guarded so bubble ticks can't
-        # clobber a slot still awaiting its backward
+        # clobber a slot still awaiting its backward. The select runs on the
+        # single slot (read-modify-write), not the whole buffer, so XLA can
+        # update sbuf in place instead of copying (L/pp+1) x BUF tensors.
         sbuf = jax.tree.map(
-            lambda buf, v: jnp.where(
-                fvalid, lax.dynamic_update_index_in_dim(buf, v, mbf % BUF, 0),
-                buf),
+            lambda buf, v: lax.dynamic_update_index_in_dim(
+                buf, jnp.where(fvalid, v, _take_mb(buf, mbf % BUF)),
+                mbf % BUF, 0),
             sbuf, saved)
 
         # ---- backward half-tick

@@ -97,7 +97,10 @@ def init_state(cfg: Config, topo: Topology, seed: int | None = None):
     pspecs = llama.param_pspecs(cfg.model)
     shardings = named_shardings(topo, pspecs)
     key = jax.random.PRNGKey(seed)
-    params = jax.jit(partial(llama.init_params, m=cfg.model), out_shardings=shardings)(key)
+    params = jax.jit(
+        partial(llama.init_params, m=cfg.model,
+                pp_size=cfg.distributed.pp_size),
+        out_shardings=shardings)(key)
 
     optimizer = build_optimizer(cfg)
     o_shape = jax.eval_shape(optimizer.init, params)
@@ -119,8 +122,10 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
     pspecs = llama.param_pspecs(cfg.model)
     optimizer = build_optimizer(cfg)
     o_shape = jax.eval_shape(
-        optimizer.init, jax.eval_shape(partial(llama.init_params, m=cfg.model),
-                                       jax.random.PRNGKey(0)))
+        optimizer.init,
+        jax.eval_shape(partial(llama.init_params, m=cfg.model,
+                               pp_size=cfg.distributed.pp_size),
+                       jax.random.PRNGKey(0)))
     ospecs = opt_pspecs(o_shape, pspecs)
     bspec = batch_pspec()
     cos, sin = llama.rope_tables(cfg)

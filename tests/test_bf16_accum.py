@@ -1,0 +1,31 @@
+"""bf16 + grad-accumulation: fp32 accumulation across microbatches.
+
+The reference accumulates microbatch grads in a fp32 main_grad regardless of
+the compute dtype (data_parallel.py:66,81). Both pipeline engines here do the
+same — 1F1B by construction (fp32 gacc in parallel/pp.py), AFAB via the
+fp32-master-params cast trick (pipeline_afab) — so with bf16 compute and a
+deep accumulation (acc=8) the two engines' loss trajectories must agree to
+bf16 compute noise, and training must still learn.
+"""
+
+import numpy as np
+
+from conftest import make_config
+from test_parallel import run_losses
+
+
+def test_afab_matches_1f1b_bf16_acc8(tiny_model_kwargs):
+    kw = dict(pp=2, acc=8, mbs=1, seq=32, dtype="bfloat16")
+
+    def cfg_for(engine):
+        cfg = make_config(tiny_model_kwargs, engine=engine, **kw)
+        cfg.training.learning_rate = 3e-3
+        return cfg
+
+    l_afab = run_losses(cfg_for("afab"), steps=8)
+    l_1f1b = run_losses(cfg_for("1f1b"), steps=8)
+    # bf16 compute: the engines order matmuls/reductions differently, so the
+    # tolerance is bf16-epsilon-scale, far tighter than bf16 accumulation
+    # drift over 8 microbatches would allow
+    np.testing.assert_allclose(l_afab, l_1f1b, rtol=0.02, atol=0.02)
+    assert l_afab[-1] < l_afab[0] - 0.4, f"bf16 training did not learn: {l_afab}"

@@ -159,3 +159,43 @@ def test_model_config_from_hf(tmp_path):
     got = ckpt.model_config_from_hf(str(p))
     assert got["hidden_size"] == 32 and got["rope_theta"] == 5000.0
     assert "architectures" not in got
+
+
+def test_resume_across_uneven_pp_layouts(tiny_model_kwargs, tmp_path):
+    """Save under an uneven pp=2 split (5 layers -> padded [6] stack), restore
+    under pp=1 ([5] stack) and under uneven pp=4 ([8] stack): real layer rows
+    must land in the right padded positions and training must continue."""
+    model = dict(tiny_model_kwargs, num_hidden_layers=5)
+    cfg_a = make_config(model, pp=2, acc=2, mbs=2)
+    topo_a = topology_from_config(cfg_a)
+    params_a, opt_a = ts.init_state(cfg_a, topo_a)
+    loader = MicroBatchDataLoader(cfg_a)
+    params_a, opt_a, _ = _train(cfg_a, topo_a, params_a, opt_a, loader, 2)
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(2, params_a, opt_a, trained_tokens=7, layout=(5, 2))
+
+    from picotron_tpu.models.llama import pp_layer_layout
+    K_a, _, pos_a = pp_layer_layout(5, 2)
+
+    for pp_b, acc_b, mbs_b in ((1, 1, 4), (4, 4, 1)):
+        cfg_b = make_config(model, pp=pp_b, acc=acc_b, mbs=mbs_b)
+        topo_b = topology_from_config(cfg_b)
+        params_b, opt_b = ts.init_state(cfg_b, topo_b, seed=999)
+        params_b, opt_b, step_no, tokens = mgr.load(
+            params_b, opt_b, layout=(5, pp_b))
+        assert (step_no, tokens) == (2, 7)
+
+        if pp_b == 1:
+            pos_b = list(range(5))
+        else:
+            _, _, pos_b = pp_layer_layout(5, pp_b)
+        wq_a = np.asarray(params_a["layers"]["wq"])
+        wq_b = np.asarray(params_b["layers"]["wq"])
+        np.testing.assert_array_equal(wq_b[pos_b], wq_a[pos_a])
+
+        step = ts.build_train_step(cfg_b, topo_b)
+        loader_b = MicroBatchDataLoader(cfg_b)
+        tok, tgt = ts.shard_batch(next(loader_b), topo_b)
+        _, _, loss = step(params_b, opt_b, tok, tgt)
+        assert np.isfinite(float(loss))
+    mgr.close()

@@ -2,9 +2,9 @@
 
 The 1F1B backward must stay a layer-remat backward (3x fwd per stage), never
 a whole-stage forward rebuild (4x): per docs/PP_COST.md the per-device flops
-ratio 1F1B/AFAB at pp=2, M=4 is ~1.42 for the layer-remat backward and ~2.0
-for a rebuild-based one, so the assert at 1.75 separates the two regimes
-with margin for compiler drift.
+ratio 1F1B/AFAB at pp=2, M=4 is ~1.54 for the layer-remat backward (theory
+1.60) and ~2.0 for a rebuild-based one, so the assert at 1.75 separates the
+two regimes with margin for compiler drift.
 """
 
 from conftest import make_config

@@ -1,0 +1,43 @@
+"""Uneven pipeline stage splits (reference pipeline_parallel.py:33-36).
+
+The reference hands remainder layers to the earliest stages; the SPMD
+pipeline realises the same distribution with a masked padded layer stack
+(models/llama.py::pp_layer_layout). The oracle is the same as
+test_parallel: an uneven split must reproduce the single-device loss
+trajectory exactly (same real-layer weights by construction of init_params).
+"""
+
+import numpy as np
+import pytest
+
+from conftest import make_config
+from picotron_tpu.models.llama import pp_layer_layout
+from test_parallel import run_losses
+
+
+def test_layout_matches_reference_rule():
+    # 32 layers / pp=5: reference gives 7,7,6,6,6 (remainder to earliest)
+    K, counts, positions = pp_layer_layout(32, 5)
+    assert counts == [7, 7, 6, 6, 6]
+    assert K == 7 and len(positions) == 32 and len(set(positions)) == 32
+    # stage 1's first real layer is global layer 7, at padded row K*1
+    assert positions[7] == 7
+    # stage 2 starts at global layer 14 -> padded row 2*K
+    assert positions[14] == 2 * K
+
+
+@pytest.mark.parametrize("engine", ["1f1b", "afab"])
+def test_uneven_pp_matches_single_device(tiny_model_kwargs, engine):
+    model = dict(tiny_model_kwargs, num_hidden_layers=5)
+    ref = run_losses(make_config(model, seq=32, mbs=4))
+    got = run_losses(make_config(model, pp=2, acc=2, mbs=2, seq=32,
+                                 engine=engine))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_uneven_pp4(tiny_model_kwargs):
+    # 5 layers on 4 stages: counts 2,1,1,1 -> 3 pad rows
+    model = dict(tiny_model_kwargs, num_hidden_layers=5)
+    ref = run_losses(make_config(model, seq=32, mbs=4))
+    got = run_losses(make_config(model, pp=4, acc=4, mbs=1, seq=32))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
