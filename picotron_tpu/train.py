@@ -104,18 +104,20 @@ def train(cfg, max_steps_override: Optional[int] = None):
         params, opt_state, step, trained_tokens = manager.load(
             params, opt_state, layout=layout)
         loader.skip_steps(step)
-        print(f"resumed from {c.load_path} at step {step} "
-              f"({utils.to_readable_format(trained_tokens)} tokens)")
+        utils.log0(f"resumed from {c.load_path} at step {step} "
+                   f"({utils.to_readable_format(trained_tokens)} tokens)")
         if c.load_path != c.save_dir and c.save_frequency > 0:
             manager.close()
             manager = ckpt_mod.CheckpointManager(c.save_dir)
 
-    wandb = _wandb_init(cfg) if lg.use_wandb else None
+    # wandb/log gating: only the controller process reports (reference
+    # train.py:101, utils.py:12-20)
+    wandb = _wandb_init(cfg) if (lg.use_wandb and utils.is_main_process()) else None
     n_params = llama.num_params(m)
     peak = utils.peak_flops_per_chip()
     n_chips = topo.world_size
     max_steps = max_steps_override or t.total_train_steps
-    print(f"model {m.name}: {utils.to_readable_format(n_params)} params | "
+    utils.log0(f"model {m.name}: {utils.to_readable_format(n_params)} params | "
           f"mesh dp={topo.dp_size} pp={topo.pp_size} cp={topo.cp_size} "
           f"tp={topo.tp_size} on {n_chips} x {jax.devices()[0].device_kind} | "
           f"global batch {cfg.global_batch_size} "
@@ -187,7 +189,7 @@ def train(cfg, max_steps_override: Optional[int] = None):
                     parts.append(f"MFU: {mfu:.2f}%")
                 if mem is not None:
                     parts.append(f"Memory usage: {mem:.2f}GB")
-                print(" | ".join(parts), flush=True)
+                utils.log0(" | ".join(parts), flush=True)
             if wandb is not None and step % lg.log_frequency == 0:
                 wandb.log({"loss": loss, "tokens_per_sec": tok_s,
                            "tokens_per_sec_per_chip": tok_s_chip,
@@ -226,12 +228,13 @@ def main(argv=None):
     with open(args.config) as f:
         raw = json.load(f)
     from picotron_tpu.config import Config
+    from picotron_tpu.utils import log0
 
     cfg = Config.from_dict(raw)
     _ensure_devices(cfg)
     _maybe_init_distributed()
     step, tokens, loss = train(cfg, max_steps_override=args.max_steps)
-    print(f"done: {step} steps, {tokens} tokens, final loss {loss:.4f}")
+    log0(f"done: {step} steps, {tokens} tokens, final loss {loss:.4f}")
     return 0
 
 

@@ -197,10 +197,28 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
     return jax.jit(multi, donate_argnums=(0, 1))
 
 
+def _place_global(x, sharding):
+    """Place a host numpy array carrying the GLOBAL batch onto the mesh.
+
+    Single-process: a plain device_put. Multi-process (a mesh spanning
+    hosts): ``jax.device_put`` of a host-local array against a global
+    sharding is invalid, so build the jax.Array with
+    ``jax.make_array_from_callback`` — every process holds the identical
+    global batch (the loader is deterministic: synthetic corpus or
+    identically-ordered tokenized dataset, the same every-rank-loads model
+    the reference uses, data.py:23-45), and the callback hands XLA exactly
+    the shards addressable on this process. Zero cross-host data movement;
+    replaces the reference's per-rank sampler slicing (data.py:40-45)."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
 def shard_batch(batch, topo: Topology):
     """Place a host numpy batch onto the mesh with (None, 'dp', 'cp')."""
     sh = NamedSharding(topo.mesh, batch_pspec())
-    return jax.device_put(batch["input_ids"], sh), jax.device_put(batch["target_ids"], sh)
+    return (_place_global(batch["input_ids"], sh),
+            _place_global(batch["target_ids"], sh))
 
 
 def shard_batch_stack(batches, topo: Topology):
@@ -211,4 +229,4 @@ def shard_batch_stack(batches, topo: Topology):
     sh = NamedSharding(topo.mesh, P(None, *batch_pspec()))
     toks = np.stack([b["input_ids"] for b in batches])
     tgts = np.stack([b["target_ids"] for b in batches])
-    return jax.device_put(toks, sh), jax.device_put(tgts, sh)
+    return _place_global(toks, sh), _place_global(tgts, sh)

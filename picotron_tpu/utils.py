@@ -23,6 +23,21 @@ TPU_PEAK_FLOPS = {
 H100_PEAK_FLOPS = 989.5e12  # the reference's denominator (utils.py:42)
 
 
+def is_main_process() -> bool:
+    """True on the controller process that should own logging/wandb/metadata
+    (the reference gates prints on global rank 0 via an fcntl lock,
+    utils.py:12-20, and wandb on wandb_rank, train.py:101). Collective-side
+    work (orbax saves, the train step itself) must NOT be gated — every
+    process participates there."""
+    return jax.process_index() == 0
+
+
+def log0(*args, **kwargs) -> None:
+    """print() on process 0 only — the multi-host log gate."""
+    if is_main_process():
+        print(*args, **kwargs)
+
+
 def on_tpu() -> bool:
     """Trace-time backend check gating the Pallas (Mosaic) fast paths: only
     an actual TPU backend qualifies — GPU must not be routed into kernels
@@ -66,14 +81,21 @@ def set_all_seed(seed: int) -> None:
 
 
 def device_memory_gb(device=None) -> float | None:
-    """Live bytes on device (the reference logs torch.cuda.memory_reserved,
-    train.py:257)."""
-    device = device or jax.devices()[0]
-    try:
-        stats = device.memory_stats()
-        return stats.get("bytes_in_use", 0) / 1e9
-    except Exception:
-        return None
+    """Peak live bytes across this process's devices (the reference logs
+    torch.cuda.memory_reserved of the local rank, train.py:257). Max, not
+    device 0: pp/tp shards can differ in footprint and the max is what OOMs."""
+    devices = [device] if device is not None else jax.local_devices()
+    best = None
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            continue
+        if stats:
+            b = stats.get("peak_bytes_in_use",
+                          stats.get("bytes_in_use", 0)) / 1e9
+            best = b if best is None else max(best, b)
+    return best
 
 
 def collective_scan_unroll():

@@ -1,0 +1,65 @@
+"""Worker for tests/test_multihost.py: one JAX process of a 2-process CPU
+"pod" (4 virtual devices each, 8 global). Runs the real library path —
+jax.distributed.initialize, global mesh over all 8 devices, shard_batch's
+multi-process placement, the jitted 4D train step — and writes its loss
+trajectory (and which processes printed) to a JSON file.
+
+Usage: python multihost_worker.py <process_id> <port> <out_json>
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid, port, out = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}", num_processes=2,
+        process_id=pid)
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    from picotron_tpu import train_step as ts
+    from picotron_tpu import utils
+    from picotron_tpu.config import Config
+    from picotron_tpu.data import MicroBatchDataLoader
+    from picotron_tpu.topology import topology_from_config
+
+    cfg = Config.from_dict({
+        # dp is the outermost mesh axis, so dp=0 lives on process 0 and dp=1
+        # on process 1 — the grad pmean crosses the process boundary, like dp
+        # over DCN on a real pod
+        "distributed": {"dp_size": 2, "cp_size": 2, "tp_size": 2,
+                        "use_cpu": True},
+        "model": dict(num_hidden_layers=4, num_attention_heads=8,
+                      num_key_value_heads=4, hidden_size=64,
+                      intermediate_size=128, vocab_size=256,
+                      max_position_embeddings=128, dtype="float32",
+                      attention_impl="sdpa"),
+        "training": {"seq_length": 32, "micro_batch_size": 4,
+                     "gradient_accumulation_steps": 1, "learning_rate": 1e-3,
+                     "remat": "none"},
+        "dataset": {"name": "synthetic"},
+    })
+    topo = topology_from_config(cfg)
+    params, opt_state = ts.init_state(cfg, topo)
+    step = ts.build_train_step(cfg, topo)
+    loader = MicroBatchDataLoader(cfg)
+    losses = []
+    for _ in range(4):
+        tokens, targets = ts.shard_batch(next(loader), topo)
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(jax.block_until_ready(loss)))
+
+    with open(out, "w") as f:
+        json.dump({"process": pid, "losses": losses,
+                   "is_main": utils.is_main_process()}, f)
+
+
+if __name__ == "__main__":
+    main()
