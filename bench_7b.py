@@ -1,0 +1,82 @@
+"""Llama-2-7B-geometry proxy benchmark on the available chip(s).
+
+The reference's second headline is 38% MFU training Llama-2-7B on 8xH100
+(reference README.md:7; BASELINE ladder configs 4-5). A full 7B with
+optimizer state does not fit one 16 GB v5e chip, so this benches a *proxy*
+with the exact 7B layer geometry (hidden 4096, intermediate 11008, 32 heads,
+vocab 32000, seq 4096, remat=full, fused linear+CE) and as many layers as
+fit. Per-layer math, kernel shapes, and memory behavior match the real
+model; MFU is computed against the proxy's own parameter count, which
+*understates* the full-model MFU slightly (the LM head is amortized over
+fewer layers).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} with
+vs_baseline = mfu / 38. Executed results are committed in docs/BENCH_7B.md.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+LLAMA2_7B_GEOM = dict(
+    name="meta-llama/Llama-2-7b (proxy geometry)",
+    num_attention_heads=32, num_key_value_heads=32, hidden_size=4096,
+    intermediate_size=11008, vocab_size=32000, max_position_embeddings=4096,
+    dtype="bfloat16", attention_impl="auto",
+)
+
+
+def proxy_cfg(layers: int, mbs: int, seq: int, on_tpu: bool):
+    from picotron_tpu.config import Config
+
+    model = dict(LLAMA2_7B_GEOM, num_hidden_layers=layers)
+    if not on_tpu:  # CPU smoke: shrink everything
+        model.update(num_hidden_layers=2, hidden_size=256,
+                     intermediate_size=688, vocab_size=1024,
+                     num_attention_heads=4, num_key_value_heads=4,
+                     dtype="float32", attention_impl="sdpa",
+                     max_position_embeddings=512)
+        seq, mbs = 128, 2
+    return Config.from_dict({
+        "distributed": {"dp_size": 1, "pp_size": 1, "cp_size": 1, "tp_size": 1},
+        "model": model,
+        "training": {"seq_length": seq, "micro_batch_size": mbs,
+                     "gradient_accumulation_steps": 1, "remat": "full",
+                     "grad_accum_dtype": "param", "learning_rate": 3e-4},
+        "dataset": {"name": "synthetic"},
+    })
+
+
+def main():
+    from bench import run_descending
+    from picotron_tpu.models import llama
+    from picotron_tpu.utils import get_mfu, on_tpu, peak_flops_per_chip
+
+    tpu = on_tpu()
+    cfg, tok_s = run_descending(
+        ((8, 2), (8, 1), (6, 1), (4, 1)) if tpu else ((2, 2),),
+        lambda lm: proxy_cfg(lm[0], lm[1], 4096, tpu),
+        tag="bench_7b", calls=4, warmup=1, steps_per_call=8)
+
+    m = cfg.model
+    n_params = llama.num_params(m)
+    peak = peak_flops_per_chip()
+    if peak is None:
+        print(json.dumps({"metric": "llama2_7b_proxy_tokens_per_sec_cpu_smoke",
+                          "value": round(tok_s, 1), "unit": "tokens/s",
+                          "vs_baseline": 0.0}))
+        return
+    mfu = get_mfu(tok_s, n_params, m.num_hidden_layers, m.hidden_size,
+                  cfg.training.seq_length, peak)
+    print(json.dumps({"metric": "llama2_7b_proxy_mfu_1chip",
+                      "value": round(mfu, 2), "unit": "%",
+                      "vs_baseline": round(mfu / 38.0, 3)}))
+    print(f"# layers={m.num_hidden_layers} mbs={cfg.training.micro_batch_size} "
+          f"seq={cfg.training.seq_length} tokens/s/chip={tok_s:.0f} "
+          f"params={n_params/1e9:.2f}B peak={peak/1e12:.0f}TF",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

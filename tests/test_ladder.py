@@ -1,0 +1,92 @@
+"""Sweep-ladder integration: configs 4/5 (the Llama-2-7B topologies) scaled
+down, end-to-end through the experiment tooling (round-2 VERDICT item 3b/5).
+
+This is the reference's primary integration story — submit_slurm_jobs.py
+walking experiment dirs -> train -> extract_metrics.py summarizing logs
+(reference submit_slurm_jobs.py:68-113, extract_metrics.py:108-195) — run
+for real: the scheduler's local backend launches `python -m
+picotron_tpu.train` subprocesses on the 8-virtual-device CPU mesh with the
+ladder's exact parallel topology (config 4's dp is halved, 16 devices -> 8),
+a tiny model standing in for the 7B geometry, and the metrics extractor
+parses the produced logs into the sweep CSV.
+"""
+
+import csv
+import json
+import os
+
+from picotron_tpu.tools.extract_metrics import extract
+from picotron_tpu.tools.submit_jobs import Scheduler, Status
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY_7B_STANDIN = dict(
+    num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=8,
+    hidden_size=64, intermediate_size=176,  # 11008/4096 ratio ~ 2.69
+    vocab_size=256, max_position_embeddings=8192,
+)
+
+
+def _scaled_ladder_cfg(src_path: str, run_name: str, seq: int) -> dict:
+    with open(src_path) as f:
+        raw = json.load(f)
+    raw["distributed"]["dp_size"] = min(raw["distributed"]["dp_size"], 8 // (
+        raw["distributed"]["tp_size"] * raw["distributed"]["pp_size"]
+        * raw["distributed"]["cp_size"]))
+    raw["distributed"]["use_cpu"] = True
+    raw["model"].update(TINY_7B_STANDIN, dtype="float32",
+                        attention_impl="sdpa")
+    raw["training"].update(seq_length=seq, total_train_steps=6,
+                           learning_rate=1e-3)
+    raw["logging"]["run_name"] = run_name
+    return raw
+
+
+def test_ladder_configs_through_sweep_tooling(tmp_path):
+    sweep = tmp_path / "sweep"
+    specs = [
+        # (source ladder config, run dir name, scaled seq)
+        ("configs/4_llama2_7b_dp4_tp2_pp2_sl1024/config.json",
+         "l4_dp2_tp2_pp2_cp1_mbs1_ga8_sl64", 64),
+        ("configs/5_llama2_7b_4d_sl8192/config.json",
+         "l5_dp1_tp2_pp2_cp2_mbs1_ga4_sl64", 64),
+    ]
+    for src, name, seq in specs:
+        d = sweep / name
+        d.mkdir(parents=True)
+        with open(d / "config.json", "w") as f:
+            json.dump(_scaled_ladder_cfg(os.path.join(REPO, src), name, seq), f)
+
+    # Run both experiments via the scheduler's local backend. The subprocesses
+    # must not inherit this test process's 8-device CPU pinning — the configs
+    # carry use_cpu and the trainer pins its own device count.
+    env_backup = {k: os.environ.pop(k, None)
+                  for k in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    try:
+        sched = Scheduler(str(sweep), backend="local")
+        assert len(sched.jobs) == 2
+        sched.submit(timeout_s=500)
+    finally:
+        for k, v in env_backup.items():
+            if v is not None:
+                os.environ[k] = v
+
+    for job in sched.jobs:
+        log = open(job.log_path, errors="replace").read()
+        assert job.status is Status.COMPLETED, f"{job.name}:\n{log[-2000:]}"
+
+    # extract_metrics over the sweep -> per-run metrics.csv + global CSV with
+    # parsed topology columns and a decreasing loss
+    rows = extract(str(sweep))
+    assert len(rows) == 2
+    by_run = {r["run"]: r for r in rows}
+    r4 = by_run["l4_dp2_tp2_pp2_cp1_mbs1_ga8_sl64"]
+    assert (r4["dp"], r4["tp"], r4["pp"], r4["cp"]) == (2, 2, 2, 1)
+    r5 = by_run["l5_dp1_tp2_pp2_cp2_mbs1_ga4_sl64"]  # dp 2->1: 16 devices -> 8
+    assert (r5["dp"], r5["tp"], r5["pp"], r5["cp"]) == (1, 2, 2, 2)
+    for r in rows:
+        assert r["final_loss"] < 5.6  # below ln(256): it learned
+        assert r["tokens_per_sec"] and r["tokens_per_sec"] > 0
+    assert os.path.exists(sweep / "global_metrics.csv")
+    with open(sweep / "global_metrics.csv") as f:
+        assert len(list(csv.DictReader(f))) == 2

@@ -37,11 +37,17 @@ def test_two_process_matches_single_process(tmp_path, cfg_factory):
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, str(i), str(port), outs[i]],
-            env=env, cwd=os.path.dirname(os.path.dirname(WORKER)),
+            env=env, cwd=repo_root,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)
     ]
-    logs = [p.communicate(timeout=540)[0] for p in procs]
+    try:
+        logs = [p.communicate(timeout=540)[0] for p in procs]
+    finally:
+        for p in procs:  # a rendezvous hang must not leak workers
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     for i, p in enumerate(procs):
         assert p.returncode == 0, f"worker {i} failed:\n{logs[i][-3000:]}"
 

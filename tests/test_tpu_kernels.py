@@ -1,0 +1,116 @@
+"""Real-TPU Pallas kernel parity vs the XLA oracles (round-2 VERDICT item 4).
+
+The rest of the suite validates the kernels in Mosaic interpret mode on CPU;
+here the compiled kernels run on an actual TPU chip. Skipped unless the
+backend is TPU — run with ``PICOTRON_TEST_TPU=1 python -m pytest
+tests/test_tpu_kernels.py`` (conftest then leaves the platform alone), which
+is what ``bench.py`` invokes as its pre-flight parity gate so the driver's
+bench environment executes these on hardware.
+
+bf16 inputs (the production dtype), fp32 tolerances sized to bf16 resolution:
+the oracle computes the same math through XLA einsums with fp32 softmax
+statistics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="needs a real TPU backend")
+
+B, S, H, D = 2, 1024, 4, 64
+SCALE = 0.125
+
+
+def _qkv(dtype, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, S, H, D), jnp.float32).astype(dtype)
+                 for k in ks)
+
+
+def test_flash_forward_matches_sdpa_on_tpu():
+    from picotron_tpu.ops.attention import sdpa
+    from picotron_tpu.ops.pallas.flash_attention import flash_attention
+
+    q, k, v = _qkv(jnp.bfloat16)
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, SCALE))(q, k, v)
+    ref = jax.jit(lambda q, k, v: sdpa(q, k, v, SCALE, causal=True))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_flash_grads_match_sdpa_on_tpu():
+    from picotron_tpu.ops.attention import sdpa
+    from picotron_tpu.ops.pallas.flash_attention import flash_attention
+
+    q, k, v = _qkv(jnp.bfloat16, seed=1)
+
+    def loss(attn):
+        def f(q, k, v):
+            o = attn(q, k, v)
+            return (o.astype(jnp.float32) ** 2).mean()
+        return f
+
+    g_flash = jax.jit(jax.grad(loss(
+        lambda q, k, v: flash_attention(q, k, v, SCALE)), argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss(
+        lambda q, k, v: sdpa(q, k, v, SCALE, causal=True)), argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=3e-2, atol=3e-2, err_msg=f"d{name}")
+
+
+def test_flash_block_grads_match_einsum_on_tpu():
+    """The ring-attention building block: block backward fed out/lse must
+    match AD through the einsum block on the chip (full-attend block, the
+    ring's off-diagonal case)."""
+    from picotron_tpu.ops.attention import block_attention
+    from picotron_tpu.ops.pallas.flash_attention import (
+        flash_attention_with_lse, flash_block_grads)
+
+    q, k, v = _qkv(jnp.bfloat16, seed=2)
+    out, lse = jax.jit(lambda q, k, v: flash_attention_with_lse(
+        q, k, v, SCALE, causal=False))(q, k, v)
+    do = jax.random.normal(jax.random.PRNGKey(3), out.shape,
+                           jnp.float32).astype(out.dtype)
+    dq, dk, dv = jax.jit(lambda q, k, v, o, l, do: flash_block_grads(
+        q, k, v, o, l, do, SCALE, causal=False))(q, k, v, out, lse, do)
+
+    def ref_f(q, k, v):
+        o, _ = block_attention(q, k, v, SCALE, mask=None)  # full-attend block
+        return (o.astype(jnp.float32) * do.astype(jnp.float32)).sum()
+
+    rq, rk, rv = jax.jit(jax.grad(ref_f, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip((dq, dk, dv), (rq, rk, rv), "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=3e-2, atol=3e-2, err_msg=f"d{name}")
+
+
+def test_rmsnorm_matches_oracle_on_tpu():
+    from picotron_tpu.ops.rmsnorm import rms_norm
+    from picotron_tpu.ops.pallas.rmsnorm import rms_norm_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (4, 512, 2048),
+                          jnp.float32).astype(jnp.bfloat16)
+    w = (1.0 + 0.1 * jax.random.normal(jax.random.PRNGKey(5), (2048,),
+                                       jnp.float32)).astype(jnp.bfloat16)
+    y = jax.jit(lambda x, w: rms_norm_pallas(x, w, 1e-5))(x, w)
+    ref = jax.jit(lambda x, w: rms_norm(x, w, 1e-5))(x, w)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+    def f(norm):
+        return lambda x, w: (norm(x, w, 1e-5).astype(jnp.float32) ** 2).mean()
+
+    gx, gw = jax.jit(jax.grad(f(rms_norm_pallas), argnums=(0, 1)))(x, w)
+    rx, rw = jax.jit(jax.grad(f(rms_norm), argnums=(0, 1)))(x, w)
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx, np.float32), rtol=3e-2, atol=3e-2)
+    np.testing.assert_allclose(np.asarray(gw, np.float32),
+                               np.asarray(rw, np.float32), rtol=3e-2, atol=3e-2)
