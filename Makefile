@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test bench clean
+.PHONY: native test test-isolated bench clean
 
 native: $(NATIVE_SO)
 
@@ -12,6 +12,15 @@ $(NATIVE_SO): $(NATIVE_SRC)
 
 test: native
 	python -m pytest tests/ -x -q
+
+# One pytest process per test file: the XLA CPU runtime's in-process
+# collective rendezvous can abort the interpreter on rare races, and process
+# isolation keeps one crash from taking down the rest of the suite.
+test-isolated: native
+	@fail=0; for f in tests/test_*.py; do \
+	  echo "== $$f"; \
+	  python -m pytest "$$f" -q || fail=1; \
+	done; exit $$fail
 
 bench: native
 	python bench.py

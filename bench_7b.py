@@ -49,11 +49,19 @@ def proxy_cfg(layers: int, mbs: int, seq: int, on_tpu: bool):
 
 
 def main():
-    from bench import run_descending
+    from bench import kernel_parity_preflight, run_descending
+
+    parity = kernel_parity_preflight()  # before the parent holds the chip
     from picotron_tpu.models import llama
     from picotron_tpu.utils import get_mfu, on_tpu, peak_flops_per_chip
 
     tpu = on_tpu()
+    if tpu:
+        if "passed" not in parity or "skipped" in parity:
+            raise SystemExit(
+                f"parent backend is TPU but the kernel parity preflight did "
+                f"not run on TPU: {parity!r}")
+        print(f"# TPU kernel parity: {parity}", file=sys.stderr)
     cfg, tok_s = run_descending(
         ((8, 2), (8, 1), (6, 1), (4, 1)) if tpu else ((2, 2),),
         lambda lm: proxy_cfg(lm[0], lm[1], 4096, tpu),

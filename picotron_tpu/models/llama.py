@@ -520,7 +520,16 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
 
 def forward_logits(params, tokens, cfg: Config, gather: bool = True):
     """Whole-model forward to logits (no pipeline), for eval/tests. Runs inside
-    shard_map; with a 1-device mesh this is the plain single-chip model."""
+    shard_map; with a 1-device mesh this is the plain single-chip model.
+
+    Zigzag layout contract: when ``cfg.distributed.cp_zigzag`` is set, the
+    RoPE tables and causal masks follow the zigzag *data* layout, so
+    ``tokens`` must already be permuted the way the training loader permutes
+    them (``parallel.cp.zigzag_perm`` applied to the sequence axis), and the
+    returned logits are in that same permuted order — apply
+    ``parallel.cp.zigzag_inverse_perm`` to the sequence axis to get
+    original-order logits. Feeding original-order tokens with cp_zigzag set
+    silently computes with wrong positions/masks."""
     cos, sin = rope_tables(cfg)
     dt = jnp.dtype(cfg.model.dtype)
     h = embed_lookup(params["embed"], tokens).astype(dt)

@@ -71,6 +71,19 @@ def zigzag_perm(seq_length: int, n: int) -> "np.ndarray":
     return np.asarray(order, dtype=np.int64)
 
 
+def zigzag_inverse_perm(seq_length: int, n: int) -> "np.ndarray":
+    """Inverse of ``zigzag_perm``: maps zigzag-layout sequence arrays back to
+    original token order — ``arr_orig = arr_zig[..., inv]``. Use on per-token
+    outputs (e.g. ``forward_logits`` of a zigzag-fed model) before comparing
+    against original-order references."""
+    import numpy as np
+
+    perm = zigzag_perm(seq_length, n)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_length, dtype=np.int64)
+    return inv
+
+
 def _block_mask(s_q: int, s_k: int, src, rank, causal: bool, n: int,
                 zigzag: bool):
     """True = attend: global position of query >= global position of key.

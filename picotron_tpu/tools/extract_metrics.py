@@ -34,12 +34,15 @@ def from_readable_format(s: str) -> float:
 
 def parse_folder_name(folder_name: str) -> dict:
     """Pull topology numbers out of a run-dir name (reference :8-23), with a
-    'cp' field added since CP is part of this framework's sweep axis set."""
+    'cp' field added since CP is part of this framework's sweep axis set.
+    Keys are anchored so one token can't match inside another (e.g. the 'p2'
+    of 'warmup2' never reads as pp=2, 'sl' never matches inside 'mbsl...')."""
     out = {}
     for key, col in (("dp", "dp"), ("tp", "tp"), ("pp", "pp"), ("cp", "cp"),
                      ("mbs", "micro_batch_size"), ("ga", "grad_acc"),
                      ("sl", "seq_len")):
-        m = re.search(rf"{key}(\d+)", folder_name)
+        m = re.search(rf"(?<![a-z0-9]){key}(\d+)(?![a-z0-9])",
+                      folder_name.lower())
         out[col] = int(m.group(1)) if m else None
     return out
 

@@ -76,3 +76,35 @@ def test_grad_accumulation_matches_large_batch(cfg_factory):
     assert abs(float(loss_a) - float(loss_b)) < 1e-5
     for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_forward_logits_zigzag_layout_roundtrip(cfg_factory):
+    """The documented zigzag contract: zigzag-permuted tokens through a
+    cp_zigzag forward, logits un-permuted with zigzag_inverse_perm, must
+    match the plain single-device forward on the original tokens."""
+    from jax.sharding import PartitionSpec as P
+
+    from picotron_tpu.parallel.cp import zigzag_inverse_perm, zigzag_perm
+
+    seq = 32
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (2, seq)), jnp.int32)
+
+    def logits_for(cfg, toks):
+        topo = topology_from_config(cfg)
+        params, _ = ts.init_state(cfg, topo)
+        fwd = jax.jit(jax.shard_map(
+            lambda p, t: llama.forward_logits(p, t, cfg),
+            mesh=topo.mesh,
+            in_specs=(llama.param_pspecs(cfg.model), P(None, "cp")),
+            out_specs=P(None, "cp"),
+            check_vma=False))
+        return np.asarray(fwd(params, toks))
+
+    ref = logits_for(cfg_factory(seq=seq, mbs=2), tokens)
+
+    cfg_z = cfg_factory(cp=2, zigzag=True, seq=seq, mbs=2)
+    perm = zigzag_perm(seq, 2)
+    inv = zigzag_inverse_perm(seq, 2)
+    zig = logits_for(cfg_z, tokens[:, perm])
+    np.testing.assert_allclose(zig[:, inv], ref, rtol=2e-5, atol=2e-5)

@@ -212,3 +212,21 @@ def test_root_shims_importable():
          "extract_metrics; print('ok')"],
         capture_output=True, text=True)
     assert out.stdout.strip() == "ok", out.stderr
+
+
+def test_parse_folder_name_anchored():
+    """Keys must not match inside other tokens (round-1 ADVICE: undelimited
+    dp(\\d+) regexes mislabel sweep rows)."""
+    from picotron_tpu.tools.extract_metrics import parse_folder_name
+
+    got = parse_folder_name("smollm_dp2_tp4_pp2_cp1_mbs1_ga8_sl2048")
+    assert (got["dp"], got["tp"], got["pp"], got["cp"]) == (2, 4, 2, 1)
+    assert (got["micro_batch_size"], got["grad_acc"], got["seq_len"]) == (1, 8, 2048)
+    # 'warmup3' must not read as pp=3; 'setup2' must not read as tp=2;
+    # 'speedup9' must not poison anything
+    got = parse_folder_name("warmup3_setup2_speedup9_dp4")
+    assert got["dp"] == 4
+    assert got["pp"] is None and got["tp"] is None
+    # no topology tokens at all
+    got = parse_folder_name("baseline_run")
+    assert all(v is None for v in got.values())
