@@ -19,16 +19,17 @@ fixes tensor_shapes once, train.py:201).
   accumulate in float32 via the fp32-master-params cast trick (see
   ``pipeline_afab``); AFAB's role is the independent correctness oracle.
 
-- 1F1B: a manual schedule. Each tick runs one forward microbatch and one
-  backward microbatch on every stage (warmup/cooldown are masked). The
-  forward saves each microbatch's layer-boundary activations into an O(pp)
-  ring buffer (the 1F1B memory win, reference :86); the backward re-derives
-  each *layer's* VJP from its saved input — layer-granular remat, one layer
-  forward recompute + backward, no whole-stage forward rebuild (see
-  docs/PP_COST.md). Gradients accumulate in float32, the reference's
-  main_grad dtype policy (data_parallel.py:66,81); the last microbatch's psum
-  happens outside, matching require_backward_grad_sync-on-last-micro
-  (train.py:40-41).
+- 1F1B: a manual phase-split schedule — (pp-1) forward-only warmup ticks,
+  M full (one-forward-one-backward) ticks, (pp-1) backward-only cooldown
+  ticks, so bubble ticks never execute a masked half and the critical path
+  is standard non-interleaved 1F1B. The forward saves each microbatch's
+  layer-boundary activations into an O(pp) ring buffer (the 1F1B memory
+  win, reference :86); the backward re-derives each *layer's* VJP from its
+  saved input — layer-granular remat, one layer forward recompute +
+  backward, no whole-stage forward rebuild (see docs/PP_COST.md). Gradients
+  accumulate in float32, the reference's main_grad dtype policy
+  (data_parallel.py:66,81); the last microbatch's psum happens outside,
+  matching require_backward_grad_sync-on-last-micro (train.py:40-41).
 
 With pp_size == 1 both schedules degenerate to the plain gradient-accumulation
 loop over microbatches (the reference's non-PP train_step, train.py:29-55).
@@ -155,10 +156,11 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
     matching slot to ``stage_bwd`` — a manual backward that re-derives each
     *layer's* VJP from its saved input. A steady-state tick therefore costs
     one stage forward + one layer-remat stage backward (≈ 3x fwd FLOPs),
-    never a whole-stage forward rebuild; see docs/PP_COST.md for the measured
-    FLOP accounting. This is the reference's residual-saving backward
-    (pipeline_parallel.py:46-52) re-done at layer-checkpoint granularity,
-    which is what a 7B-class model needs on TPU HBM anyway.
+    never a whole-stage forward rebuild, and warmup/cooldown ticks execute
+    only their live half (phase split below); see docs/PP_COST.md for the
+    measured FLOP accounting. This is the reference's residual-saving
+    backward (pipeline_parallel.py:46-52) re-done at layer-checkpoint
+    granularity, which is what a 7B-class model needs on TPU HBM anyway.
 
     stage_fwd(params, h_recv, tok, tgt) -> (h_out, loss, saved)
     stage_bwd(params, saved, tok, tgt, dh_out, dloss) -> (dparams, dh_prev)
@@ -166,7 +168,6 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
     M = tokens.shape[0]
     s = lax.axis_index("pp")
     is_last = s == pp_size - 1
-    T = M + 2 * (pp_size - 1)
     BUF = 2 * pp_size - 1  # max in-flight microbatches = 2*pp - 2 - 2*s < BUF
     down, up = _down_perm(pp_size), _up_perm(pp_size)
 
@@ -179,10 +180,8 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
     sbuf0 = jax.tree.map(
         lambda sh: jnp.zeros((BUF,) + tuple(sh.shape), sh.dtype), saved_shape)
 
-    def tick(carry, t):
+    def fwd_half(carry, t):
         h_recv, dh_recv, sbuf, gacc, loss_acc = carry
-
-        # ---- forward half-tick
         mb_f = t - s
         fvalid = (mb_f >= 0) & (mb_f < M)
         mbf = jnp.clip(mb_f, 0, M - 1)
@@ -198,8 +197,11 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
                 buf, jnp.where(fvalid, v, _take_mb(buf, mbf % BUF)),
                 mbf % BUF, 0),
             sbuf, saved)
+        h_next = lax.ppermute(h_out, "pp", down) if down else jnp.zeros_like(h_out)
+        return (h_next, dh_recv, sbuf, gacc, loss_acc)
 
-        # ---- backward half-tick
+    def bwd_half(carry, t):
+        h_recv, dh_recv, sbuf, gacc, loss_acc = carry
         mb_b = t - (2 * pp_size - 2 - s)
         bvalid = (mb_b >= 0) & (mb_b < M)
         mbb = jnp.clip(mb_b, 0, M - 1)
@@ -211,15 +213,34 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
         gacc = jax.tree.map(
             lambda a, g: a + jnp.where(bvalid, g, 0).astype(jnp.float32), gacc, dparams
         )
-
-        # ---- wire crossings (reference pp_communications.py:34-46 fused
-        # send-fwd/recv-bwd pairs; here XLA schedules both permutes together)
-        h_next = lax.ppermute(h_out, "pp", down) if down else jnp.zeros_like(h_out)
         dh_next = lax.ppermute(dh_prev, "pp", up) if up else jnp.zeros_like(dh_prev)
-        return (h_next, dh_next, sbuf, gacc, loss_acc), None
+        return (h_recv, dh_next, sbuf, gacc, loss_acc)
 
-    carry0 = (h0, jnp.zeros(h_shape, h_dtype), sbuf0, gacc0, jnp.float32(0.0))
-    (h, dh, sbuf, gacc, loss_acc), _ = lax.scan(tick, carry0, jnp.arange(T),
-                                                unroll=collective_scan_unroll())
+    # Three phases so bubble ticks never execute a masked half (a masked
+    # backward costs 3x a forward). No stage backwards before tick pp-1 and
+    # none forwards after tick M+pp-2, so the split is stage-uniform:
+    #   warmup   ticks [0, pp-2]:          forward half only
+    #   steady   ticks [pp-1, M+pp-2]:     forward + backward
+    #   cooldown ticks [M+pp-1, M+2pp-3]:  backward half only
+    # Total critical path = (pp-1) fwd + M (fwd+bwd) + (pp-1) bwd — standard
+    # non-interleaved 1F1B (docs/PP_COST.md). The wire crossings match the
+    # reference's fused send-fwd/recv-bwd pairs (pp_communications.py:34-46);
+    # XLA schedules the two permutes of a steady tick together.
+    def scan_phase(carry, ticks, body):
+        if len(ticks) == 0:
+            return carry
+        out, _ = lax.scan(lambda c, t: (body(c, t), None), carry,
+                          jnp.asarray(ticks), unroll=collective_scan_unroll())
+        return out
+
+    def full_tick(carry, t):
+        return bwd_half(fwd_half(carry, t), t)
+
+    carry = (h0, jnp.zeros(h_shape, h_dtype), sbuf0, gacc0, jnp.float32(0.0))
+    carry = scan_phase(carry, range(pp_size - 1), fwd_half)
+    carry = scan_phase(carry, range(pp_size - 1, M + pp_size - 1), full_tick)
+    carry = scan_phase(carry, range(M + pp_size - 1, M + 2 * pp_size - 2),
+                       bwd_half)
+    loss_acc, gacc = carry[4], carry[3]
     loss = lax.psum(loss_acc, "pp") / M
     return loss, gacc
