@@ -108,3 +108,21 @@ def test_forward_logits_zigzag_layout_roundtrip(cfg_factory):
     inv = zigzag_inverse_perm(seq, 2)
     zig = logits_for(cfg_z, tokens[:, perm])
     np.testing.assert_allclose(zig[:, inv], ref, rtol=2e-5, atol=2e-5)
+
+
+def test_remat_modes_do_not_change_math(cfg_factory):
+    """remat trades memory for recompute; all three modes must produce the
+    identical loss trajectory (fp32, sdpa path: save_attn's checkpoint names
+    simply match nothing and degrade to full)."""
+    from test_parallel import run_losses
+
+    ref = None
+    for remat in ("none", "full", "save_attn"):
+        cfg = cfg_factory(seq=32, mbs=4)
+        cfg.training.remat = remat
+        got = run_losses(cfg, steps=4)
+        if ref is None:
+            ref = got
+        else:
+            np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6,
+                                       err_msg=f"remat={remat}")
