@@ -99,6 +99,7 @@ def create_single_config(
     *,
     tp: int = 1, cp: int = 1, dp: int = 1, pp: int = 1,
     pp_engine: str = "1f1b",
+    cp_zigzag: Optional[bool] = None,
     model_name: str = "HuggingFaceTB/SmolLM-360M-Instruct",
     num_hidden_layers: Optional[int] = None,
     num_attention_heads: Optional[int] = None,
@@ -116,6 +117,8 @@ def create_single_config(
     learning_rate: Optional[float] = None,
     total_train_steps: Optional[int] = None,
     seed: Optional[int] = None,
+    remat: Optional[str] = None,
+    steps_per_call: Optional[int] = None,
     template_path: str = TEMPLATE_PATH,
     exist_ok: bool = False,
 ) -> str:
@@ -126,6 +129,8 @@ def create_single_config(
     d = content["distributed"]
     d.update(tp_size=tp, cp_size=cp, dp_size=dp, pp_size=pp,
              pp_engine=pp_engine, use_cpu=use_cpu)
+    if cp_zigzag is not None:  # None = keep the template's value
+        d["cp_zigzag"] = cp_zigzag
 
     m = content["model"]
     m["name"] = model_name
@@ -154,6 +159,10 @@ def create_single_config(
         t["total_train_steps"] = total_train_steps
     if seed is not None:
         t["seed"] = seed
+    if remat is not None:
+        t["remat"] = remat
+    if steps_per_call is not None:
+        t["steps_per_call"] = steps_per_call
 
     if dataset_name is not None:
         content["dataset"]["name"] = dataset_name
@@ -188,6 +197,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--pp_engine", type=str, default="1f1b")
+    p.add_argument("--cp_zigzag", action="store_true", default=None,
+                   help="load-balanced zigzag context-parallel layout")
     p.add_argument("--model_name", type=str,
                    default="HuggingFaceTB/SmolLM-360M-Instruct")
     p.add_argument("--num_hidden_layers", type=int, default=None)
@@ -205,6 +216,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--total_train_steps", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--remat", type=str, default=None,
+                   choices=("none", "full", "save_attn"))
+    p.add_argument("--steps_per_call", type=int, default=None,
+                   help="optimizer steps fused per device dispatch")
     p.add_argument("--use_wandb", action="store_true")
     p.add_argument("--use_cpu", action="store_true")
     p.add_argument("--template", type=str, default=TEMPLATE_PATH)
@@ -221,7 +236,8 @@ def main(argv=None) -> int:
     path = create_single_config(
         out_dir=args.out_dir, exp_name=args.exp_name,
         tp=args.tp, cp=args.cp, dp=args.dp, pp=args.pp,
-        pp_engine=args.pp_engine, model_name=args.model_name,
+        pp_engine=args.pp_engine, cp_zigzag=args.cp_zigzag,
+        model_name=args.model_name,
         num_hidden_layers=args.num_hidden_layers,
         num_attention_heads=args.num_attention_heads,
         num_key_value_heads=args.num_key_value_heads,
@@ -232,7 +248,8 @@ def main(argv=None) -> int:
         dataset_name=args.dataset_name, subset_name=args.subset_name,
         use_wandb=args.use_wandb, use_cpu=args.use_cpu,
         learning_rate=args.lr, total_train_steps=args.total_train_steps,
-        seed=args.seed, template_path=args.template, exist_ok=args.overwrite,
+        seed=args.seed, remat=args.remat, steps_per_call=args.steps_per_call,
+        template_path=args.template, exist_ok=args.overwrite,
     )
     print(f"config created: {path}")
     if args.download:

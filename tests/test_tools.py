@@ -230,3 +230,20 @@ def test_parse_folder_name_anchored():
     # no topology tokens at all
     got = parse_folder_name("baseline_run")
     assert all(v is None for v in got.values())
+
+
+def test_create_config_round3_flags(tmp_path):
+    """cp_zigzag / remat / steps_per_call are reachable from the generator
+    CLI surface."""
+    from picotron_tpu.tools.create_config import main as cc_main
+
+    rc = cc_main([
+        "--out_dir", str(tmp_path), "--exp_name", "zig",
+        "--model_name", "HuggingFaceTB/SmolLM-1.7B",
+        "--cp", "2", "--cp_zigzag", "--remat", "save_attn",
+        "--steps_per_call", "8", "--seq_len", "2048", "--use_cpu", "--dp", "4"])
+    assert rc == 0
+    cfg = json.load(open(tmp_path / "zig" / "config.json"))
+    assert cfg["distributed"]["cp_zigzag"] is True
+    assert cfg["training"]["remat"] == "save_attn"
+    assert cfg["training"]["steps_per_call"] == 8
