@@ -52,6 +52,11 @@ class ModelConfig:
     # "auto": pallas flash attention on TPU, XLA sdpa elsewhere.
     # Replaces the reference's FLASH_ATTEN env switch (model.py:147-157).
     attention_impl: str = "auto"  # "auto" | "sdpa" | "flash"
+    # Pallas flash-attention tile sizes; None = kernel defaults (512x512,
+    # measured optimal on v5e at seq 2048/D64 and 4096/D128 — see
+    # ops/pallas/flash_attention.py). Tuning knobs for other chips/shapes.
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
     use_pallas_rmsnorm: Optional[bool] = None  # None = auto (TPU only)
     # gather logits over tp before the loss (reference tensor_parallel.py:48-50
     # gather_output=True); False = vocab-parallel cross-entropy (faster).
@@ -217,6 +222,15 @@ class Config:
         if t.grad_accum_dtype not in ("float32", "param"):
             raise ValueError(
                 f"unknown grad_accum_dtype {t.grad_accum_dtype!r} (float32|param)")
+        for name, b, floor in (("flash_block_q", m.flash_block_q, 8),
+                               ("flash_block_k", m.flash_block_k, 128)):
+            # Mosaic score tiles are [block_q, block_k] with an (8, 128)
+            # minimum tile; powers of two keep the kernel's halve-until-
+            # divides fallback (_pick_block) landing on real tile sizes
+            # instead of degrading to 1-row blocks (e.g. 24 -> 3 -> 1).
+            if b is not None and (b < floor or b & (b - 1) != 0):
+                raise ValueError(
+                    f"{name} must be a power of two >= {floor}, got {b}")
         if t.grad_accum_dtype == "param" and d.pp_size > 1:
             # the pipeline schedules accumulate in fp32 (the reference's
             # main_grad policy); 'param' is a single-stage memory optimization

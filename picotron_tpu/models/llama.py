@@ -178,11 +178,15 @@ def _attention(q, k, v, cfg: Config):
         # ring with Pallas flash blocks on TPU, XLA einsum blocks elsewhere
         return ring_attention(q, k, v, scale, "cp", cfg.distributed.cp_size,
                               True, impl == "flash",
-                              cfg.distributed.cp_zigzag)
+                              cfg.distributed.cp_zigzag,
+                              cfg.model.flash_block_q,
+                              cfg.model.flash_block_k)
     if impl == "flash":
         from picotron_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, scale, causal=True)
+        return flash_attention(q, k, v, scale, causal=True,
+                               block_q=cfg.model.flash_block_q,
+                               block_k=cfg.model.flash_block_k)
     return sdpa(q, k, v, scale, causal=True)
 
 

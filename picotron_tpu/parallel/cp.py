@@ -97,21 +97,23 @@ def _block_mask(s_q: int, s_k: int, src, rank, causal: bool, n: int,
     return qpos[:, None] >= kpos[None, :]
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def ring_attention(q, k, v, scale: float, axis: str, axis_size: int,
                    causal: bool, use_flash: bool = False,
-                   zigzag: bool = False):
+                   zigzag: bool = False, block_q: int | None = None,
+                   block_k: int | None = None):
     """q, k, v: [B, S_local, H, D] (kv heads already GQA-repeated, as the
     reference repeats before the ring, model.py:141-142). Returns [B,S,H,D].
     use_flash selects the Pallas block kernel (TPU) over the XLA einsum;
     zigzag expects the zigzag_perm() sequence layout and balances causal
     work across ranks."""
     out, _ = _ring_fwd_impl(q, k, v, scale, axis, axis_size, causal,
-                            use_flash, zigzag)
+                            use_flash, zigzag, block_q, block_k)
     return out
 
 
-def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag):
+def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag,
+               block_q=None, block_k=None):
     """One ring block -> (out [B,S,H,D] fp32, lse [B,S,H] fp32), with skipped
     (sub-)blocks returning lse=-inf rows (identity under the merge)."""
     b, s, h, d = q.shape
@@ -124,14 +126,17 @@ def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag):
 
     from picotron_tpu.ops.pallas.flash_attention import flash_attention_with_lse
 
+    flash = partial(flash_attention_with_lse, scale=scale,
+                    block_q=block_q, block_k=block_k)
+
     def full(_):
-        o, l = flash_attention_with_lse(q, kt, vt, scale, causal=False)
+        o, l = flash(q, kt, vt, causal=False)
         return o.astype(jnp.float32), l
 
     def diag(_):
         # zigzag local pair (r, 2n-1-r) is position-monotonic, so the
         # diagonal step is a plain causal block in both layouts
-        o, l = flash_attention_with_lse(q, kt, vt, scale, causal=True)
+        o, l = flash(q, kt, vt, causal=True)
         return o.astype(jnp.float32), l
 
     def skip(_):
@@ -140,15 +145,13 @@ def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag):
 
     def early(_):
         # zigzag, src < rank: every query sees only the source's early half
-        o, l = flash_attention_with_lse(q, kt[:, : s // 2], vt[:, : s // 2],
-                                        scale, causal=False)
+        o, l = flash(q, kt[:, : s // 2], vt[:, : s // 2], causal=False)
         return o.astype(jnp.float32), l
 
     def late(_):
         # zigzag, src > rank: only this rank's late half sees the source
         # (its whole chunk pair); early-half rows merge as identity
-        o, l = flash_attention_with_lse(q[:, s // 2:], kt, vt, scale,
-                                        causal=False)
+        o, l = flash(q[:, s // 2:], kt, vt, causal=False)
         return (jnp.concatenate(
                     [jnp.zeros((b, s // 2, h, d), jnp.float32),
                      o.astype(jnp.float32)], axis=1),
@@ -165,7 +168,8 @@ def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag):
     return lax.switch(idx, [skip, full, diag], None)
 
 
-def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag):
+def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag,
+                   block_q=None, block_k=None):
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     b, s, h, d = q.shape
@@ -177,7 +181,7 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag):
         kt, vt = kv
         src = (rank - t) % n
         blk_out, blk_lse = _block_fwd(q, kt, vt, scale, src, rank, causal,
-                                      use_flash, n, zigzag)
+                                      use_flash, n, zigzag, block_q, block_k)
         # LSE merge (reference context_parallel.py:170-171):
         #   out <- out - sigmoid(blk_lse - lse) * (out - blk_out)
         #   lse <- logaddexp(lse, blk_lse)
@@ -192,9 +196,10 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag):
     return out.astype(q.dtype), lse
 
 
-def _ring_fwd(q, k, v, scale, axis, n, causal, use_flash, zigzag):
+def _ring_fwd(q, k, v, scale, axis, n, causal, use_flash, zigzag,
+              block_q=None, block_k=None):
     out, lse = _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash,
-                              zigzag)
+                              zigzag, block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
@@ -222,19 +227,21 @@ def _block_bwd_einsum(q, kt, vt, dout, out_unused, lse, D, scale, src, rank,
 
 
 def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal,
-                     zigzag):
+                     zigzag, block_q=None, block_k=None):
     """One block's (dq, dk, dv) via the Pallas backward kernels fed the
     globally-merged out/lse (skip branch costs nothing at runtime)."""
     from picotron_tpu.ops.pallas.flash_attention import flash_block_grads
 
     b, s, h, d = q.shape
     f32 = lambda t: tuple(x.astype(jnp.float32) for x in t)
+    grads = partial(flash_block_grads, scale=scale,
+                    block_q=block_q, block_k=block_k)
 
     def full(_):
-        return f32(flash_block_grads(q, kt, vt, out, lse, dout, scale, False))
+        return f32(grads(q, kt, vt, out, lse, dout, causal=False))
 
     def diag(_):
-        return f32(flash_block_grads(q, kt, vt, out, lse, dout, scale, True))
+        return f32(grads(q, kt, vt, out, lse, dout, causal=True))
 
     def skip(_):
         z = jnp.zeros(q.shape, jnp.float32)
@@ -242,17 +249,17 @@ def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal,
 
     def early(_):
         # zigzag, src < rank: all queries x source's early kv half
-        dq, dk_h, dv_h = f32(flash_block_grads(
-            q, kt[:, : s // 2], vt[:, : s // 2], out, lse, dout, scale, False))
+        dq, dk_h, dv_h = f32(grads(
+            q, kt[:, : s // 2], vt[:, : s // 2], out, lse, dout, causal=False))
         zpad = jnp.zeros((b, s - s // 2, h, d), jnp.float32)
         return (dq, jnp.concatenate([dk_h, zpad], axis=1),
                 jnp.concatenate([dv_h, zpad], axis=1))
 
     def late(_):
         # zigzag, src > rank: late query half x full source kv
-        dq_h, dk, dv = f32(flash_block_grads(
+        dq_h, dk, dv = f32(grads(
             q[:, s // 2:], kt, vt, out[:, s // 2:], lse[:, s // 2:],
-            dout[:, s // 2:], scale, False))
+            dout[:, s // 2:], causal=False))
         zpad = jnp.zeros((b, s // 2, h, d), jnp.float32)
         return jnp.concatenate([zpad, dq_h], axis=1), dk, dv
 
@@ -264,7 +271,8 @@ def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal,
     return lax.switch(idx, [skip, full, diag], None)
 
 
-def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, res, dout):
+def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, block_q, block_k,
+              res, dout):
     q, k, v, out, lse = res
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -284,7 +292,8 @@ def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, res, dout):
         src = (rank - t) % n
         if use_flash:
             dq_blk, dk_blk, dv_blk = _block_bwd_flash(
-                q, kt, vt, dout, out, lse, scale, src, rank, causal, zigzag)
+                q, kt, vt, dout, out, lse, scale, src, rank, causal, zigzag,
+                block_q, block_k)
         else:
             dq_blk, dk_blk, dv_blk = _block_bwd_einsum(
                 q, kt, vt, dout, out, lse, D, scale, src, rank, causal, n,

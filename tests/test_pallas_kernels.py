@@ -104,3 +104,26 @@ def test_rmsnorm_grads_match_reference():
     rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
     np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=5e-5, atol=5e-5)
     np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=5e-5, atol=5e-5)
+
+
+def test_flash_blocks_configurable_through_model(tiny_model_kwargs):
+    """model.flash_block_q/k reach the kernel through _attention: a custom
+    (non-default) tiling must not change the math."""
+    from picotron_tpu.config import Config
+    from picotron_tpu.models.llama import _attention
+
+    def cfg_with(bq, bk):
+        return Config.from_dict({
+            "distributed": {"use_cpu": True},
+            "model": dict(tiny_model_kwargs, attention_impl="flash",
+                          flash_block_q=bq, flash_block_k=bk),
+            "training": {"seq_length": 128},
+            "dataset": {"name": "synthetic"},
+        })
+
+    q, k, v = _qkv(b=1, s=128, h=2, d=64, seed=3)
+    with pltpu.force_tpu_interpret_mode():
+        got = _attention(q, k, v, cfg_with(32, 128))
+        ref = _attention(q, k, v, cfg_with(None, None))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

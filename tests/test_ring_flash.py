@@ -165,3 +165,21 @@ def test_zigzag_perm_inverse():
         np.testing.assert_array_equal(
             perm[r * sl:(r + 1) * sl],
             np.asarray(chunk_positions(r, sl, N, True)))
+
+
+def test_block_fwd_custom_tiles_match_default():
+    """flash_block_q/k plumb through the ring's _block_fwd: a custom tiling
+    must not change the block math (single device, interpret mode)."""
+    q, k, v = _qkv(3)
+    C = S // 2
+    with pltpu.force_tpu_interpret_mode():
+        o_def, l_def = _block_fwd(q[:, :C], k[:, :C], v[:, :C], SCALE,
+                                  src=0, rank=0, causal=True, use_flash=True,
+                                  n=2, zigzag=False)
+        o_cus, l_cus = _block_fwd(q[:, :C], k[:, :C], v[:, :C], SCALE,
+                                  src=0, rank=0, causal=True, use_flash=True,
+                                  n=2, zigzag=False, block_q=32, block_k=64)
+    np.testing.assert_allclose(np.asarray(o_cus), np.asarray(o_def),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(l_cus), np.asarray(l_def),
+                               rtol=2e-5, atol=2e-5)
