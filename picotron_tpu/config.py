@@ -222,15 +222,17 @@ class Config:
         if t.grad_accum_dtype not in ("float32", "param"):
             raise ValueError(
                 f"unknown grad_accum_dtype {t.grad_accum_dtype!r} (float32|param)")
-        for name, b, floor in (("flash_block_q", m.flash_block_q, 8),
-                               ("flash_block_k", m.flash_block_k, 128)):
-            # Mosaic score tiles are [block_q, block_k] with an (8, 128)
-            # minimum tile; powers of two keep the kernel's halve-until-
-            # divides fallback (_pick_block) landing on real tile sizes
-            # instead of degrading to 1-row blocks (e.g. 24 -> 3 -> 1).
-            if b is not None and (b < floor or b & (b - 1) != 0):
+        for name, b in (("flash_block_q", m.flash_block_q),
+                        ("flash_block_k", m.flash_block_k)):
+            # Powers of two keep the kernel's halve-until-divides fallback
+            # (_pick_block) landing on real tile sizes instead of degrading
+            # to 1-row blocks (e.g. 24 -> 3 -> 1). The kernel accepts small
+            # tiles (ring half-blocks generate them); for full lane
+            # utilization prefer block_k >= 128 and block_q >= 8 x dtype
+            # packing (the 512x512 defaults are the measured optimum).
+            if b is not None and (b < 8 or b & (b - 1) != 0):
                 raise ValueError(
-                    f"{name} must be a power of two >= {floor}, got {b}")
+                    f"{name} must be a power of two >= 8, got {b}")
         if t.grad_accum_dtype == "param" and d.pp_size > 1:
             # the pipeline schedules accumulate in fp32 (the reference's
             # main_grad policy); 'param' is a single-stage memory optimization

@@ -1,0 +1,77 @@
+"""Unit tests for the bench harness logic (bench.py is a driver artifact:
+its size-descent and error classification decide what number gets published,
+so they get the same test treatment as the framework)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+from bench import classify_bench_error, run_descending
+
+
+def test_classify_bench_error():
+    assert classify_bench_error("resource_exhausted: out of hbm") == "oom"
+    assert classify_bench_error("ran out of memory while allocating") == "oom"
+    assert classify_bench_error(
+        "exceeds the amount of memory available (need 20g)") == "oom"
+    assert classify_bench_error(
+        "internal: http 500 remote_compile failed") == "opaque"
+    assert classify_bench_error("tpu_compile_helper exit code 1") == "opaque"
+    assert classify_bench_error("typeerror: bad argument") == "raise"
+
+
+def _patched(monkeypatch, behavior):
+    """Patch bench.run with a scripted behavior: size -> list of outcomes
+    (numbers return, strings raise RuntimeError(str)); each attempt pops."""
+    import bench
+
+    calls = []
+
+    def fake_run(cfg, **kw):
+        size = cfg
+        calls.append(size)
+        outcome = behavior[size].pop(0)
+        if isinstance(outcome, str):
+            raise RuntimeError(outcome)
+        return outcome
+
+    monkeypatch.setattr(bench, "run", fake_run)
+    return calls
+
+
+def test_descends_on_oom(monkeypatch):
+    calls = _patched(monkeypatch, {
+        "big": ["resource_exhausted"], "small": [123.0]})
+    cfg, tok_s = run_descending(("big", "small"), lambda s: s, tag="t")
+    assert (cfg, tok_s) == ("small", 123.0)
+    assert calls == ["big", "small"]
+
+
+def test_opaque_retries_same_size_once(monkeypatch):
+    calls = _patched(monkeypatch, {
+        "big": ["remote_compile http 500", 99.0]})
+    cfg, tok_s = run_descending(("big", "small"), lambda s: s, tag="t")
+    assert (cfg, tok_s) == ("big", 99.0)
+    assert calls == ["big", "big"]
+
+
+def test_opaque_twice_descends(monkeypatch):
+    calls = _patched(monkeypatch, {
+        "big": ["remote_compile a", "tpu_compile_helper b"], "small": [7.0]})
+    cfg, tok_s = run_descending(("big", "small"), lambda s: s, tag="t")
+    assert (cfg, tok_s) == ("small", 7.0)
+    assert calls == ["big", "big", "small"]
+
+
+def test_unknown_error_raises(monkeypatch):
+    _patched(monkeypatch, {"big": ["some assertion failed"]})
+    with pytest.raises(RuntimeError, match="assertion"):
+        run_descending(("big", "small"), lambda s: s, tag="t")
+
+
+def test_all_sizes_fail_exits(monkeypatch):
+    _patched(monkeypatch, {"big": ["out of memory"], "small": ["out of memory"]})
+    with pytest.raises(SystemExit, match="failed at all sizes"):
+        run_descending(("big", "small"), lambda s: s, tag="t")
