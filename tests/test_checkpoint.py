@@ -199,3 +199,40 @@ def test_resume_across_uneven_pp_layouts(tiny_model_kwargs, tmp_path):
         _, _, loss = step(params_b, opt_b, tok, tgt)
         assert np.isfinite(float(loss))
     mgr.close()
+
+
+def test_train_entry_hf_bootstrap(tiny_model_kwargs, tmp_path):
+    """checkpoint.hf_bootstrap_path through the real train() entry: exported
+    weights must be what training starts from (the reference's bootstrap
+    path, checkpoint.py:50-102)."""
+    from picotron_tpu.train import train
+
+    cfg0 = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg0.model)
+    sft = str(tmp_path / "boot.safetensors")
+    ckpt.save_hf_safetensors(params, sft)
+
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg.training.total_train_steps = 2
+    cfg.checkpoint.hf_bootstrap_path = sft
+    # seed 42 init would differ from key-7 params; identical first-step loss
+    # to a manual run from the exported params proves the bootstrap loaded
+    from picotron_tpu import train_step as ts2
+    from picotron_tpu.data import MicroBatchDataLoader as Loader
+
+    topo = topology_from_config(cfg0)
+    opt0 = ts2.build_optimizer(cfg0).init(params)
+    step = ts2.build_train_step(cfg0, topo)
+    loader = Loader(cfg0)
+    tok, tgt = ts2.shard_batch(next(loader), topo)
+    _, _, want_first_loss = step(params, opt0, tok, tgt)
+
+    _, _, last_loss = train(cfg)
+    assert np.isfinite(last_loss)
+    # compare first-step losses by re-running train for 1 step
+    cfg1 = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg1.training.total_train_steps = 1
+    cfg1.checkpoint.hf_bootstrap_path = sft
+    _, _, got_first_loss = train(cfg1)
+    np.testing.assert_allclose(got_first_loss, float(want_first_loss),
+                               rtol=1e-6, atol=1e-6)

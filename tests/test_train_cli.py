@@ -65,3 +65,33 @@ def test_loader_skip_steps_matches_replay(tiny_model_kwargs):
     xa, xb = next(a), next(b)
     np.testing.assert_array_equal(xa["input_ids"], xb["input_ids"])
     np.testing.assert_array_equal(xa["target_ids"], xb["target_ids"])
+
+
+def test_wandb_logging_path(tiny_model_kwargs, monkeypatch):
+    """use_wandb drives the full wandb call surface (init with the
+    reference's run-name convention, per-step log, finish) via a stub
+    module — no network, no wandb dependency."""
+    import sys
+    import types
+
+    events = []
+    stub = types.ModuleType("wandb")
+    stub.init = lambda **kw: events.append(("init", kw)) or stub
+    stub.log = lambda data, step=None: events.append(("log", step, data))
+    stub.finish = lambda: events.append(("finish",))
+    monkeypatch.setitem(sys.modules, "wandb", stub)
+
+    from picotron_tpu.train import train
+
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg.training.total_train_steps = 2
+    cfg.logging.use_wandb = True
+    cfg.logging.run_name = "stubrun"
+    steps, tokens, loss = train(cfg)
+    assert steps == 2
+    init_kw = events[0][1]
+    assert init_kw["name"].startswith("stubrun_")
+    assert "_dp1_tp1_pp1_cp1" in init_kw["name"]
+    logs = [e for e in events if e[0] == "log"]
+    assert len(logs) == 2 and logs[0][1] == 1 and "loss" in logs[0][2]
+    assert events[-1] == ("finish",)
