@@ -79,14 +79,20 @@ class CheckpointManager:
         self.manager = ocp.CheckpointManager(self.directory, options=options)
 
     def save(self, step: int, params, opt_state, trained_tokens: int,
-             layout: Optional[tuple[int, int]] = None) -> None:
+             layout: Optional[tuple[int, int]] = None,
+             zero1: Optional[tuple[bool, int]] = None) -> None:
         """``layout`` = (num_hidden_layers, pp_size) of the saving run;
         recorded in the metadata so a restore under a different uneven-pp
-        padding can remap the stacked layer rows (see ``load``)."""
+        padding can remap the stacked layer rows (see ``load``).
+        ``zero1`` = (enabled, dp_size): ZeRO-1 chunk shapes depend on dp, so
+        the layout is recorded and ``load`` refuses a mismatched restore
+        instead of corrupting the optimizer state."""
         ocp = self._ocp
         meta = {"step": step, "trained_tokens": int(trained_tokens)}
         if layout is not None:
             meta["num_hidden_layers"], meta["pp_size"] = int(layout[0]), int(layout[1])
+        if zero1 is not None:
+            meta["zero1"], meta["zero1_dp"] = bool(zero1[0]), int(zero1[1])
         self.manager.save(
             step,
             args=ocp.args.Composite(
@@ -106,7 +112,8 @@ class CheckpointManager:
             step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
 
     def load(self, params_like, opt_state_like, step: Optional[int] = None,
-             layout: Optional[tuple[int, int]] = None):
+             layout: Optional[tuple[int, int]] = None,
+             zero1: Optional[tuple[bool, int]] = None):
         """Restore into the shardings/dtypes of the given example trees
         (live arrays or ShapeDtypeStructs). Returns
         (params, opt_state, step, trained_tokens).
@@ -137,6 +144,29 @@ class CheckpointManager:
             tgt_rows, tgt_pos = _padded_layout(*layout)
             if src_rows != tgt_rows or src_pos != tgt_pos:
                 remap = (src_rows, src_pos, tgt_pos)
+
+        # ZeRO-1 guard: the dp-chunked optimizer state is dp-specific (leaf
+        # shapes = dp * ceil(n_local/dp)) and a 1-D chunk cannot go through
+        # the stacked-layer-row remap — refuse a mismatched restore with a
+        # real error instead of a shape crash or silent corruption. dp_size
+        # only matters when ZeRO-1 is on for either side: non-ZeRO optimizer
+        # state is dp-replicated and restores across dp changes fine.
+        saved_z = (bool(meta.get("zero1", False)), int(meta.get("zero1_dp", 1)))
+        if zero1 is not None:
+            want = (bool(zero1[0]), int(zero1[1]))
+            mismatch = (saved_z[0] != want[0]) or (
+                saved_z[0] and saved_z[1] != want[1])
+            if mismatch:
+                raise ValueError(
+                    f"optimizer state was saved with (zero1, dp) = {saved_z} "
+                    f"but this run has {want}; ZeRO-1 chunk layouts are "
+                    f"dp-specific — restore under the same (zero1, dp_size) "
+                    f"or re-shard the optimizer state offline")
+        if saved_z[0] and remap is not None:
+            raise ValueError(
+                "cannot remap an uneven-pp layer layout on a ZeRO-1 "
+                "checkpoint: the optimizer state is stored as flat dp chunks; "
+                "restore under the saving run's (num_hidden_layers, pp_size)")
 
         def is_stacked(path) -> bool:
             return any(

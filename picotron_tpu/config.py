@@ -34,6 +34,26 @@ class DistributedConfig:
     # contiguous chunks, faithful to the reference (its zigzag TODO:
     # tests/test_dataloader.py:136).
     cp_zigzag: bool = False
+    # Context-parallel algorithm: "ring" = ppermute K/V ring attention (the
+    # reference's mode); "ulysses" = DeepSpeed-style all-to-all sequence
+    # parallelism (beyond the reference, SURVEY §2.3): one all-to-all swaps
+    # seq-sharding for head-sharding, a single full-sequence (flash)
+    # attention runs per rank, one all-to-all swaps back. Needs local heads
+    # (num_attention_heads / tp) divisible by cp; incompatible with
+    # cp_zigzag (it is load-balanced by construction).
+    cp_impl: str = "ring"
+    # Megatron-style sequence parallelism: between TP blocks the activation
+    # sequence axis is sharded over 'tp' (all-gather entering column-parallel
+    # matmuls, reduce-scatter leaving row-parallel ones). Same wire bytes as
+    # plain TP, residual stream / norms / saved boundaries shrink by 1/tp.
+    # The reference only TODOs this (utils.py:66); beyond-parity feature.
+    tp_sequence_parallel: bool = False
+    # ZeRO stage 1: shard optimizer state (and the update compute) over 'dp'.
+    # Gradients reduce-scatter over dp instead of all-reducing, each rank
+    # updates its 1/dp chunk of the (flattened) params, updated params
+    # all-gather back. Cuts AdamW state memory by dp at identical numerics.
+    # Out of the reference's scope (SURVEY.md §2.3 ZeRO row); beyond-parity.
+    zero1: bool = False
 
 
 @dataclass
@@ -187,6 +207,24 @@ class Config:
             raise ValueError(
                 f"cp_zigzag needs seq_length % (2*cp_size) == 0, got "
                 f"{t.seq_length} % {2 * d.cp_size}")
+        if d.cp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"unknown cp_impl {d.cp_impl!r} (ring|ulysses)")
+        if d.cp_impl == "ulysses" and d.cp_size > 1:
+            if d.cp_zigzag:
+                raise ValueError(
+                    "cp_impl='ulysses' is incompatible with cp_zigzag (the "
+                    "all-to-all layout is load-balanced by construction)")
+            if (m.num_attention_heads // d.tp_size) % d.cp_size != 0:
+                raise ValueError(
+                    f"cp_impl='ulysses' needs local heads "
+                    f"({m.num_attention_heads} / tp {d.tp_size}) divisible "
+                    f"by cp_size {d.cp_size}")
+        if d.tp_sequence_parallel and (
+                t.seq_length // d.cp_size) % d.tp_size != 0:
+            raise ValueError(
+                f"tp_sequence_parallel needs the cp-local sequence "
+                f"({t.seq_length} / cp {d.cp_size}) divisible by tp_size "
+                f"{d.tp_size}")
         if m.num_attention_heads % d.tp_size != 0:
             raise ValueError(f"num_attention_heads {m.num_attention_heads} % tp_size {d.tp_size} != 0")
         if m.num_key_value_heads % d.tp_size != 0:

@@ -46,8 +46,14 @@ from picotron_tpu.ops.cross_entropy import (
 )
 from picotron_tpu.ops.rmsnorm import rms_norm
 from picotron_tpu.ops.rope import apply_rope, precompute_rope
-from picotron_tpu.parallel.cp import ring_attention
-from picotron_tpu.parallel.tp import tp_copy, tp_gather, tp_reduce
+from picotron_tpu.parallel.cp import ring_attention, ulysses_attention
+from picotron_tpu.parallel.tp import (
+    sp_gather,
+    sp_scatter,
+    tp_copy,
+    tp_gather,
+    tp_reduce,
+)
 from picotron_tpu.utils import on_tpu
 
 Params = dict[str, Any]
@@ -157,16 +163,23 @@ def param_pspecs(_: ModelConfig) -> Params:
 # --------------------------------------------------------------------------- #
 
 
-def embed_lookup(w, tokens):
+def use_sp(cfg: Config) -> bool:
+    """Sequence parallelism is active (a no-op rewrite at tp == 1)."""
+    return cfg.distributed.tp_sequence_parallel and cfg.distributed.tp_size > 1
+
+
+def embed_lookup(w, tokens, sp: bool = False):
     """Vocab-parallel embedding: mask out-of-shard tokens, psum partials
-    (reference VocabParallelEmbedding, tensor_parallel.py:246-271)."""
+    (reference VocabParallelEmbedding, tensor_parallel.py:246-271). With
+    sequence parallelism the partial sums are reduce-scattered straight to
+    this rank's seq shard instead of fully reduced."""
     v_local = w.shape[0]
     start = lax.axis_index("tp") * v_local
     local = tokens - start
     ok = (local >= 0) & (local < v_local)
     e = jnp.take(w, jnp.clip(local, 0, v_local - 1), axis=0)
     e = e * ok[..., None].astype(w.dtype)
-    return tp_reduce(e)
+    return sp_scatter(e) if sp else tp_reduce(e)
 
 
 def _attention(q, k, v, cfg: Config):
@@ -175,6 +188,13 @@ def _attention(q, k, v, cfg: Config):
     if impl == "auto":
         impl = "flash" if on_tpu() else "sdpa"
     if cfg.distributed.cp_size > 1:
+        if cfg.distributed.cp_impl == "ulysses":
+            # all-to-all seq<->head reshard around one full-sequence kernel
+            return ulysses_attention(q, k, v, scale, "cp",
+                                     cfg.distributed.cp_size, True,
+                                     impl == "flash",
+                                     cfg.model.flash_block_q,
+                                     cfg.model.flash_block_k)
         # ring with Pallas flash blocks on TPU, XLA einsum blocks elsewhere
         return ring_attention(q, k, v, scale, "cp", cfg.distributed.cp_size,
                               True, impl == "flash",
@@ -202,14 +222,22 @@ def _norm(x, w, cfg: Config):
 
 
 def decoder_layer(lp, h, cos, sin, cfg: Config):
-    """One decoder block with per-shard head counts (model.py:94-97,187-208)."""
+    """One decoder block with per-shard head counts (model.py:94-97,187-208).
+
+    With sequence parallelism the residual stream ``h`` is seq-sharded over
+    'tp': the norm runs on the local shard, the Megatron f/g collectives
+    become all-gather (entering column-parallel) / reduce-scatter (leaving
+    row-parallel), and attention/MLP still see the full (cp-local) sequence.
+    """
     m, tp = cfg.model, cfg.distributed.tp_size
     nh, nkv, D = m.num_attention_heads // tp, m.num_key_value_heads // tp, m.head_dim
-    B, S, _ = h.shape
+    sp = use_sp(cfg)
+    enter = sp_gather if sp else tp_copy
+    leave = sp_scatter if sp else tp_reduce
 
     # attention sub-block: column(q,k,v) -> rope -> attn -> row(out)
-    x = _norm(h, lp["attn_norm"], cfg)
-    x = tp_copy(x)
+    x = enter(_norm(h, lp["attn_norm"], cfg))
+    B, S, _ = x.shape
     q = (x @ lp["wq"]).reshape(B, S, nh, D)
     k = (x @ lp["wk"]).reshape(B, S, nkv, D)
     v = (x @ lp["wv"]).reshape(B, S, nkv, D)
@@ -219,13 +247,12 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
         k = jnp.repeat(k, nh // nkv, axis=2)
         v = jnp.repeat(v, nh // nkv, axis=2)
     o = _attention(q, k, v, cfg).reshape(B, S, nh * D)
-    h = h + tp_reduce(o @ lp["wo"])
+    h = h + leave(o @ lp["wo"])
 
     # MLP sub-block: column(gate,up) -> SwiGLU -> row(down)  (model.py:163-185)
-    x = _norm(h, lp["mlp_norm"], cfg)
-    x = tp_copy(x)
+    x = enter(_norm(h, lp["mlp_norm"], cfg))
     y = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
-    return h + tp_reduce(y @ lp["w_down"])
+    return h + leave(y @ lp["w_down"])
 
 
 def layer_valid_mask(stacked, cfg: Config):
@@ -286,8 +313,11 @@ def layers_forward(stacked, h, cos, sin, cfg: Config):
 
 
 def _head_input(params, h, cfg: Config):
-    """Final norm + tp copy — the shared prefix of logits and loss paths."""
-    return tp_copy(_norm(h, params["final_norm"], cfg))
+    """Final norm + tp copy — the shared prefix of logits and loss paths.
+    With sequence parallelism the norm runs on the local seq shard and the
+    result is all-gathered to the full sequence for the vocab-sharded head."""
+    x = _norm(h, params["final_norm"], cfg)
+    return sp_gather(x) if use_sp(cfg) else tp_copy(x)
 
 
 def head_logits(params, h, cfg: Config):
@@ -365,15 +395,16 @@ def _stage_input(params, h_recv, tokens, cfg: Config):
     embedding lookup (the reference instantiates the embedding only on stage
     0, pipeline_parallel.py:12-15)."""
     dt = jnp.dtype(cfg.model.dtype)
+    sp = use_sp(cfg)
     if cfg.distributed.pp_size == 1:
-        return embed_lookup(params["embed"], tokens).astype(dt)
+        return embed_lookup(params["embed"], tokens, sp).astype(dt)
     if _stage_gating():
         return lax.cond(
             lax.axis_index("pp") == 0,
-            lambda: embed_lookup(params["embed"], tokens).astype(dt),
+            lambda: embed_lookup(params["embed"], tokens, sp).astype(dt),
             lambda: h_recv,
         )
-    emb = embed_lookup(params["embed"], tokens).astype(dt)
+    emb = embed_lookup(params["embed"], tokens, sp).astype(dt)
     return jnp.where(lax.axis_index("pp") == 0, emb, h_recv)
 
 
@@ -508,7 +539,8 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
     # ---- embedding backward (first stage only)
     def embed_vjp():
         _, vjp = jax.vjp(
-            lambda w: embed_lookup(w, tokens).astype(dt), params["embed"])
+            lambda w: embed_lookup(w, tokens, use_sp(cfg)).astype(dt),
+            params["embed"])
         return vjp(dh)[0]
 
     if _stage_gating():
@@ -536,7 +568,7 @@ def forward_logits(params, tokens, cfg: Config, gather: bool = True):
     silently computes with wrong positions/masks."""
     cos, sin = rope_tables(cfg)
     dt = jnp.dtype(cfg.model.dtype)
-    h = embed_lookup(params["embed"], tokens).astype(dt)
+    h = embed_lookup(params["embed"], tokens, use_sp(cfg)).astype(dt)
     s_local = tokens.shape[-1]
     cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
     h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)

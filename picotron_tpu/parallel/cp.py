@@ -314,3 +314,52 @@ def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, block_q, block_k,
 
 
 ring_attention.defvjp(_ring_fwd, _ring_bwd)
+
+
+# --------------------------------------------------------------------------- #
+# Ulysses (DeepSpeed-style all-to-all sequence parallelism) — the second
+# long-context mode, beyond the reference (SURVEY §2.3 marks Ulysses out of
+# its scope). Instead of rotating K/V around a ring, one all-to-all swaps
+# the sharded dimension from sequence to heads: every rank then holds the
+# FULL sequence for H/cp heads and runs one ordinary (flash) causal
+# attention; a second all-to-all swaps back. Wire cost is 2 all-to-alls of
+# the activations (vs n ppermute rounds of K/V), compute is perfectly
+# balanced with no masked/skipped blocks — preferable when heads >> cp and
+# ICI all-to-all bandwidth is good. Gradients need no custom VJP: the
+# transpose of all-to-all is the reverse all-to-all, and the inner
+# attention brings its own.
+# --------------------------------------------------------------------------- #
+
+
+def ulysses_attention(q, k, v, scale: float, axis: str, axis_size: int,
+                      causal: bool, use_flash: bool = False,
+                      block_q: int | None = None,
+                      block_k: int | None = None):
+    """q, k, v: [B, S_local, H, D], sequence CONTIGUOUSLY sharded over
+    ``axis`` (no zigzag — Ulysses is load-balanced by construction) and
+    H % axis_size == 0 (kv heads already GQA-repeated). Returns
+    [B, S_local, H, D]."""
+    n = axis_size
+
+    def seq_to_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):  # [B, S, H/n, D] -> [B, S/n, H, D]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    if n == 1:
+        qf, kf, vf = q, k, v
+    else:
+        qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if use_flash:
+        from picotron_tpu.ops.pallas.flash_attention import flash_attention
+
+        o = flash_attention(qf, kf, vf, scale, causal=causal,
+                            block_q=block_q, block_k=block_k)
+    else:
+        from picotron_tpu.ops.attention import sdpa
+
+        o = sdpa(qf, kf, vf, scale, causal=causal)
+    return o if n == 1 else heads_to_seq(o)

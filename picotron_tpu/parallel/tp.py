@@ -29,6 +29,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 
@@ -64,6 +65,72 @@ def _tp_reduce_bwd(axis, _, g):
 
 
 tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+# --------------------------------------------------------------------------- #
+# Sequence parallelism (Megatron-SP): between the TP blocks the activation's
+# *sequence* axis is sharded over 'tp' instead of replicated. The f/g pair
+# becomes g-bar/f-bar: entering a column-parallel matmul the seq shards are
+# all-gathered; leaving a row-parallel matmul the partial sums are
+# reduce-scattered back to seq shards (psum = all-gather + reduce-scatter, so
+# the wire cost is identical to plain TP while the residual stream, norms and
+# saved layer boundaries shrink by 1/tp). The reference only TODOs this
+# (utils.py:66 "LayerNorm is also split across TP ranks"); SURVEY.md §2.3
+# marks it nearly free in JAX. Norm-weight gradients become partial over the
+# local seq shard and are psum'd over 'tp' in the train step
+# (train_step.sync_sp_norm_grads).
+# --------------------------------------------------------------------------- #
+
+
+def all_gather_dim(x, axis: str, dim: int):
+    """Tiled all-gather along array dimension ``dim`` over mesh axis ``axis``.
+    Public building block shared by the SP collectives and the ZeRO-1 param
+    all-gather (train_step)."""
+    return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def reduce_scatter_dim(x, axis: str, dim: int):
+    """Tiled reduce-scatter along array dimension ``dim`` over mesh axis
+    ``axis``. Public building block shared by the SP collectives and the
+    ZeRO-1 gradient reduce-scatter (train_step)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def sp_gather(x, axis: str = "tp", dim: int = 1):
+    """Seq all-gather forward / reduce-scatter backward (Megatron-SP g-bar):
+    [B, S/tp, ...] -> [B, S, ...] entering a column-parallel region."""
+    return all_gather_dim(x, axis, dim)
+
+
+def _sp_gather_fwd(x, axis, dim):
+    return all_gather_dim(x, axis, dim), None
+
+
+def _sp_gather_bwd(axis, dim, _, g):
+    return (reduce_scatter_dim(g, axis, dim),)
+
+
+sp_gather.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def sp_scatter(x, axis: str = "tp", dim: int = 1):
+    """Seq reduce-scatter forward / all-gather backward (Megatron-SP f-bar):
+    partial-sum [B, S, ...] -> reduced [B, S/tp, ...] leaving a row-parallel
+    region. Replaces ``tp_reduce`` when sequence parallelism is on."""
+    return reduce_scatter_dim(x, axis, dim)
+
+
+def _sp_scatter_fwd(x, axis, dim):
+    return reduce_scatter_dim(x, axis, dim), None
+
+
+def _sp_scatter_bwd(axis, dim, _, g):
+    return (all_gather_dim(g, axis, dim),)
+
+
+sp_scatter.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))

@@ -100,6 +100,9 @@ def create_single_config(
     tp: int = 1, cp: int = 1, dp: int = 1, pp: int = 1,
     pp_engine: str = "1f1b",
     cp_zigzag: Optional[bool] = None,
+    cp_impl: Optional[str] = None,
+    tp_sequence_parallel: Optional[bool] = None,
+    zero1: Optional[bool] = None,
     model_name: str = "HuggingFaceTB/SmolLM-360M-Instruct",
     num_hidden_layers: Optional[int] = None,
     num_attention_heads: Optional[int] = None,
@@ -131,6 +134,12 @@ def create_single_config(
              pp_engine=pp_engine, use_cpu=use_cpu)
     if cp_zigzag is not None:  # None = keep the template's value
         d["cp_zigzag"] = cp_zigzag
+    if cp_impl is not None:
+        d["cp_impl"] = cp_impl
+    if tp_sequence_parallel is not None:
+        d["tp_sequence_parallel"] = tp_sequence_parallel
+    if zero1 is not None:
+        d["zero1"] = zero1
 
     m = content["model"]
     m["name"] = model_name
@@ -199,6 +208,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pp_engine", type=str, default="1f1b")
     p.add_argument("--cp_zigzag", action="store_true", default=None,
                    help="load-balanced zigzag context-parallel layout")
+    p.add_argument("--cp_impl", type=str, default=None,
+                   choices=("ring", "ulysses"),
+                   help="context-parallel algorithm: ppermute K/V ring or "
+                        "Ulysses all-to-all seq<->head resharding")
+    p.add_argument("--tp_sequence_parallel", action="store_true", default=None,
+                   help="Megatron sequence parallelism: seq-shard the "
+                        "residual stream over tp between TP blocks")
+    p.add_argument("--zero1", action="store_true", default=None,
+                   help="ZeRO-1: shard optimizer state over dp "
+                        "(reduce-scatter grads, chunked update, all-gather)")
     p.add_argument("--model_name", type=str,
                    default="HuggingFaceTB/SmolLM-360M-Instruct")
     p.add_argument("--num_hidden_layers", type=int, default=None)
@@ -237,6 +256,8 @@ def main(argv=None) -> int:
         out_dir=args.out_dir, exp_name=args.exp_name,
         tp=args.tp, cp=args.cp, dp=args.dp, pp=args.pp,
         pp_engine=args.pp_engine, cp_zigzag=args.cp_zigzag,
+        cp_impl=args.cp_impl,
+        tp_sequence_parallel=args.tp_sequence_parallel, zero1=args.zero1,
         model_name=args.model_name,
         num_hidden_layers=args.num_hidden_layers,
         num_attention_heads=args.num_attention_heads,

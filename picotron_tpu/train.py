@@ -99,10 +99,11 @@ def train(cfg, max_steps_override: Optional[int] = None):
         manager = ckpt_mod.CheckpointManager(c.load_path or c.save_dir)
 
     layout = (m.num_hidden_layers, cfg.distributed.pp_size)
+    z1 = (cfg.distributed.zero1, cfg.distributed.dp_size)
     step, trained_tokens = 0, 0
     if c.load_path:
         params, opt_state, step, trained_tokens = manager.load(
-            params, opt_state, layout=layout)
+            params, opt_state, layout=layout, zero1=z1)
         loader.skip_steps(step)
         utils.log0(f"resumed from {c.load_path} at step {step} "
                    f"({utils.to_readable_format(trained_tokens)} tokens)")
@@ -202,14 +203,16 @@ def train(cfg, max_steps_override: Optional[int] = None):
         # state, so the recorded step must be the end-of-group step.
         if (manager is not None and c.save_frequency > 0
                 and step // c.save_frequency > step_before // c.save_frequency):
-            manager.save(step, params, opt_state, trained_tokens, layout=layout)
+            manager.save(step, params, opt_state, trained_tokens, layout=layout,
+                         zero1=z1)
             last_saved_step = step
 
     if profiling:
         jax.profiler.stop_trace()
     if manager is not None:
         if c.save_frequency > 0 and step != last_saved_step:
-            manager.save(step, params, opt_state, trained_tokens, layout=layout)
+            manager.save(step, params, opt_state, trained_tokens, layout=layout,
+                         zero1=z1)
         manager.close()
     if wandb is not None:
         wandb.finish()

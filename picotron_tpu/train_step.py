@@ -35,21 +35,118 @@ from jax.tree_util import tree_flatten_with_path, tree_unflatten
 from picotron_tpu.config import Config
 from picotron_tpu.models import llama
 from picotron_tpu.parallel.pp import no_pipeline, pipeline_1f1b, pipeline_afab
+from picotron_tpu.parallel.tp import all_gather_dim, reduce_scatter_dim
 from picotron_tpu.topology import Topology, batch_pspec, named_shardings
 
 
 def build_optimizer(cfg: Config) -> optax.GradientTransformation:
+    """AdamW with torch defaults (reference train.py:209). Gradient clipping
+    is NOT part of the chain: inside shard_map optax.clip_by_global_norm
+    would compute each device's *local* norm — different per tp/pp shard,
+    which desyncs replicated params. The step applies
+    ``clip_by_global_norm_sharded`` instead (true global norm via per-leaf
+    psum over the axes that shard it)."""
     t = cfg.training
-    parts = []
-    if t.grad_clip > 0:
-        parts.append(optax.clip_by_global_norm(t.grad_clip))
-    parts.append(
-        optax.adamw(
-            t.learning_rate, b1=t.adam_beta1, b2=t.adam_beta2, eps=t.adam_eps,
-            weight_decay=t.weight_decay,
-        )
-    )
-    return optax.chain(*parts)
+    # chain() wrapper kept so the optimizer-state pytree structure matches
+    # checkpoints saved when clipping lived inside the chain (grad_clip=0
+    # runs — the default — share the (adamw_state,) structure; clip>0
+    # checkpoints from before the sharded-clip change need a fresh opt state)
+    return optax.chain(optax.adamw(
+        t.learning_rate, b1=t.adam_beta1, b2=t.adam_beta2, eps=t.adam_eps,
+        weight_decay=t.weight_decay,
+    ))
+
+
+def _spec_axes(spec) -> tuple:
+    axes = []
+    for entry in spec:
+        if entry is None:
+            continue
+        axes.extend([entry] if isinstance(entry, str) else list(entry))
+    return tuple(axes)
+
+
+def clip_by_global_norm_sharded(grads, pspecs, max_norm):
+    """Mesh-aware global-norm clip. Each leaf's squared sum is psum'd over
+    exactly the axes that shard it (replicated axes excluded so nothing is
+    double-counted), so every device computes the same true global norm —
+    matching optax.clip_by_global_norm numerics on a single device and
+    keeping replicated params in sync on any topology. Works for both the
+    param-shaped grad tree (pspecs = llama.param_pspecs) and the ZeRO-1
+    chunk tree (pspecs = zero1_chunk_specs)."""
+    spec_leaves = jax.tree.leaves(pspecs, is_leaf=lambda x: isinstance(x, P))
+    total = jnp.float32(0.0)
+    for g, spec in zip(jax.tree.leaves(grads), spec_leaves):
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        axes = _spec_axes(spec)
+        if axes:
+            sq = lax.psum(sq, axes)
+        total = total + sq
+    gn = jnp.sqrt(total)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gn, 1e-16))
+    return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+
+
+# --------------------------------------------------------------------------- #
+# ZeRO-1: dp-sharded optimizer state (beyond-parity; SURVEY §2.3 marks ZeRO
+# out of the reference's scope). Each param leaf's local (pp/tp-sharded)
+# block is flattened, zero-padded to a multiple of dp, and split into dp
+# equal chunks; gradients arrive by reduce-scatter (instead of all-reduce),
+# AdamW updates only the local chunk, and the updated chunks all-gather back
+# into full params. State memory per device drops by dp at identical
+# numerics (pad entries have zero grad and zero param, so their AdamW update
+# is exactly zero).
+# --------------------------------------------------------------------------- #
+
+
+def _zero1_chunk_len(n: int, dp: int) -> int:
+    return -(-n // dp)
+
+
+def zero1_chunk_specs(pspecs):
+    """PartitionSpec for each flattened chunk leaf: one dimension, tiled over
+    'dp' plus every axis that shards the param leaf (canonical order: dp
+    outermost, then the param spec's axes in order)."""
+    return jax.tree.map(lambda spec: P(("dp", *_spec_axes(spec))), pspecs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _zero1_scatter(g, dp):
+    """Reduce-scatter a local grad block over 'dp': [shape] -> mean chunk
+    [ceil(n/dp)]."""
+    n = g.size
+    c = _zero1_chunk_len(n, dp)
+    flat = jnp.pad(g.reshape(-1), (0, dp * c - n))
+    return reduce_scatter_dim(flat, "dp", 0) / dp
+
+
+def _zero1_slice(p, dp):
+    """This dp rank's chunk of a local param block."""
+    n = p.size
+    c = _zero1_chunk_len(n, dp)
+    flat = jnp.pad(p.reshape(-1), (0, dp * c - n))
+    return lax.dynamic_slice_in_dim(flat, lax.axis_index("dp") * c, c, 0)
+
+
+def _zero1_unsplit(chunk, like):
+    """All-gather updated chunks over 'dp' back into the full local block."""
+    full = all_gather_dim(chunk, "dp", 0)
+    return full[: like.size].reshape(like.shape)
+
+
+def zero1_opt_pspecs(cfg: Config, optimizer, pspecs):
+    """PartitionSpecs of the dp-chunked optimizer state: eval-shape the
+    optimizer on local-chunk-shaped params, then map mu/nu leaves to their
+    chunk specs by path suffix (scalars like count stay replicated)."""
+    dp = cfg.distributed.dp_size
+    p_shape = jax.eval_shape(
+        partial(llama.init_params, m=cfg.model, pp_size=cfg.distributed.pp_size),
+        jax.random.PRNGKey(0))
+    chunk_shape = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct((_zero1_chunk_len(p.size, dp),), p.dtype),
+        p_shape)
+    o_shape = jax.eval_shape(optimizer.init, chunk_shape)
+    return opt_pspecs(o_shape, zero1_chunk_specs(pspecs))
 
 
 def _key_name(k) -> str:
@@ -79,6 +176,20 @@ def opt_pspecs(opt_state_shape, pspecs) -> Any:
     return tree_unflatten(otree, out)
 
 
+def sync_sp_norm_grads(grads):
+    """Sequence parallelism: norm-weight grads are partial sums over each tp
+    rank's seq shard (the norms run on sharded activations) — psum over 'tp'
+    completes them. Matmul weight grads are already correct: their activation
+    operands are all-gathered to full sequence inside the layer."""
+    g = dict(grads)
+    layers = dict(g["layers"])
+    for k in ("attn_norm", "mlp_norm"):
+        layers[k] = lax.psum(layers[k], "tp")
+    g["layers"] = layers
+    g["final_norm"] = lax.psum(g["final_norm"], "tp")
+    return g
+
+
 def sync_pp_replicated_grads(grads, pspecs):
     """psum over 'pp' for grads of params replicated across stages (embedding,
     final norm, LM head): only the owning stage contributes nonzero grads."""
@@ -102,6 +213,16 @@ def init_state(cfg: Config, topo: Topology, seed: int | None = None):
                 pp_size=cfg.distributed.pp_size),
         out_shardings=shardings)(key)
 
+    if cfg.distributed.zero1:
+        optimizer = build_optimizer(cfg)
+        ospecs = zero1_opt_pspecs(cfg, optimizer, pspecs)
+        init_fn = lambda p: optimizer.init(
+            jax.tree.map(partial(_zero1_slice, dp=cfg.distributed.dp_size), p))
+        opt_state = jax.jit(jax.shard_map(
+            init_fn, mesh=topo.mesh, in_specs=(pspecs,), out_specs=ospecs,
+            check_vma=False))(params)
+        return params, opt_state
+
     optimizer = build_optimizer(cfg)
     o_shape = jax.eval_shape(optimizer.init, params)
     ospecs = opt_pspecs(o_shape, pspecs)
@@ -119,21 +240,32 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
     mesh = topo.mesh
     pp = cfg.distributed.pp_size
     engine = cfg.distributed.pp_engine
+    zero1 = cfg.distributed.zero1
     pspecs = llama.param_pspecs(cfg.model)
     optimizer = build_optimizer(cfg)
-    o_shape = jax.eval_shape(
-        optimizer.init,
-        jax.eval_shape(partial(llama.init_params, m=cfg.model,
-                               pp_size=cfg.distributed.pp_size),
-                       jax.random.PRNGKey(0)))
-    ospecs = opt_pspecs(o_shape, pspecs)
+    if zero1:
+        cspecs = zero1_chunk_specs(pspecs)
+        ospecs = zero1_opt_pspecs(cfg, optimizer, pspecs)
+    else:
+        o_shape = jax.eval_shape(
+            optimizer.init,
+            jax.eval_shape(partial(llama.init_params, m=cfg.model,
+                                   pp_size=cfg.distributed.pp_size),
+                           jax.random.PRNGKey(0)))
+        ospecs = opt_pspecs(o_shape, pspecs)
     bspec = batch_pspec()
     cos, sin = llama.rope_tables(cfg)
     dt = jnp.dtype(cfg.model.dtype)
 
+    # with sequence parallelism the residual stream (and so every pipeline
+    # boundary tensor) is seq-sharded over 'tp'
+    sp_div = (cfg.distributed.tp_size
+              if llama.use_sp(cfg) else 1)
+
     def _step(params, opt_state, tokens, targets):
         stage_fn = lambda p, h, tok, tgt: llama.stage_apply(p, h, tok, tgt, cos, sin, cfg)
-        h_shape = (tokens.shape[1], tokens.shape[2], cfg.model.hidden_size)
+        h_shape = (tokens.shape[1], tokens.shape[2] // sp_div,
+                   cfg.model.hidden_size)
         if pp == 1:
             acc_dt = dt if cfg.training.grad_accum_dtype == "param" else jnp.float32
             loss, grads = no_pipeline(stage_fn, params, tokens, targets,
@@ -151,13 +283,37 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
 
         # grad sync: mean over the fused dp×cp group (data_parallel.py:47,83),
         # psum over pp for stage-replicated params, cast fp32 -> param dtype
-        # (data_parallel.py:161-165)
-        grads = jax.tree.map(lambda g: lax.pmean(g, ("dp", "cp")), grads)
-        grads = sync_pp_replicated_grads(grads, pspecs)
-        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+        # (data_parallel.py:161-165). With ZeRO-1 the dp share of the mean
+        # arrives by reduce-scatter and the update touches only this rank's
+        # 1/dp chunk of each (already pp/tp-sharded) param block.
+        if zero1:
+            dp = cfg.distributed.dp_size
+            grads = jax.tree.map(lambda g: lax.pmean(g, "cp"), grads)
+            grads = sync_pp_replicated_grads(grads, pspecs)
+            if sp_div > 1:
+                grads = sync_sp_norm_grads(grads)
+            g_chunks = jax.tree.map(partial(_zero1_scatter, dp=dp), grads)
+            g_chunks = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                                    g_chunks, params)
+            if cfg.training.grad_clip > 0:
+                g_chunks = clip_by_global_norm_sharded(
+                    g_chunks, cspecs, cfg.training.grad_clip)
+            p_chunks = jax.tree.map(partial(_zero1_slice, dp=dp), params)
+            updates, opt_state = optimizer.update(g_chunks, opt_state, p_chunks)
+            p_chunks = optax.apply_updates(p_chunks, updates)
+            params = jax.tree.map(_zero1_unsplit, p_chunks, params)
+        else:
+            grads = jax.tree.map(lambda g: lax.pmean(g, ("dp", "cp")), grads)
+            grads = sync_pp_replicated_grads(grads, pspecs)
+            if sp_div > 1:
+                grads = sync_sp_norm_grads(grads)
+            grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+            if cfg.training.grad_clip > 0:
+                grads = clip_by_global_norm_sharded(
+                    grads, pspecs, cfg.training.grad_clip)
 
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
         loss = lax.pmean(loss, ("dp", "cp"))  # logging mean (utils.py:93-98)
         return params, opt_state, loss
 

@@ -1,0 +1,30 @@
+"""Config validation for the round-3 feature flags (the reference surfaces
+topology constraints as asserts, train.py:85-86; here they are real errors)."""
+
+import pytest
+
+from conftest import make_config
+
+
+def test_ulysses_rejects_zigzag(tiny_model_kwargs):
+    with pytest.raises(ValueError, match="incompatible with cp_zigzag"):
+        make_config(tiny_model_kwargs, cp=2, seq=64, cp_impl="ulysses",
+                    zigzag=True)
+
+
+def test_ulysses_rejects_indivisible_heads(tiny_model_kwargs):
+    # 8 heads / tp 2 = 4 local heads, cp 8 does not divide them
+    kw = dict(tiny_model_kwargs)
+    with pytest.raises(ValueError, match="divisible"):
+        make_config(kw, tp=2, cp=8, seq=64, cp_impl="ulysses")
+
+
+def test_unknown_cp_impl_rejected(tiny_model_kwargs):
+    with pytest.raises(ValueError, match="cp_impl"):
+        make_config(tiny_model_kwargs, cp=2, seq=64, cp_impl="rong")
+
+
+def test_sp_needs_divisible_local_seq(tiny_model_kwargs):
+    # cp-local sequence = 12/2 = 6, not divisible by tp 4
+    with pytest.raises(ValueError, match="tp_sequence_parallel"):
+        make_config(tiny_model_kwargs, tp=4, cp=2, seq=12, sp=True)

@@ -55,6 +55,22 @@ TOPOLOGIES = [
     dict(cp=2, zigzag=True),
     dict(cp=4, zigzag=True),
     dict(dp=2, cp=2, tp=2, zigzag=True),
+    # Megatron sequence parallelism: seq-sharded residual stream between TP
+    # blocks must be a pure layout change (beyond-parity; reference TODO
+    # utils.py:66)
+    dict(tp=2, sp=True),
+    dict(tp=4, sp=True),
+    dict(tp=2, cp=2, sp=True),
+    dict(pp=2, tp=2, acc=2, engine="1f1b", sp=True),
+    dict(pp=2, tp=2, acc=2, engine="afab", sp=True),
+    dict(dp=2, tp=2, cp=2, sp=True, zigzag=True),
+    # Ulysses all-to-all context parallelism: resharding seq<->heads around
+    # one full-sequence attention must be a pure layout change (beyond-parity;
+    # SURVEY §2.3 marks Ulysses out of the reference's scope)
+    dict(cp=2, cp_impl="ulysses"),
+    dict(cp=4, cp_impl="ulysses"),
+    dict(tp=2, cp=2, cp_impl="ulysses", sp=True),
+    dict(dp=2, pp=2, cp=2, acc=2, engine="1f1b", cp_impl="ulysses"),
 ]
 
 

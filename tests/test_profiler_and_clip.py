@@ -26,7 +26,7 @@ def test_profiler_window_writes_trace(tiny_model_kwargs, tmp_path):
 
 
 def test_grad_clip_changes_step_but_still_learns(tiny_model_kwargs):
-    """training.grad_clip wires optax.clip_by_global_norm ahead of adamw
+    """training.grad_clip applies a global-norm clip ahead of adamw
     (the reference passes only lr; clipping is config surface here). A tiny
     clip bound must alter the trajectory while training still learns."""
     from test_parallel import run_losses
@@ -38,3 +38,24 @@ def test_grad_clip_changes_step_but_still_learns(tiny_model_kwargs):
     assert not np.allclose(clipped, base, atol=1e-4), (
         "grad_clip=0.05 did not change the trajectory")
     assert clipped[-1] < clipped[0], f"clipped run did not learn: {clipped}"
+
+
+def test_grad_clip_topology_equivalence(tiny_model_kwargs):
+    """The clip norm is the TRUE global norm on any topology: each leaf's
+    squared sum is psum'd over exactly the axes sharding it
+    (clip_by_global_norm_sharded), so sharded runs clip identically to the
+    single-device run — a per-device local norm would desync tp-replicated
+    params (norm weights) and diverge from this oracle."""
+    from test_parallel import run_losses
+
+    def clipped(**kw):
+        cfg = make_config(tiny_model_kwargs, seq=32, **kw)
+        cfg.training.grad_clip = 0.05
+        return run_losses(cfg, steps=5)
+
+    base = clipped(mbs=8)
+    for kw in (dict(tp=4, mbs=8), dict(pp=2, acc=2, mbs=4, engine="1f1b"),
+               dict(tp=2, cp=2, mbs=8, sp=True)):
+        got = clipped(**kw)
+        np.testing.assert_allclose(got, base, rtol=2e-5, atol=2e-5,
+                                   err_msg=str(kw))

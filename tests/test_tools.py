@@ -247,3 +247,27 @@ def test_create_config_round3_flags(tmp_path):
     assert cfg["distributed"]["cp_zigzag"] is True
     assert cfg["training"]["remat"] == "save_attn"
     assert cfg["training"]["steps_per_call"] == 8
+
+
+def test_create_config_sp_zero1_flags(tmp_path):
+    """tp_sequence_parallel / zero1 are reachable from the generator CLI and
+    default to the template's values when absent."""
+    from picotron_tpu.tools.create_config import main as cc_main
+
+    rc = cc_main([
+        "--out_dir", str(tmp_path), "--exp_name", "spz",
+        "--model_name", "HuggingFaceTB/SmolLM-1.7B",
+        "--tp", "2", "--dp", "2", "--tp_sequence_parallel", "--zero1",
+        "--seq_len", "2048", "--use_cpu"])
+    assert rc == 0
+    cfg = json.load(open(tmp_path / "spz" / "config.json"))
+    assert cfg["distributed"]["tp_sequence_parallel"] is True
+    assert cfg["distributed"]["zero1"] is True
+
+    rc = cc_main([
+        "--out_dir", str(tmp_path), "--exp_name", "plain",
+        "--model_name", "HuggingFaceTB/SmolLM-1.7B", "--use_cpu"])
+    assert rc == 0
+    cfg = json.load(open(tmp_path / "plain" / "config.json"))
+    assert cfg["distributed"]["tp_sequence_parallel"] is False
+    assert cfg["distributed"]["zero1"] is False

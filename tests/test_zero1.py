@@ -1,0 +1,74 @@
+"""ZeRO-1 (dp-sharded optimizer state): identical numerics, 1/dp state memory.
+
+Beyond-parity feature (SURVEY.md §2.3 marks ZeRO out of the reference's
+scope). The oracle is the same as every other topology: with the same seed,
+config and data, the fp32 loss trajectory must equal the unsharded baseline
+exactly — reduce-scatter + chunked update + all-gather is a pure
+reassociation of all-reduce + replicated update.
+"""
+
+import jax
+import numpy as np
+
+from picotron_tpu import train_step as ts
+from picotron_tpu.topology import topology_from_config
+from tests.test_parallel import run_losses
+
+
+def test_zero1_matches_replicated(cfg_factory):
+    base = run_losses(cfg_factory(dp=4, seq=32, mbs=2))
+    got = run_losses(cfg_factory(dp=4, seq=32, mbs=2, zero1=True))
+    np.testing.assert_allclose(got, base, rtol=2e-5, atol=2e-5)
+
+
+def test_zero1_with_grad_clip(cfg_factory):
+    base = run_losses(cfg_factory(dp=2, seq=32, mbs=4, grad_clip=0.5))
+    got = run_losses(cfg_factory(dp=2, seq=32, mbs=4, grad_clip=0.5, zero1=True))
+    np.testing.assert_allclose(got, base, rtol=2e-5, atol=2e-5)
+
+
+def test_zero1_4d_topology(cfg_factory):
+    base = run_losses(cfg_factory(seq=32, mbs=8))
+    got = run_losses(cfg_factory(dp=2, pp=2, tp=2, acc=2, seq=32, mbs=2,
+                                 engine="1f1b", zero1=True))
+    np.testing.assert_allclose(got, base, rtol=2e-5, atol=2e-5)
+
+
+def test_zero1_checkpoint_guard(cfg_factory, tmp_path):
+    """A ZeRO-1 checkpoint restores under the same (zero1, dp) and refuses a
+    mismatched layout with a real error (the chunk shapes are dp-specific)."""
+    import pytest
+
+    from picotron_tpu.checkpoint import CheckpointManager
+
+    cfg = cfg_factory(dp=2, seq=32, mbs=4, zero1=True)
+    topo = topology_from_config(cfg)
+    params, opt_state = ts.init_state(cfg, topo)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(1, params, opt_state, trained_tokens=5, layout=(4, 1),
+             zero1=(True, 2))
+    p2, o2, step, tokens = mgr.load(params, opt_state, layout=(4, 1),
+                                    zero1=(True, 2))
+    assert step == 1 and tokens == 5
+    for a, b in zip(jax.tree.leaves(o2), jax.tree.leaves(opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="dp-specific"):
+        mgr.load(params, opt_state, layout=(4, 1), zero1=(True, 4))
+    with pytest.raises(ValueError, match="dp-specific"):
+        mgr.load(params, opt_state, layout=(4, 1), zero1=(False, 2))
+    mgr.close()
+
+
+def test_zero1_state_is_dp_sharded(cfg_factory):
+    """Each device holds 1/dp of every mu/nu leaf (vs the replicated
+    baseline), i.e. per-device optimizer state shrinks by dp."""
+    cfg = cfg_factory(dp=4, seq=32, mbs=2, zero1=True)
+    topo = topology_from_config(cfg)
+    _, opt_state = ts.init_state(cfg, topo)
+    leaves = [l for l in jax.tree.leaves(opt_state)
+              if hasattr(l, "sharding") and l.ndim == 1]
+    assert leaves, "expected chunked optimizer-state leaves"
+    for leaf in leaves:
+        shard = leaf.sharding.shard_shape(leaf.shape)
+        assert shard[0] * 4 == leaf.shape[0], (
+            f"leaf {leaf.shape} shard {shard} is not 1/dp")
