@@ -97,6 +97,16 @@ class ModelConfig:
 class TrainingConfig:
     seed: int = 42
     learning_rate: float = 3e-4
+    # LR schedule (beyond the reference, which trains at constant lr,
+    # train.py:209): "constant" | "cosine" | "linear", with optional linear
+    # warmup from 0 over lr_warmup_steps. Decay runs to
+    # learning_rate * lr_min_ratio over lr_decay_steps (default:
+    # total_train_steps). The default (constant, no warmup) keeps the
+    # optimizer state structurally identical to a plain float lr.
+    lr_schedule: str = "constant"
+    lr_warmup_steps: int = 0
+    lr_min_ratio: float = 0.0
+    lr_decay_steps: Optional[int] = None
     # torch AdamW defaults — the reference passes only lr (train.py:209)
     weight_decay: float = 0.01
     adam_beta1: float = 0.9
@@ -255,6 +265,15 @@ class Config:
                 "(auto|fused|gathered|vocab_parallel)")
         if t.steps_per_call < 1:
             raise ValueError("steps_per_call must be >= 1")
+        if t.lr_schedule not in ("constant", "cosine", "linear"):
+            raise ValueError(
+                f"unknown lr_schedule {t.lr_schedule!r} (constant|cosine|linear)")
+        if t.lr_warmup_steps < 0:
+            raise ValueError("lr_warmup_steps must be >= 0")
+        if not 0.0 <= t.lr_min_ratio <= 1.0:
+            raise ValueError("lr_min_ratio must be in [0, 1]")
+        if t.lr_decay_steps is not None and t.lr_decay_steps <= 0:
+            raise ValueError("lr_decay_steps must be > 0 when set")
         if t.remat not in ("none", "full", "save_attn"):
             raise ValueError(f"unknown remat {t.remat!r} (none|full|save_attn)")
         if t.grad_accum_dtype not in ("float32", "param"):

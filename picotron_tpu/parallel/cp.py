@@ -322,8 +322,9 @@ ring_attention.defvjp(_ring_fwd, _ring_bwd)
 # its scope). Instead of rotating K/V around a ring, one all-to-all swaps
 # the sharded dimension from sequence to heads: every rank then holds the
 # FULL sequence for H/cp heads and runs one ordinary (flash) causal
-# attention; a second all-to-all swaps back. Wire cost is 2 all-to-alls of
-# the activations (vs n ppermute rounds of K/V), compute is perfectly
+# attention; a second all-to-all swaps back. Wire cost is 4 all-to-alls per
+# forward (q, k, v in; o out — the DeepSpeed-Ulysses accounting; 8 with the
+# backward transposes, vs n ppermute rounds of K/V), compute is perfectly
 # balanced with no masked/skipped blocks — preferable when heads >> cp and
 # ICI all-to-all bandwidth is good. Gradients need no custom VJP: the
 # transpose of all-to-all is the reverse all-to-all, and the inner

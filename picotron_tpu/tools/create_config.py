@@ -118,6 +118,10 @@ def create_single_config(
     use_wandb: bool = False,
     use_cpu: bool = False,
     learning_rate: Optional[float] = None,
+    lr_schedule: Optional[str] = None,
+    lr_warmup_steps: Optional[int] = None,
+    lr_min_ratio: Optional[float] = None,
+    lr_decay_steps: Optional[int] = None,
     total_train_steps: Optional[int] = None,
     seed: Optional[int] = None,
     remat: Optional[str] = None,
@@ -164,6 +168,14 @@ def create_single_config(
         m["max_position_embeddings"] = seq_len
     if learning_rate is not None:
         t["learning_rate"] = learning_rate
+    if lr_schedule is not None:
+        t["lr_schedule"] = lr_schedule
+    if lr_warmup_steps is not None:
+        t["lr_warmup_steps"] = lr_warmup_steps
+    if lr_min_ratio is not None:
+        t["lr_min_ratio"] = lr_min_ratio
+    if lr_decay_steps is not None:
+        t["lr_decay_steps"] = lr_decay_steps
     if total_train_steps is not None:
         t["total_train_steps"] = total_train_steps
     if seed is not None:
@@ -233,6 +245,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--subset_name", type=str, default=None)
     p.add_argument("--exp_name", type=str, default="dummy_exp")
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr_schedule", type=str, default=None,
+                   choices=("constant", "cosine", "linear"))
+    p.add_argument("--lr_warmup_steps", type=int, default=None)
+    p.add_argument("--lr_min_ratio", type=float, default=None)
+    p.add_argument("--lr_decay_steps", type=int, default=None,
+                   help="decay horizon in steps (default: total_train_steps)")
     p.add_argument("--total_train_steps", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--remat", type=str, default=None,
@@ -268,7 +286,10 @@ def main(argv=None) -> int:
         grad_acc_steps=args.grad_acc_steps, mbs=args.mbs, seq_len=args.seq_len,
         dataset_name=args.dataset_name, subset_name=args.subset_name,
         use_wandb=args.use_wandb, use_cpu=args.use_cpu,
-        learning_rate=args.lr, total_train_steps=args.total_train_steps,
+        learning_rate=args.lr, lr_schedule=args.lr_schedule,
+        lr_warmup_steps=args.lr_warmup_steps, lr_min_ratio=args.lr_min_ratio,
+        lr_decay_steps=args.lr_decay_steps,
+        total_train_steps=args.total_train_steps,
         seed=args.seed, remat=args.remat, steps_per_call=args.steps_per_call,
         template_path=args.template, exist_ok=args.overwrite,
     )

@@ -39,20 +39,46 @@ from picotron_tpu.parallel.tp import all_gather_dim, reduce_scatter_dim
 from picotron_tpu.topology import Topology, batch_pspec, named_shardings
 
 
+def lr_schedule(t):
+    """Learning-rate schedule from the training config: optional linear
+    warmup from 0 over ``lr_warmup_steps``, then constant / cosine / linear
+    decay to ``learning_rate * lr_min_ratio`` over ``lr_decay_steps``
+    (default total_train_steps). Returns a plain float for the default
+    (constant, no warmup) so the optimizer state keeps the schedule-free
+    structure. Beyond the reference, which trains at constant lr
+    (train.py:209)."""
+    peak = t.learning_rate
+    w = t.lr_warmup_steps
+    if t.lr_schedule == "constant" and w == 0:
+        return peak
+    total = t.lr_decay_steps if t.lr_decay_steps is not None else t.total_train_steps
+    end = peak * t.lr_min_ratio
+    if t.lr_schedule == "constant":
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, peak, w),
+             optax.constant_schedule(peak)], [w])
+    if t.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            0.0, peak, w, max(total, w + 1), end)
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak, w),
+         optax.linear_schedule(peak, end, max(total - w, 1))], [w])
+
+
 def build_optimizer(cfg: Config) -> optax.GradientTransformation:
-    """AdamW with torch defaults (reference train.py:209). Gradient clipping
-    is NOT part of the chain: inside shard_map optax.clip_by_global_norm
-    would compute each device's *local* norm — different per tp/pp shard,
-    which desyncs replicated params. The step applies
-    ``clip_by_global_norm_sharded`` instead (true global norm via per-leaf
-    psum over the axes that shard it)."""
+    """AdamW with torch defaults (reference train.py:209) and the configured
+    lr schedule. Gradient clipping is NOT part of the chain: inside shard_map
+    optax.clip_by_global_norm would compute each device's *local* norm —
+    different per tp/pp shard, which desyncs replicated params. The step
+    applies ``clip_by_global_norm_sharded`` instead (true global norm via
+    per-leaf psum over the axes that shard it)."""
     t = cfg.training
     # chain() wrapper kept so the optimizer-state pytree structure matches
     # checkpoints saved when clipping lived inside the chain (grad_clip=0
     # runs — the default — share the (adamw_state,) structure; clip>0
     # checkpoints from before the sharded-clip change need a fresh opt state)
     return optax.chain(optax.adamw(
-        t.learning_rate, b1=t.adam_beta1, b2=t.adam_beta2, eps=t.adam_eps,
+        lr_schedule(t), b1=t.adam_beta1, b2=t.adam_beta2, eps=t.adam_eps,
         weight_decay=t.weight_decay,
     ))
 

@@ -1,0 +1,75 @@
+"""Learning-rate schedules (beyond the reference's constant lr, train.py:209)."""
+
+import numpy as np
+import pytest
+
+from conftest import make_config
+from picotron_tpu.train_step import lr_schedule
+
+
+def _tcfg(tiny_model_kwargs, **kw):
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    for k, v in kw.items():
+        setattr(cfg.training, k, v)
+    cfg.validate()
+    return cfg.training
+
+
+def test_constant_no_warmup_is_plain_float(tiny_model_kwargs):
+    t = _tcfg(tiny_model_kwargs)
+    assert lr_schedule(t) == t.learning_rate  # float => schedule-free opt state
+
+
+def test_warmup_ramp_and_plateau(tiny_model_kwargs):
+    t = _tcfg(tiny_model_kwargs, lr_warmup_steps=10)
+    s = lr_schedule(t)
+    assert float(s(0)) == 0.0
+    np.testing.assert_allclose(float(s(5)), t.learning_rate / 2, rtol=1e-6)
+    np.testing.assert_allclose(float(s(10)), t.learning_rate, rtol=1e-6)
+    np.testing.assert_allclose(float(s(1000)), t.learning_rate, rtol=1e-6)
+
+
+def test_cosine_decays_to_min_ratio(tiny_model_kwargs):
+    t = _tcfg(tiny_model_kwargs, lr_schedule="cosine", lr_warmup_steps=4,
+              lr_min_ratio=0.1, lr_decay_steps=100,
+              total_train_steps=100)
+    s = lr_schedule(t)
+    np.testing.assert_allclose(float(s(4)), t.learning_rate, rtol=1e-6)
+    np.testing.assert_allclose(float(s(100)), 0.1 * t.learning_rate, rtol=1e-5)
+    assert float(s(50)) < t.learning_rate
+
+
+def test_linear_decay_endpoints(tiny_model_kwargs):
+    t = _tcfg(tiny_model_kwargs, lr_schedule="linear", lr_warmup_steps=5,
+              lr_min_ratio=0.0, total_train_steps=55)
+    s = lr_schedule(t)
+    np.testing.assert_allclose(float(s(5)), t.learning_rate, rtol=1e-6)
+    np.testing.assert_allclose(float(s(30)), t.learning_rate / 2, rtol=1e-5)
+    np.testing.assert_allclose(float(s(55)), 0.0, atol=1e-12)
+
+
+def test_bad_schedule_rejected(tiny_model_kwargs):
+    with pytest.raises(ValueError, match="lr_schedule"):
+        _tcfg(tiny_model_kwargs, lr_schedule="step")
+
+
+def test_warmup_changes_trajectory_and_topology_agrees(tiny_model_kwargs):
+    """A scheduled run trains (and differs from constant lr), and the
+    schedule rides the jitted step identically on a sharded topology."""
+    from test_parallel import run_losses
+
+    base = run_losses(make_config(tiny_model_kwargs, seq=32, mbs=8), steps=6)
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=8)
+    cfg.training.lr_schedule = "cosine"
+    cfg.training.lr_warmup_steps = 3
+    cfg.training.total_train_steps = 20
+    warm = run_losses(cfg, steps=6)
+    assert not np.allclose(warm, base, atol=1e-4)
+    assert warm[-1] < warm[0]
+
+    cfg2 = make_config(tiny_model_kwargs, dp=2, seq=32, mbs=4, zero1=True)
+    cfg2.training.lr_schedule = "cosine"
+    cfg2.training.lr_warmup_steps = 3
+    cfg2.training.total_train_steps = 20
+    got = run_losses(cfg2, steps=6)
+    np.testing.assert_allclose(got, warm, rtol=2e-5, atol=2e-5)
