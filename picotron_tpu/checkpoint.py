@@ -49,14 +49,21 @@ from picotron_tpu.topology import Topology, named_shardings
 # --------------------------------------------------------------------------- #
 
 
-def _padded_layout(L: int, pp: int) -> tuple[int, list[int]]:
-    """(padded rows, real-row positions) of the stacked layer axis for a
-    (num_hidden_layers, pp_size) pair — [L] with identity positions for even
-    splits, llama.pp_layer_layout otherwise."""
-    if L % pp == 0:
+def _padded_layout(L: int, pp: int, interleave: int = 1) -> tuple[int, list[int]]:
+    """(stacked rows, real-row positions) of the stacked layer axis for a
+    (num_hidden_layers, pp_size[, pp_interleave]) layout — [L] with identity
+    positions for even contiguous splits, llama.pp_layer_layout otherwise
+    (padded uneven splits, chunk-permuted interleaved 1F1B)."""
+    if L % pp == 0 and interleave == 1:
         return L, list(range(L))
-    K, _, positions = llama.pp_layer_layout(L, pp)
+    K, _, positions = llama.pp_layer_layout(L, pp, interleave)
     return K * pp, positions
+
+
+def _layout3(layout):
+    """Normalize a (L, pp) / (L, pp, interleave) layout tuple to length 3."""
+    return (int(layout[0]), int(layout[1]),
+            int(layout[2]) if len(layout) > 2 else 1)
 
 
 class CheckpointManager:
@@ -90,7 +97,9 @@ class CheckpointManager:
         ocp = self._ocp
         meta = {"step": step, "trained_tokens": int(trained_tokens)}
         if layout is not None:
-            meta["num_hidden_layers"], meta["pp_size"] = int(layout[0]), int(layout[1])
+            lay = _layout3(layout)
+            meta["num_hidden_layers"], meta["pp_size"] = lay[0], lay[1]
+            meta["pp_interleave"] = lay[2]
         if zero1 is not None:
             meta["zero1"], meta["zero1_dp"] = bool(zero1[0]), int(zero1[1])
         self.manager.save(
@@ -135,7 +144,9 @@ class CheckpointManager:
         meta = self._read_meta(step)
         remap = None
         if layout is not None and "num_hidden_layers" in meta:
-            src = (int(meta["num_hidden_layers"]), int(meta["pp_size"]))
+            src = (int(meta["num_hidden_layers"]), int(meta["pp_size"]),
+                   int(meta.get("pp_interleave", 1)))
+            layout = _layout3(layout)
             if src[0] != layout[0]:
                 raise ValueError(
                     f"checkpoint has {src[0]} layers, config wants "
@@ -316,6 +327,7 @@ def load_hf_safetensors(
     m: ModelConfig,
     topo: Optional[Topology] = None,
     dtype: Optional[str] = None,
+    interleave: int = 1,
 ) -> llama.Params:
     """Build our parameter pytree from an HF-format Llama checkpoint.
 
@@ -335,10 +347,13 @@ def load_hf_safetensors(
     pp = topo.pp_size if topo is not None else 1
 
     def stack_layers(per_layer: list[np.ndarray]) -> np.ndarray:
-        """HF layer i -> its row in the (possibly padded) stacked axis
-        (pad rows of an uneven pp split are zeros)."""
-        rows, positions = _padded_layout(L, pp)
-        if rows == L:
+        """HF layer i -> its row in the stacked axis (identity for even
+        contiguous splits, zero-padded for uneven ones, chunk-permuted for
+        interleaved 1F1B). The fast path requires identity POSITIONS, not
+        just rows == L — the interleaved layout is a permutation at the
+        same row count."""
+        rows, positions = _padded_layout(L, pp, interleave)
+        if rows == L and positions == list(range(L)):
             return np.stack(per_layer)
         out = np.zeros((rows,) + per_layer[0].shape, per_layer[0].dtype)
         for g, pos in enumerate(positions):
@@ -376,12 +391,17 @@ def load_hf_safetensors(
 
 def save_hf_safetensors(params: llama.Params, path: str,
                         num_layers: Optional[int] = None,
-                        pp_size: int = 1) -> None:
+                        pp_size: int = 1, interleave: int = 1) -> None:
     """Export our pytree to a single HF-format safetensors file (inverse of
     the reference's import direction — it only reads; export makes the
     bootstrap test a round trip). For an uneven-pp padded stack, pass the
     real ``num_layers`` and the ``pp_size`` it was padded for; only the real
-    rows are written, so the export is topology-free."""
+    rows are written, so the export is topology-free.
+
+    CAUTION: params trained with ``pp_interleave > 1`` store layers
+    chunk-permuted at rows == num_layers — undetectable from the array
+    itself (no pad rows). You MUST pass the run's ``pp_size`` and
+    ``interleave`` or the export is silently layer-scrambled."""
     from safetensors.numpy import save_file
 
     out: dict[str, np.ndarray] = {}
@@ -404,7 +424,7 @@ def save_hf_safetensors(params: llama.Params, path: str,
                 "layer stack contains all-zero (pad) rows — this model was "
                 "trained with an uneven pp split; pass num_layers= and "
                 "pp_size= so only real layers are exported")
-    exp_rows, positions = _padded_layout(L, pp_size)
+    exp_rows, positions = _padded_layout(L, pp_size, interleave)
     if exp_rows != rows:
         raise ValueError(
             f"layer stack has {rows} rows but layout (num_layers={L}, "

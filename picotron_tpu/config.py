@@ -28,6 +28,13 @@ class DistributedConfig:
     pp_size: int = 1
     dp_size: int = 1
     pp_engine: str = "1f1b"  # "afab" | "1f1b"   (reference train.py:223-229)
+    # Interleaved 1F1B (virtual pipeline stages, beyond the reference —
+    # SURVEY §2.3 notes "no interleaved/virtual stages"): each device holds
+    # pp_interleave non-contiguous model chunks and the schedule cycles
+    # through them, shrinking the pipeline bubble by the interleave factor.
+    # Requires pp_engine="1f1b", num_hidden_layers % (pp*v) == 0, and
+    # gradient_accumulation_steps % pp == 0.
+    pp_interleave: int = 1
     use_cpu: bool = False  # run on host CPU devices (reference gloo path, train.py:83)
     # Zigzag context-parallel layout: each cp rank owns sequence chunks
     # (r, 2n-1-r), balancing causal ring-attention work across ranks. False =
@@ -256,6 +263,21 @@ class Config:
             raise ValueError("pipeline parallelism needs >= 1 microbatch")
         if d.pp_engine not in ("afab", "1f1b"):
             raise ValueError(f"unknown pp_engine {d.pp_engine!r} (afab|1f1b)")
+        if d.pp_interleave < 1:
+            raise ValueError("pp_interleave must be >= 1")
+        if d.pp_interleave > 1 and d.pp_size > 1:
+            if d.pp_engine != "1f1b":
+                raise ValueError("pp_interleave > 1 requires pp_engine='1f1b'")
+            if m.num_hidden_layers % (d.pp_size * d.pp_interleave) != 0:
+                raise ValueError(
+                    f"pp_interleave needs num_hidden_layers "
+                    f"({m.num_hidden_layers}) divisible by pp_size * "
+                    f"pp_interleave ({d.pp_size} * {d.pp_interleave})")
+            if t.gradient_accumulation_steps % d.pp_size != 0:
+                raise ValueError(
+                    f"pp_interleave needs gradient_accumulation_steps "
+                    f"({t.gradient_accumulation_steps}) divisible by pp_size "
+                    f"({d.pp_size}) (microbatch groups cycle the chunks)")
         if m.attention_impl not in ("auto", "sdpa", "flash"):
             raise ValueError(
                 f"unknown attention_impl {m.attention_impl!r} (auto|sdpa|flash)")

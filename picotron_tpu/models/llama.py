@@ -69,19 +69,37 @@ def _uniform(key, shape, fan_in, dtype):
     return jax.random.uniform(key, shape, jnp.float32, -bound, bound).astype(dtype)
 
 
-def pp_layer_layout(L: int, pp: int):
-    """Uneven pipeline splits: stage layer counts + padded stack positions.
+def pp_layer_layout(L: int, pp: int, interleave: int = 1):
+    """Stage layer counts + stacked-row positions for the pipeline layouts.
 
-    Remainder layers go to the earliest stages — the reference's distribution
-    rule (pipeline_parallel.py:33-36). The SPMD pipeline shards a stacked
-    layer axis over 'pp', which needs equal rows per stage, so the stack is
-    padded to K = ceil(L/pp) rows per stage and the pad rows are masked
-    identity layers (zero weights, skipped via a validity mask — FLOP waste
-    = (K*pp - L)/L, e.g. 1/32 for Llama-2-7B on pp=3).
+    Even/uneven contiguous splits (interleave == 1): remainder layers go to
+    the earliest stages — the reference's distribution rule
+    (pipeline_parallel.py:33-36). The SPMD pipeline shards a stacked layer
+    axis over 'pp', which needs equal rows per stage, so the stack is padded
+    to K = ceil(L/pp) rows per stage and the pad rows are masked identity
+    layers (zero weights, skipped via a validity mask — FLOP waste =
+    (K*pp - L)/L, e.g. 1/32 for Llama-2-7B on pp=3).
+
+    Interleaved (virtual-stage) layout (interleave = v > 1, requires
+    L % (pp*v) == 0): the model is cut into v*pp chunks of L/(pp*v) layers;
+    device s owns chunks {s, pp+s, ..., (v-1)*pp+s}, stored chunk-major in
+    its contiguous K-row shard — the Megatron-style layout that lets the
+    interleaved 1F1B schedule shrink the pipeline bubble by v
+    (parallel/pp.py::pipeline_1f1b_interleaved).
 
     Returns (K, counts, positions): counts[s] = real layers on stage s,
-    positions[g] = row of global layer g in the [K*pp] padded stack.
+    positions[g] = row of global layer g in the [K*pp] stacked axis.
     """
+    if interleave > 1:
+        assert L % (pp * interleave) == 0, (L, pp, interleave)
+        Kv = L // (pp * interleave)
+        K = L // pp
+        positions = []
+        for g in range(L):
+            chunk, i = divmod(g, Kv)  # virtual stage chunk = c*pp + s
+            c, s = divmod(chunk, pp)
+            positions.append(s * K + c * Kv + i)
+        return K, [K] * pp, positions
     base, rem = divmod(L, pp)
     counts = [base + (1 if s < rem else 0) for s in range(pp)]
     K = base + (1 if rem else 0)
@@ -91,15 +109,17 @@ def pp_layer_layout(L: int, pp: int):
     return K, counts, positions
 
 
-def init_params(key, m: ModelConfig, pp_size: int = 1) -> Params:
+def init_params(key, m: ModelConfig, pp_size: int = 1,
+                interleave: int = 1) -> Params:
     """Global (unsharded-shape) parameter pytree. Jit with out_shardings to
     materialize directly as sharded arrays — replaces the reference's
     meta-device init + materialization dance (checkpoint.py:15-48, 50-102).
 
     Real-layer weights are drawn with an [L, ...] leading axis regardless of
-    ``pp_size``, then scattered into the padded [K*pp, ...] stack when the
-    split is uneven — so the model function is identical across topologies
-    and the equivalence oracle holds for uneven splits too."""
+    ``pp_size``/``interleave``, then scattered into the stacked-row layout
+    (padded for uneven splits, chunk-permuted for interleaved 1F1B) — so the
+    model function is identical across topologies and the equivalence oracle
+    holds for every layout."""
     H, I, V, L = m.hidden_size, m.intermediate_size, m.vocab_size, m.num_hidden_layers
     D = m.head_dim
     Hq, Hkv = m.num_attention_heads * D, m.num_key_value_heads * D
@@ -118,8 +138,8 @@ def init_params(key, m: ModelConfig, pp_size: int = 1) -> Params:
         "w_up": _uniform(ks["w_up"], (L, H, I), H, dt),
         "w_down": _uniform(ks["w_down"], (L, I, H), I, dt),
     }
-    if L % pp_size != 0:
-        K, _, positions = pp_layer_layout(L, pp_size)
+    if L % pp_size != 0 or interleave > 1:
+        K, _, positions = pp_layer_layout(L, pp_size, interleave)
         idx = jnp.asarray(positions)
         layers = {
             k: jnp.zeros((K * pp_size,) + v.shape[1:], v.dtype).at[idx].set(v)
@@ -389,56 +409,65 @@ def _stage_gating() -> bool:
     return on_tpu()
 
 
-def _stage_input(params, h_recv, tokens, cfg: Config):
-    """Stage input: the embedding on stage 0, the received activation
-    elsewhere — gated so non-first stages never pay the vocab-parallel
-    embedding lookup (the reference instantiates the embedding only on stage
-    0, pipeline_parallel.py:12-15)."""
+def _stage_input(params, h_recv, tokens, cfg: Config, is_first=None):
+    """Stage input: the embedding on the first (virtual) stage, the received
+    activation elsewhere — gated so non-first stages never pay the
+    vocab-parallel embedding lookup (the reference instantiates the
+    embedding only on stage 0, pipeline_parallel.py:12-15). ``is_first``
+    overrides the default first-stage predicate (the interleaved engine
+    passes "device 0 AND chunk 0")."""
     dt = jnp.dtype(cfg.model.dtype)
     sp = use_sp(cfg)
     if cfg.distributed.pp_size == 1:
         return embed_lookup(params["embed"], tokens, sp).astype(dt)
+    pred = (lax.axis_index("pp") == 0) if is_first is None else is_first
     if _stage_gating():
         return lax.cond(
-            lax.axis_index("pp") == 0,
+            pred,
             lambda: embed_lookup(params["embed"], tokens, sp).astype(dt),
             lambda: h_recv,
         )
     emb = embed_lookup(params["embed"], tokens, sp).astype(dt)
-    return jnp.where(lax.axis_index("pp") == 0, emb, h_recv)
+    return jnp.where(pred, emb, h_recv)
 
 
-def _stage_loss(params, h, targets, cfg: Config):
-    """Loss, computed only on the last stage (reference
+def _stage_loss(params, h, targets, cfg: Config, is_last=None):
+    """Loss, computed only on the last (virtual) stage (reference
     pipeline_parallel.py:67-69, 97-100) — gated so earlier stages skip the
-    LM-head matmul (for SmolLM a 2048x49152 matmul, ~10% of model FLOPs)."""
+    LM-head matmul (for SmolLM a 2048x49152 matmul, ~10% of model FLOPs).
+    ``is_last`` overrides the default last-stage predicate (the interleaved
+    engine passes "device pp-1 AND chunk v-1")."""
     pp = cfg.distributed.pp_size
     if pp == 1:
         return loss_from_hidden(params, h, targets, cfg)
+    pred = (lax.axis_index("pp") == pp - 1) if is_last is None else is_last
     if _stage_gating():
         return lax.cond(
-            lax.axis_index("pp") == pp - 1,
+            pred,
             lambda: loss_from_hidden(params, h, targets, cfg),
             lambda: jnp.zeros((), jnp.float32),
         )
     loss = loss_from_hidden(params, h, targets, cfg)
-    return jnp.where(lax.axis_index("pp") == pp - 1, loss, 0.0)
+    return jnp.where(pred, loss, 0.0)
 
 
-def stage_apply(params, h_recv, tokens, targets, cos, sin, cfg: Config):
+def stage_apply(params, h_recv, tokens, targets, cos, sin, cfg: Config,
+                is_first=None, is_last=None):
     """The uniform per-pipeline-stage program. Returns (h_out, loss) where
     h_out is the activation sent downstream (pre-final-norm) and loss is
     nonzero only on the last stage. Embedding and LM-head/loss are cond-gated
-    to their owning stages, so no stage wastes the other stages' FLOPs."""
-    h = _stage_input(params, h_recv, tokens, cfg)
+    to their owning (virtual) stages, so no stage wastes the other stages'
+    FLOPs."""
+    h = _stage_input(params, h_recv, tokens, cfg, is_first)
     s_local = tokens.shape[-1]
     cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
     h = layers_forward(params["layers"], h, cos_l, sin_l, cfg)
-    loss = _stage_loss(params, h, targets, cfg)
+    loss = _stage_loss(params, h, targets, cfg, is_last)
     return h, loss
 
 
-def stage_fwd_save(params, h_recv, tokens, targets, cos, sin, cfg: Config):
+def stage_fwd_save(params, h_recv, tokens, targets, cos, sin, cfg: Config,
+                   is_first=None, is_last=None):
     """Forward for the manual-backward 1F1B engine: ``stage_apply`` that also
     returns the activations ``stage_bwd`` needs — the input to every local
     layer plus the final hidden state. This is the layer-granular
@@ -449,7 +478,7 @@ def stage_fwd_save(params, h_recv, tokens, targets, cos, sin, cfg: Config):
     construction*: ``training.remat`` governs the AD engines (afab /
     no_pipeline); here the backward always re-derives each layer's VJP from
     its boundary (docs/PP_COST.md)."""
-    h = _stage_input(params, h_recv, tokens, cfg)
+    h = _stage_input(params, h_recv, tokens, cfg, is_first)
     s_local = tokens.shape[-1]
     cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
     valid = layer_valid_mask(params["layers"], cfg)
@@ -463,7 +492,7 @@ def stage_fwd_save(params, h_recv, tokens, targets, cos, sin, cfg: Config):
             lp, v = xs
             return jnp.where(v, decoder_layer(lp, h, cos_l, sin_l, cfg), h), h
         h_final, layer_inputs = lax.scan(body, h, (params["layers"], valid))
-    loss = _stage_loss(params, h_final, targets, cfg)
+    loss = _stage_loss(params, h_final, targets, cfg, is_last)
     # h_final IS buffered (not rederived from layer_inputs[-1] inside the
     # last-stage cond in stage_bwd): with cp>1 the rederiving decoder_layer
     # would put ring-attention ppermutes inside a partially-executed
@@ -474,7 +503,7 @@ def stage_fwd_save(params, h_recv, tokens, targets, cos, sin, cfg: Config):
 
 
 def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
-              cfg: Config):
+              cfg: Config, is_first=None, is_last=None):
     """Manual backward for one stage: given the saved layer boundaries, the
     downstream cotangent ``dh_out`` and the loss cotangent ``dloss``, return
     (dparams, dh_prev). Each layer's backward re-derives its VJP from the
@@ -484,6 +513,8 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
     stages, mirroring ``stage_apply``."""
     pp = cfg.distributed.pp_size
     stage = lax.axis_index("pp")
+    pred_first = (stage == 0) if is_first is None else is_first
+    pred_last = (stage == pp - 1) if is_last is None else is_last
     dt = jnp.dtype(cfg.model.dtype)
     s_local = tokens.shape[-1]
     cos_l, sin_l = slice_rope_for_cp(cos, sin, s_local, cfg)
@@ -502,7 +533,7 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
 
     if _stage_gating():
         d_fnorm, d_lmhead, dh_loss = lax.cond(
-            stage == pp - 1,
+            pred_last,
             loss_vjp,
             lambda: (jnp.zeros_like(params["final_norm"]),
                      jnp.zeros_like(params["lm_head"]),
@@ -544,11 +575,11 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
         return vjp(dh)[0]
 
     if _stage_gating():
-        d_embed = lax.cond(stage == 0, embed_vjp,
+        d_embed = lax.cond(pred_first, embed_vjp,
                            lambda: jnp.zeros_like(params["embed"]))
     else:
-        d_embed = jnp.where(stage == 0, embed_vjp(), 0)
-    dh_prev = jnp.where(stage == 0, jnp.zeros_like(dh), dh)
+        d_embed = jnp.where(pred_first, embed_vjp(), 0)
+    dh_prev = jnp.where(pred_first, jnp.zeros_like(dh), dh)
     dparams = {"embed": d_embed, "layers": d_layers,
                "final_norm": d_fnorm, "lm_head": d_lmhead}
     return dparams, dh_prev

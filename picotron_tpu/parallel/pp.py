@@ -139,6 +139,160 @@ def pipeline_afab(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
     return loss, grads
 
 
+def _scan_phase(carry, ticks, body):
+    """Scan a half- or full-tick body over a contiguous tick range (empty
+    ranges are a no-op). Shared by both 1F1B engines."""
+    if len(ticks) == 0:
+        return carry
+    out, _ = lax.scan(lambda c, t: (body(c, t), None), carry,
+                      jnp.asarray(ticks), unroll=collective_scan_unroll())
+    return out
+
+
+def _full_tick(fwd_half, bwd_half):
+    """Compose the two half-tick bodies into one steady-state tick."""
+    def tick(carry, t):
+        return bwd_half(fwd_half(carry, t), t)
+    return tick
+
+
+def pipeline_1f1b_interleaved(stage_fwd, stage_bwd, params, tokens, targets,
+                              pp_size, v, h_shape, h_dtype):
+    """Interleaved (virtual-stage) 1F1B: each device holds ``v``
+    non-contiguous model chunks (chunk-major rows of its 'pp' shard, layout
+    ``llama.pp_layer_layout(L, pp, v)``), shrinking the pipeline bubble by
+    ``v``. Beyond the reference (SURVEY §2.3: "no interleaved/virtual
+    stages").
+
+    The schedule is tick-uniform SPMD: every device processes (chunk,
+    microbatch) *units* in the same global order — microbatches in groups of
+    pp, each group passing chunk 0..v-1 (Megatron's grouping) — with
+
+      fwd unit  k = t - s                and
+      bwd unit  j = t - (pp-1-s) - OFF,  OFF = v*pp - 1,
+
+    where the unit orders are
+      fwd k -> chunk (k mod pp*v) // pp,  micro (k // (pp*v))*pp + k mod pp
+      bwd j -> same but chunks descending.
+    Boundary activations move on ONE circular ppermute per direction: the
+    s -> s+1 edges carry same-chunk hand-off and the wrap edge pp-1 -> 0
+    carries the chunk c -> c+1 transition (its garbage arrivals land exactly
+    on units masked as first-virtual-stage/loss-seeded). This reproduces
+    Megatron's interleaved warmup counts ((pp-s-1)*2 + (v-1)*pp) and
+    steady-state exactly. Requires M % pp == 0 (validated in config).
+
+    stage_fwd(chunk_params, h, tok, tgt, is_first, is_last)
+        -> (h_out, loss, saved)
+    stage_bwd(chunk_params, saved, tok, tgt, dh_out, dloss, is_first,
+        is_last) -> (dparams, dh_prev)
+    with is_first/is_last the first/last *virtual* stage predicates.
+    """
+    M = tokens.shape[0]
+    N = M * v
+    s = lax.axis_index("pp")
+    OFF = v * pp_size - 1
+    # bwd consumes units chunk-descending, so a chunk-0 slot lives up to
+    # 2*v*pp - 2 fwd units before its backward claims it
+    BUF = 2 * v * pp_size
+    down = [(i, (i + 1) % pp_size) for i in range(pp_size)]  # circular
+    up = [((i + 1) % pp_size, i) for i in range(pp_size)]
+    K = jax.tree.leaves(params["layers"])[0].shape[0]
+    Kv = K // v
+
+    def chunk_params(c):
+        layers = jax.tree.map(
+            lambda x: lax.dynamic_slice_in_dim(x, c * Kv, Kv, 0),
+            params["layers"])
+        return {**params, "layers": layers}
+
+    def unit_fwd(k):
+        g = k // (pp_size * v)
+        c = (k % (pp_size * v)) // pp_size
+        m = g * pp_size + k % pp_size
+        return c, m
+
+    def unit_bwd(j):
+        g = j // (pp_size * v)
+        c = v - 1 - (j % (pp_size * v)) // pp_size
+        m = g * pp_size + j % pp_size
+        return c, m
+
+    gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    h0 = jnp.zeros(h_shape, h_dtype)
+    tok0, tgt0 = _take_mb(tokens, 0), _take_mb(targets, 0)
+    t_pred = jnp.bool_(True)
+    saved_shape = jax.eval_shape(
+        lambda p, h, tok, tgt: stage_fwd(p, h, tok, tgt, t_pred, t_pred)[2],
+        chunk_params(0), h0, tok0, tgt0)
+    sbuf0 = jax.tree.map(
+        lambda sh: jnp.zeros((BUF,) + tuple(sh.shape), sh.dtype), saved_shape)
+
+    def fwd_half(carry, t):
+        h_recv, dh_recv, sbuf, gacc, loss_acc = carry
+        k = t - s
+        fvalid = (k >= 0) & (k < N)
+        kk = jnp.clip(k, 0, N - 1)
+        c, m = unit_fwd(kk)
+        is_first = (s == 0) & (c == 0)
+        is_last = (s == pp_size - 1) & (c == v - 1)
+        h_out, loss_mb, saved = stage_fwd(
+            chunk_params(c), h_recv, _take_mb(tokens, m), _take_mb(targets, m),
+            is_first, is_last)
+        loss_acc = loss_acc + jnp.where(fvalid, loss_mb, 0.0)
+        sbuf = jax.tree.map(
+            lambda buf, val: lax.dynamic_update_index_in_dim(
+                buf, jnp.where(fvalid, val, _take_mb(buf, kk % BUF)),
+                kk % BUF, 0),
+            sbuf, saved)
+        h_next = lax.ppermute(h_out, "pp", down)
+        return (h_next, dh_recv, sbuf, gacc, loss_acc)
+
+    def bwd_half(carry, t):
+        h_recv, dh_recv, sbuf, gacc, loss_acc = carry
+        j = t - (pp_size - 1 - s) - OFF
+        bvalid = (j >= 0) & (j < N)
+        jj = jnp.clip(j, 0, N - 1)
+        c, m = unit_bwd(jj)
+        # fwd index of this unit: k - j = (2c - v + 1) * pp
+        k_of_j = jj + (2 * c - v + 1) * pp_size
+        saved_b = jax.tree.map(lambda buf: _take_mb(buf, k_of_j % BUF), sbuf)
+        is_first = (s == 0) & (c == 0)
+        is_last = (s == pp_size - 1) & (c == v - 1)
+        dh_out = jnp.where(is_last, jnp.zeros_like(dh_recv), dh_recv)
+        dloss = jnp.where(is_last & bvalid, 1.0 / M, 0.0).astype(jnp.float32)
+        dparams, dh_prev = stage_bwd(
+            chunk_params(c), saved_b, _take_mb(tokens, m), _take_mb(targets, m),
+            dh_out, dloss, is_first, is_last)
+        dparams = jax.tree.map(lambda g: jnp.where(bvalid, g, 0), dparams)
+        # layer grads land in this chunk's rows of the [K]-row accumulator;
+        # everything else accumulates whole
+        glayers = jax.tree.map(
+            lambda acc, g: lax.dynamic_update_slice_in_dim(
+                acc,
+                lax.dynamic_slice_in_dim(acc, c * Kv, Kv, 0)
+                + g.astype(jnp.float32),
+                c * Kv, 0),
+            gacc["layers"], dparams["layers"])
+        gacc = {
+            k2: (glayers if k2 == "layers"
+                 else jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                   gacc[k2], dparams[k2]))
+            for k2 in gacc
+        }
+        dh_next = lax.ppermute(dh_prev, "pp", up)
+        return (h_recv, dh_next, sbuf, gacc, loss_acc)
+
+    carry = (h0, jnp.zeros(h_shape, h_dtype), sbuf0, gacc0, jnp.float32(0.0))
+    carry = _scan_phase(carry, range(OFF), fwd_half)
+    carry = _scan_phase(carry, range(OFF, N + pp_size - 1),
+                        _full_tick(fwd_half, bwd_half))
+    carry = _scan_phase(carry, range(N + pp_size - 1, N + pp_size - 1 + OFF),
+                        bwd_half)
+    loss_acc, gacc = carry[4], carry[3]
+    loss = lax.psum(loss_acc, "pp") / M
+    return loss, gacc
+
+
 def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
                   h_shape, h_dtype):
     """(loss, grads_fp32) via the interleaved one-forward-one-backward schedule.
@@ -226,21 +380,12 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
     # non-interleaved 1F1B (docs/PP_COST.md). The wire crossings match the
     # reference's fused send-fwd/recv-bwd pairs (pp_communications.py:34-46);
     # XLA schedules the two permutes of a steady tick together.
-    def scan_phase(carry, ticks, body):
-        if len(ticks) == 0:
-            return carry
-        out, _ = lax.scan(lambda c, t: (body(c, t), None), carry,
-                          jnp.asarray(ticks), unroll=collective_scan_unroll())
-        return out
-
-    def full_tick(carry, t):
-        return bwd_half(fwd_half(carry, t), t)
-
     carry = (h0, jnp.zeros(h_shape, h_dtype), sbuf0, gacc0, jnp.float32(0.0))
-    carry = scan_phase(carry, range(pp_size - 1), fwd_half)
-    carry = scan_phase(carry, range(pp_size - 1, M + pp_size - 1), full_tick)
-    carry = scan_phase(carry, range(M + pp_size - 1, M + 2 * pp_size - 2),
-                       bwd_half)
+    carry = _scan_phase(carry, range(pp_size - 1), fwd_half)
+    carry = _scan_phase(carry, range(pp_size - 1, M + pp_size - 1),
+                        _full_tick(fwd_half, bwd_half))
+    carry = _scan_phase(carry, range(M + pp_size - 1, M + 2 * pp_size - 2),
+                        bwd_half)
     loss_acc, gacc = carry[4], carry[3]
     loss = lax.psum(loss_acc, "pp") / M
     return loss, gacc

@@ -99,6 +99,7 @@ def create_single_config(
     *,
     tp: int = 1, cp: int = 1, dp: int = 1, pp: int = 1,
     pp_engine: str = "1f1b",
+    pp_interleave: Optional[int] = None,
     cp_zigzag: Optional[bool] = None,
     cp_impl: Optional[str] = None,
     tp_sequence_parallel: Optional[bool] = None,
@@ -136,7 +137,9 @@ def create_single_config(
     d = content["distributed"]
     d.update(tp_size=tp, cp_size=cp, dp_size=dp, pp_size=pp,
              pp_engine=pp_engine, use_cpu=use_cpu)
-    if cp_zigzag is not None:  # None = keep the template's value
+    if pp_interleave is not None:  # None = keep the template's value
+        d["pp_interleave"] = pp_interleave
+    if cp_zigzag is not None:
         d["cp_zigzag"] = cp_zigzag
     if cp_impl is not None:
         d["cp_impl"] = cp_impl
@@ -218,6 +221,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--pp_engine", type=str, default="1f1b")
+    p.add_argument("--pp_interleave", type=int, default=None,
+                   help="virtual pipeline stages per device (interleaved "
+                        "1F1B; shrinks the bubble by this factor)")
     p.add_argument("--cp_zigzag", action="store_true", default=None,
                    help="load-balanced zigzag context-parallel layout")
     p.add_argument("--cp_impl", type=str, default=None,
@@ -273,7 +279,8 @@ def main(argv=None) -> int:
     path = create_single_config(
         out_dir=args.out_dir, exp_name=args.exp_name,
         tp=args.tp, cp=args.cp, dp=args.dp, pp=args.pp,
-        pp_engine=args.pp_engine, cp_zigzag=args.cp_zigzag,
+        pp_engine=args.pp_engine, pp_interleave=args.pp_interleave,
+        cp_zigzag=args.cp_zigzag,
         cp_impl=args.cp_impl,
         tp_sequence_parallel=args.tp_sequence_parallel, zero1=args.zero1,
         model_name=args.model_name,

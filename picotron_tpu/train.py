@@ -89,7 +89,9 @@ def train(cfg, max_steps_override: Optional[int] = None):
     loader = MicroBatchDataLoader(cfg)
     params, opt_state = ts.init_state(cfg, topo)
     if c.hf_bootstrap_path:
-        params = ckpt_mod.load_hf_safetensors(c.hf_bootstrap_path, m, topo)
+        params = ckpt_mod.load_hf_safetensors(
+            c.hf_bootstrap_path, m, topo,
+            interleave=cfg.distributed.pp_interleave)
     spc = t.steps_per_call
     step_fn = ts.build_train_step(cfg, topo, multi_step=spc)
     step_fn_single = step_fn if spc == 1 else None  # lazily built for the tail
@@ -98,7 +100,8 @@ def train(cfg, max_steps_override: Optional[int] = None):
     if c.save_frequency > 0 or c.load_path:
         manager = ckpt_mod.CheckpointManager(c.load_path or c.save_dir)
 
-    layout = (m.num_hidden_layers, cfg.distributed.pp_size)
+    layout = (m.num_hidden_layers, cfg.distributed.pp_size,
+              cfg.distributed.pp_interleave)
     z1 = (cfg.distributed.zero1, cfg.distributed.dp_size)
     step, trained_tokens = 0, 0
     if c.load_path:

@@ -34,7 +34,12 @@ from jax.tree_util import tree_flatten_with_path, tree_unflatten
 
 from picotron_tpu.config import Config
 from picotron_tpu.models import llama
-from picotron_tpu.parallel.pp import no_pipeline, pipeline_1f1b, pipeline_afab
+from picotron_tpu.parallel.pp import (
+    no_pipeline,
+    pipeline_1f1b,
+    pipeline_1f1b_interleaved,
+    pipeline_afab,
+)
 from picotron_tpu.parallel.tp import all_gather_dim, reduce_scatter_dim
 from picotron_tpu.topology import Topology, batch_pspec, named_shardings
 
@@ -166,7 +171,8 @@ def zero1_opt_pspecs(cfg: Config, optimizer, pspecs):
     chunk specs by path suffix (scalars like count stay replicated)."""
     dp = cfg.distributed.dp_size
     p_shape = jax.eval_shape(
-        partial(llama.init_params, m=cfg.model, pp_size=cfg.distributed.pp_size),
+        partial(llama.init_params, m=cfg.model, pp_size=cfg.distributed.pp_size,
+                interleave=cfg.distributed.pp_interleave),
         jax.random.PRNGKey(0))
     chunk_shape = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct((_zero1_chunk_len(p.size, dp),), p.dtype),
@@ -236,7 +242,8 @@ def init_state(cfg: Config, topo: Topology, seed: int | None = None):
     key = jax.random.PRNGKey(seed)
     params = jax.jit(
         partial(llama.init_params, m=cfg.model,
-                pp_size=cfg.distributed.pp_size),
+                pp_size=cfg.distributed.pp_size,
+                interleave=cfg.distributed.pp_interleave),
         out_shardings=shardings)(key)
 
     if cfg.distributed.zero1:
@@ -276,7 +283,8 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
         o_shape = jax.eval_shape(
             optimizer.init,
             jax.eval_shape(partial(llama.init_params, m=cfg.model,
-                                   pp_size=cfg.distributed.pp_size),
+                                   pp_size=cfg.distributed.pp_size,
+                                   interleave=cfg.distributed.pp_interleave),
                            jax.random.PRNGKey(0)))
         ospecs = opt_pspecs(o_shape, pspecs)
     bspec = batch_pspec()
@@ -296,6 +304,16 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
             acc_dt = dt if cfg.training.grad_accum_dtype == "param" else jnp.float32
             loss, grads = no_pipeline(stage_fn, params, tokens, targets,
                                       h_shape, dt, acc_dt)
+        elif engine == "1f1b" and cfg.distributed.pp_interleave > 1:
+            vch = cfg.distributed.pp_interleave
+            stage_fwd = lambda p, h, tok, tgt, fi, la: llama.stage_fwd_save(
+                p, h, tok, tgt, cos, sin, cfg, fi, la)
+            stage_bwd = lambda p, saved, tok, tgt, dh, dl, fi, la: \
+                llama.stage_bwd(p, saved, tok, tgt, dh, dl, cos, sin, cfg,
+                                fi, la)
+            loss, grads = pipeline_1f1b_interleaved(
+                stage_fwd, stage_bwd, params, tokens, targets, pp, vch,
+                h_shape, dt)
         elif engine == "1f1b":
             stage_fwd = lambda p, h, tok, tgt: llama.stage_fwd_save(
                 p, h, tok, tgt, cos, sin, cfg)

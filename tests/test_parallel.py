@@ -71,6 +71,12 @@ TOPOLOGIES = [
     dict(cp=4, cp_impl="ulysses"),
     dict(tp=2, cp=2, cp_impl="ulysses", sp=True),
     dict(dp=2, pp=2, cp=2, acc=2, engine="1f1b", cp_impl="ulysses"),
+    # Interleaved 1F1B (virtual pipeline stages, beyond-parity — SURVEY §2.3
+    # notes the reference has none): chunked layer placement + the
+    # tick-uniform interleaved schedule must reproduce the same trajectories
+    dict(pp=2, acc=2, engine="1f1b", interleave=2),
+    dict(pp=2, acc=4, engine="1f1b", interleave=2),
+    dict(dp=2, pp=2, tp=2, acc=2, engine="1f1b", interleave=2),
 ]
 
 
