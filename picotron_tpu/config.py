@@ -84,6 +84,11 @@ class ModelConfig:
     # ops/pallas/flash_attention.py). Tuning knobs for other chips/shapes.
     flash_block_q: Optional[int] = None
     flash_block_k: Optional[int] = None
+    # Kernel data layout: "folded" reshapes [B,S,H,D] -> [B*H,S,D] around
+    # every kernel call (battle-tested default); "bshd" runs the kernels on
+    # the model layout directly, skipping the host-side transpose copies
+    # (opt-in until A/B'd on hardware; interpret-mode-verified identical).
+    flash_layout: str = "folded"
     use_pallas_rmsnorm: Optional[bool] = None  # None = auto (TPU only)
     # gather logits over tp before the loss (reference tensor_parallel.py:48-50
     # gather_output=True); False = vocab-parallel cross-entropy (faster).
@@ -301,6 +306,9 @@ class Config:
         if t.grad_accum_dtype not in ("float32", "param"):
             raise ValueError(
                 f"unknown grad_accum_dtype {t.grad_accum_dtype!r} (float32|param)")
+        if m.flash_layout not in ("folded", "bshd"):
+            raise ValueError(
+                f"unknown flash_layout {m.flash_layout!r} (folded|bshd)")
         for name, b in (("flash_block_q", m.flash_block_q),
                         ("flash_block_k", m.flash_block_k)):
             # Powers of two keep the kernel's halve-until-divides fallback

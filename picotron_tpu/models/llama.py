@@ -214,19 +214,22 @@ def _attention(q, k, v, cfg: Config):
                                      cfg.distributed.cp_size, True,
                                      impl == "flash",
                                      cfg.model.flash_block_q,
-                                     cfg.model.flash_block_k)
+                                     cfg.model.flash_block_k,
+                                     cfg.model.flash_layout)
         # ring with Pallas flash blocks on TPU, XLA einsum blocks elsewhere
         return ring_attention(q, k, v, scale, "cp", cfg.distributed.cp_size,
                               True, impl == "flash",
                               cfg.distributed.cp_zigzag,
                               cfg.model.flash_block_q,
-                              cfg.model.flash_block_k)
+                              cfg.model.flash_block_k,
+                              cfg.model.flash_layout)
     if impl == "flash":
         from picotron_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, scale, causal=True,
                                block_q=cfg.model.flash_block_q,
-                               block_k=cfg.model.flash_block_k)
+                               block_k=cfg.model.flash_block_k,
+                               layout=cfg.model.flash_layout)
     return sdpa(q, k, v, scale, causal=True)
 
 
@@ -597,6 +600,14 @@ def forward_logits(params, tokens, cfg: Config, gather: bool = True):
     ``parallel.cp.zigzag_inverse_perm`` to the sequence axis to get
     original-order logits. Feeding original-order tokens with cp_zigzag set
     silently computes with wrong positions/masks."""
+    if cfg.distributed.pp_interleave > 1 and cfg.distributed.pp_size > 1:
+        # the interleaved layout stores layer rows chunk-permuted; this eval
+        # path scans rows in stacked order, which would silently run the
+        # layers out of order — restore the checkpoint under a contiguous
+        # layout (CheckpointManager.load with layout=(L, 1)) to eval
+        raise ValueError(
+            "forward_logits does not support the interleaved layer layout "
+            "(pp_interleave > 1); remap to a contiguous layout first")
     cos, sin = rope_tables(cfg)
     dt = jnp.dtype(cfg.model.dtype)
     h = embed_lookup(params["embed"], tokens, use_sp(cfg)).astype(dt)

@@ -6,14 +6,24 @@ Same asymptotics as FlashAttention-2: O(S) memory (never materializes the
 [S, S] score matrix in HBM), online softmax in fp32, log-sum-exp saved for
 the backward, which re-derives P per block.
 
-Layout: the model's [B, S, H, D] is folded to [B*H, S, D]; the grid walks
-(batch*head, query-block) for the forward/dq and (batch*head, key-block) for
-dk/dv. K/V for one head live whole in VMEM (S*D*2B ~ 1 MB at S=8192, D=64)
+Two data layouts share the same kernel bodies (``model.flash_layout``):
+
+- "folded" (default, battle-tested): the model's [B, S, H, D] is folded to
+  [B*H, S, D] around the pallas_call; the grid walks (batch*head, q-block).
+  The fold is a host-side transpose+reshape copy of every operand per call.
+- "bshd" (opt-in until A/B'd on hardware): the kernels consume [B, S, H, D]
+  directly — grid (batch, head, q-block), the head dimension squeezed out
+  by a size-None BlockSpec entry — so each kernel instance sees identical
+  [block, D] tiles with ZERO host-side transpose copies (the fold costs ~2
+  HBM round trips of q/k/v/out fwd and q/k/v/out/dout bwd that XLA cannot
+  fuse into the custom call).
+
+K/V for one head live whole in VMEM (S*D*2B ~ 1 MB at S=8192, D=64)
 while scores exist only as a [block_q, block_k] VMEM tile — the MXU sees
 (block_q x D) @ (D x block_k) and (block_q x block_k) @ (block_k x D)
-matmuls, all 128-aligned. The per-row LSE is materialized as [BH, S, 128]
-with the value broadcast across the 128-lane minor dim — Mosaic requires the
-last two block dims be (8k, 128m), so a [BH, S] layout can't be tiled
+matmuls, all 128-aligned. The per-row LSE is materialized with a broadcast
+128-lane minor dim ([BH, S, 128] / [B, S, H, 128]) — Mosaic requires the
+last two block dims be (8k, 128m), so a lane-less layout can't be tiled
 per-q-block (the in-tree TPU flash kernel uses the same trick).
 
 Causality is handled at two levels: whole key-blocks strictly above the
@@ -63,12 +73,13 @@ def _causal_band(s, q0, k0, bq, bk):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
-                block_k, causal):
+                block_k, causal, blk_axis=1):
     # Matmul inputs stay in their native dtype (bf16 in training) with fp32
     # accumulation via preferred_element_type — fp32 MXU issue rate is 1/8
     # of bf16 on TPU, so casting q/k/v up would throttle the whole kernel.
-    # Softmax state (m, l, acc) is fp32.
-    qi = pl.program_id(1)
+    # Softmax state (m, l, acc) is fp32. blk_axis: which grid axis walks the
+    # q-blocks (1 = folded (BH, nq) grid, 2 = bshd (B, H, nq) grid).
+    qi = pl.program_id(blk_axis)
     q = q_ref[0]  # [bq, D]
     seq_k = k_ref.shape[1]
     nk = seq_k // block_k
@@ -102,33 +113,56 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
     lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (bq, LANE))
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
-    """Returns (out [BH,Sq,D], lse [BH,Sq,LANE] broadcast layout, fp32).
-    Sq and Sk may differ (ring-attention half blocks); causal requires
-    Sq == Sk (aligned positions)."""
-    bh, sq, d = q.shape
-    sk = k.shape[1]
+def _fwd(q, k, v, scale, causal, block_q, block_k, layout="folded"):
+    """folded: q [BH,Sq,D] -> (out [BH,Sq,D], lse [BH,Sq,LANE]).
+    bshd: q [B,Sq,H,D] -> (out [B,Sq,H,D], lse [B,Sq,H,LANE]).
+    LSE is the broadcast-lane fp32 layout. Sq and Sk may differ
+    (ring-attention half blocks); causal requires Sq == Sk (aligned
+    positions)."""
+    sq, sk = q.shape[1], k.shape[1]
+    d = q.shape[-1]
     assert not causal or sq == sk
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
-    grid = (bh, sq // bq)
-    out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, block_q=bq, block_k=bk,
-                          causal=causal),
-        grid=grid,
-        in_specs=[
+    if layout == "folded":
+        bh = q.shape[0]
+        grid = (bh, sq // bq)
+        blk_axis = 1
+        in_specs = [
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[
+        ]
+        out_specs = [
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, LANE), lambda b, i: (b, i, 0)),
-        ],
-        out_shape=[
+        ]
+        out_shape = [
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq, LANE), jnp.float32),
-        ],
+        ]
+    else:
+        b, h = q.shape[0], q.shape[2]
+        grid = (b, h, sq // bq)
+        blk_axis = 2
+        in_specs = [
+            pl.BlockSpec((1, bq, None, d), lambda b, hh, i: (b, i, hh, 0)),
+            pl.BlockSpec((1, sk, None, d), lambda b, hh, i: (b, 0, hh, 0)),
+            pl.BlockSpec((1, sk, None, d), lambda b, hh, i: (b, 0, hh, 0)),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, bq, None, d), lambda b, hh, i: (b, i, hh, 0)),
+            pl.BlockSpec((1, bq, None, LANE), lambda b, hh, i: (b, i, hh, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
+            jax.ShapeDtypeStruct((b, sq, h, LANE), jnp.float32),
+        ]
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_q=bq, block_k=bk,
+                          causal=causal, blk_axis=blk_axis),
+        grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape,
     )(q, k, v)
     return out, lse
 
@@ -139,8 +173,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
-                   scale, block_q, block_k, causal):
-    qi = pl.program_id(1)
+                   scale, block_q, block_k, causal, blk_axis=1):
+    qi = pl.program_id(blk_axis)
     q = q_ref[0]
     do = do_ref[0]
     lse = lse_ref[0][:, 0:1]
@@ -171,8 +205,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, *, scale, block_q, block_k, causal):
-    kj = pl.program_id(1)
+                    dk_ref, dv_ref, *, scale, block_q, block_k, causal,
+                    blk_axis=1):
+    kj = pl.program_id(blk_axis)
     k = k_ref[0]  # [bk, D]
     v = v_ref[0]
     seq_q = q_ref.shape[1]
@@ -209,53 +244,77 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, dout):
+def _bwd(scale, causal, block_q, block_k, layout, res, dout):
     q, k, v, out, lse_c = res
-    bh, sq, d = q.shape
-    sk = k.shape[1]
-    # Residuals carry the compact [BH, Sq] LSE (the broadcast LANE layout is
-    # 128x larger, which matters when a remat policy saves it); re-broadcast
-    # to the Mosaic-tileable layout here, transiently.
-    lse = jnp.broadcast_to(lse_c[:, :, None], (bh, sq, LANE))
+    sq, sk = q.shape[1], k.shape[1]
+    d = q.shape[-1]
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
+    # Residuals carry the compact (lane-less) LSE (the broadcast LANE layout
+    # is 128x larger, which matters when a remat policy saves it);
+    # re-broadcast to the Mosaic-tileable layout here, transiently.
+    lse = jnp.broadcast_to(lse_c[..., None], lse_c.shape + (LANE,))
+
+    if layout == "folded":
+        bh = q.shape[0]
+        dq_grid, dkv_grid, blk_axis = (bh, sq // bq), (bh, sk // bk), 1
+
+        def spec(n, lane=False):  # block of n rows (or whole axis), d/LANE wide
+            w = LANE if lane else d
+            if n is None:  # whole seq axis
+                return pl.BlockSpec((1, sq, w), lambda b, i: (b, 0, 0))
+            return pl.BlockSpec((1, n, w), lambda b, i: (b, i, 0))
+
+        def kspec(n):
+            if n is None:
+                return pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0))
+            return pl.BlockSpec((1, n, d), lambda b, i: (b, i, 0))
+
+        dq_shape = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
+        dkv_shape = [jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                     jax.ShapeDtypeStruct((bh, sk, d), v.dtype)]
+    else:
+        b, h = q.shape[0], q.shape[2]
+        dq_grid, dkv_grid, blk_axis = (b, h, sq // bq), (b, h, sk // bk), 2
+
+        def spec(n, lane=False):
+            w = LANE if lane else d
+            if n is None:
+                return pl.BlockSpec((1, sq, None, w),
+                                    lambda b, hh, i: (b, 0, hh, 0))
+            return pl.BlockSpec((1, n, None, w),
+                                lambda b, hh, i: (b, i, hh, 0))
+
+        def kspec(n):
+            if n is None:
+                return pl.BlockSpec((1, sk, None, d),
+                                    lambda b, hh, i: (b, 0, hh, 0))
+            return pl.BlockSpec((1, n, None, d),
+                                lambda b, hh, i: (b, i, hh, 0))
+
+        dq_shape = jax.ShapeDtypeStruct((b, sq, h, d), q.dtype)
+        dkv_shape = [jax.ShapeDtypeStruct((b, sk, h, d), k.dtype),
+                     jax.ShapeDtypeStruct((b, sk, h, d), v.dtype)]
+
+    # operand order is layout-independent; only spec/kspec/grids/shapes vary
+    dq_in = [spec(bq), kspec(None), kspec(None), spec(bq), spec(bq),
+             spec(bq, lane=True)]
+    dq_out = spec(bq)
+    dkv_in = [spec(None), kspec(bk), kspec(bk), spec(None), spec(None),
+              spec(None, lane=True)]
+    dkv_out = [kspec(bk), kspec(bk)]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk,
-                          causal=causal),
-        grid=(bh, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, LANE), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                          causal=causal, blk_axis=blk_axis),
+        grid=dq_grid, in_specs=dq_in, out_specs=dq_out, out_shape=dq_shape,
     )(q, k, v, out, dout, lse)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq,
-                          block_k=bk, causal=causal),
-        grid=(bh, sk // bk),
-        in_specs=[
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, sq, LANE), lambda b, j: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
-        ],
+                          block_k=bk, causal=causal, blk_axis=blk_axis),
+        grid=dkv_grid, in_specs=dkv_in, out_specs=dkv_out,
+        out_shape=dkv_shape,
     )(q, k, v, out, dout, lse)
     return dq, dk, dv
 
@@ -265,56 +324,74 @@ def _bwd(scale, causal, block_q, block_k, res, dout):
 # --------------------------------------------------------------------------- #
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+def _check_layout(layout: str) -> None:
+    if layout not in ("folded", "bshd"):
+        raise ValueError(f"unknown flash layout {layout!r} (folded|bshd)")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, scale, causal, block_q, block_k, layout):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k, layout)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, layout):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, layout)
     # checkpoint_name lets a selective remat policy (llama.layers_forward,
     # remat="save_attn") keep out+lse across the backward, so rematerialized
     # backward passes skip the flash forward kernel entirely.
     out = checkpoint_name(out, "flash_out")
-    lse_c = checkpoint_name(lse[:, :, 0], "flash_lse")
+    lse_c = checkpoint_name(lse[..., 0], "flash_lse")
     return out, (q, k, v, out, lse_c)
 
 
-_flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
+_flash_core.defvjp(_flash_fwd_rule, _bwd)
 
 
 def flash_attention(q, k, v, scale: float | None = None, causal: bool = True,
                     block_q: int | None = None,
-                    block_k: int | None = None):
-    """q, k, v: [B, S, H, D] with equal head counts. Returns [B, S, H, D]."""
+                    block_k: int | None = None,
+                    layout: str = "folded"):
+    """q, k, v: [B, S, H, D] with equal head counts. Returns [B, S, H, D].
+    layout="bshd" runs the kernels on the model layout directly (no fold
+    copies); "folded" is the default until the bshd variant is A/B'd on
+    hardware."""
+    _check_layout(layout)
     b, s, h, d = q.shape
     block_q = block_q or DEFAULT_BLOCK_Q
     block_k = block_k or DEFAULT_BLOCK_K
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if layout == "bshd":
+        return _flash_core(q, k, v, float(scale), causal, block_q, block_k,
+                           "bshd")
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    out = _flash_bhsd(fold(q), fold(k), fold(v), float(scale), causal,
-                      block_q, block_k)
+    out = _flash_core(fold(q), fold(k), fold(v), float(scale), causal,
+                      block_q, block_k, "folded")
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 def flash_block_grads(q, k, v, out, lse, dout, scale: float,
                       causal: bool = True,
                       block_q: int | None = None,
-                      block_k: int | None = None):
+                      block_k: int | None = None,
+                      layout: str = "folded"):
     """Gradients of one attention block given an externally-merged (global)
     out/lse — the ring-attention backward building block (the ring re-derives
     each block's true share of the global softmax as exp(s - lse_global),
     reference context_parallel.py:112-155). q/out/dout are [B, Sq, H, D],
     k/v are [B, Sk, H, D] (Sq != Sk allowed for ring half-blocks, non-causal
     only); lse is [B, Sq, H] fp32. Returns (dq, dk, dv)."""
+    _check_layout(layout)
     b, sq, h, d = q.shape
     block_q = block_q or DEFAULT_BLOCK_Q
     block_k = block_k or DEFAULT_BLOCK_K
+    if layout == "bshd":
+        return _bwd(scale, causal, block_q, block_k, "bshd",
+                    (q, k, v, out, lse), dout)
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     lse_c = lse.transpose(0, 2, 1).reshape(b * h, sq)
-    dq, dk, dv = _bwd(scale, causal, block_q, block_k,
+    dq, dk, dv = _bwd(scale, causal, block_q, block_k, "folded",
                       (fold(q), fold(k), fold(v), fold(out), lse_c),
                       fold(dout))
     unfold = lambda x: x.reshape(b, h, x.shape[1], d).transpose(0, 2, 1, 3)
@@ -324,17 +401,23 @@ def flash_block_grads(q, k, v, out, lse, dout, scale: float,
 def flash_attention_with_lse(q, k, v, scale: float | None = None,
                              causal: bool = True,
                              block_q: int | None = None,
-                             block_k: int | None = None):
+                             block_k: int | None = None,
+                             layout: str = "folded"):
     """Forward-only variant returning (out [B,Sq,H,D], lse [B,Sq,H]) — the
     building block for ring attention's LSE merge. Sq != Sk allowed
     (non-causal only)."""
+    _check_layout(layout)
     b, s, h, d = q.shape
     block_q = block_q or DEFAULT_BLOCK_Q
     block_k = block_k or DEFAULT_BLOCK_K
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if layout == "bshd":
+        out, lse = _fwd(q, k, v, float(scale), causal, block_q, block_k,
+                        "bshd")
+        return out, lse[..., 0]
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     out, lse = _fwd(fold(q), fold(k), fold(v), float(scale), causal,
-                    block_q, block_k)
+                    block_q, block_k, "folded")
     return (out.reshape(b, h, s, d).transpose(0, 2, 1, 3),
             lse[:, :, 0].reshape(b, h, s).transpose(0, 2, 1))

@@ -97,23 +97,24 @@ def _block_mask(s_q: int, s_k: int, src, rank, causal: bool, n: int,
     return qpos[:, None] >= kpos[None, :]
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
 def ring_attention(q, k, v, scale: float, axis: str, axis_size: int,
                    causal: bool, use_flash: bool = False,
                    zigzag: bool = False, block_q: int | None = None,
-                   block_k: int | None = None):
+                   block_k: int | None = None,
+                   flash_layout: str = "folded"):
     """q, k, v: [B, S_local, H, D] (kv heads already GQA-repeated, as the
     reference repeats before the ring, model.py:141-142). Returns [B,S,H,D].
     use_flash selects the Pallas block kernel (TPU) over the XLA einsum;
     zigzag expects the zigzag_perm() sequence layout and balances causal
     work across ranks."""
     out, _ = _ring_fwd_impl(q, k, v, scale, axis, axis_size, causal,
-                            use_flash, zigzag, block_q, block_k)
+                            use_flash, zigzag, block_q, block_k, flash_layout)
     return out
 
 
 def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag,
-               block_q=None, block_k=None):
+               block_q=None, block_k=None, flash_layout="folded"):
     """One ring block -> (out [B,S,H,D] fp32, lse [B,S,H] fp32), with skipped
     (sub-)blocks returning lse=-inf rows (identity under the merge)."""
     b, s, h, d = q.shape
@@ -127,7 +128,7 @@ def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag,
     from picotron_tpu.ops.pallas.flash_attention import flash_attention_with_lse
 
     flash = partial(flash_attention_with_lse, scale=scale,
-                    block_q=block_q, block_k=block_k)
+                    block_q=block_q, block_k=block_k, layout=flash_layout)
 
     def full(_):
         o, l = flash(q, kt, vt, causal=False)
@@ -169,7 +170,7 @@ def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag,
 
 
 def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag,
-                   block_q=None, block_k=None):
+                   block_q=None, block_k=None, flash_layout="folded"):
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     b, s, h, d = q.shape
@@ -181,7 +182,8 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag,
         kt, vt = kv
         src = (rank - t) % n
         blk_out, blk_lse = _block_fwd(q, kt, vt, scale, src, rank, causal,
-                                      use_flash, n, zigzag, block_q, block_k)
+                                      use_flash, n, zigzag, block_q, block_k,
+                                      flash_layout)
         # LSE merge (reference context_parallel.py:170-171):
         #   out <- out - sigmoid(blk_lse - lse) * (out - blk_out)
         #   lse <- logaddexp(lse, blk_lse)
@@ -197,9 +199,9 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag,
 
 
 def _ring_fwd(q, k, v, scale, axis, n, causal, use_flash, zigzag,
-              block_q=None, block_k=None):
+              block_q=None, block_k=None, flash_layout="folded"):
     out, lse = _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash,
-                              zigzag, block_q, block_k)
+                              zigzag, block_q, block_k, flash_layout)
     return out, (q, k, v, out, lse)
 
 
@@ -227,7 +229,8 @@ def _block_bwd_einsum(q, kt, vt, dout, out_unused, lse, D, scale, src, rank,
 
 
 def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal,
-                     zigzag, block_q=None, block_k=None):
+                     zigzag, block_q=None, block_k=None,
+                     flash_layout="folded"):
     """One block's (dq, dk, dv) via the Pallas backward kernels fed the
     globally-merged out/lse (skip branch costs nothing at runtime)."""
     from picotron_tpu.ops.pallas.flash_attention import flash_block_grads
@@ -235,7 +238,7 @@ def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal,
     b, s, h, d = q.shape
     f32 = lambda t: tuple(x.astype(jnp.float32) for x in t)
     grads = partial(flash_block_grads, scale=scale,
-                    block_q=block_q, block_k=block_k)
+                    block_q=block_q, block_k=block_k, layout=flash_layout)
 
     def full(_):
         return f32(grads(q, kt, vt, out, lse, dout, causal=False))
@@ -272,7 +275,7 @@ def _block_bwd_flash(q, kt, vt, dout, out, lse, scale, src, rank, causal,
 
 
 def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, block_q, block_k,
-              res, dout):
+              flash_layout, res, dout):
     q, k, v, out, lse = res
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -293,7 +296,7 @@ def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, block_q, block_k,
         if use_flash:
             dq_blk, dk_blk, dv_blk = _block_bwd_flash(
                 q, kt, vt, dout, out, lse, scale, src, rank, causal, zigzag,
-                block_q, block_k)
+                block_q, block_k, flash_layout)
         else:
             dq_blk, dk_blk, dv_blk = _block_bwd_einsum(
                 q, kt, vt, dout, out, lse, D, scale, src, rank, causal, n,
@@ -335,7 +338,8 @@ ring_attention.defvjp(_ring_fwd, _ring_bwd)
 def ulysses_attention(q, k, v, scale: float, axis: str, axis_size: int,
                       causal: bool, use_flash: bool = False,
                       block_q: int | None = None,
-                      block_k: int | None = None):
+                      block_k: int | None = None,
+                      flash_layout: str = "folded"):
     """q, k, v: [B, S_local, H, D], sequence CONTIGUOUSLY sharded over
     ``axis`` (no zigzag — Ulysses is load-balanced by construction) and
     H % axis_size == 0 (kv heads already GQA-repeated). Returns
@@ -358,7 +362,8 @@ def ulysses_attention(q, k, v, scale: float, axis: str, axis_size: int,
         from picotron_tpu.ops.pallas.flash_attention import flash_attention
 
         o = flash_attention(qf, kf, vf, scale, causal=causal,
-                            block_q=block_q, block_k=block_k)
+                            block_q=block_q, block_k=block_k,
+                            layout=flash_layout)
     else:
         from picotron_tpu.ops.attention import sdpa
 

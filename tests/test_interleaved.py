@@ -71,6 +71,23 @@ def test_interleaved_hf_roundtrip(tiny_model_kwargs, tmp_path):
             np.testing.assert_array_equal(got[pos], want[g], err_msg=f"{name}[{g}]")
 
 
+def test_forward_logits_rejects_interleaved_layout(tiny_model_kwargs):
+    """The eval path scans stacked rows in order, so it must refuse the
+    chunk-permuted interleaved layout instead of silently running layers out
+    of order."""
+    import jax
+    import pytest
+
+    from picotron_tpu.models import llama
+
+    cfg = make_config(tiny_model_kwargs, pp=2, acc=2, engine="1f1b",
+                      interleave=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg.model, pp_size=2,
+                               interleave=2)
+    with pytest.raises(ValueError, match="interleaved"):
+        llama.forward_logits(params, np.zeros((1, 32), np.int32), cfg)
+
+
 def test_interleaved_checkpoint_cross_layout(tiny_model_kwargs, tmp_path):
     """A checkpoint saved from an interleaved pp=2/v=2 run restores into the
     single-device (contiguous) layout and continues the exact trajectory —

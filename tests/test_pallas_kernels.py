@@ -27,26 +27,29 @@ def _qkv(b=2, s=256, h=2, d=64, seed=0):
     return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
 
 
+@pytest.mark.parametrize("layout", ["folded", "bshd"])
 @pytest.mark.parametrize("causal", [True, False])
-def test_flash_forward_matches_sdpa(causal):
+def test_flash_forward_matches_sdpa(causal, layout):
     q, k, v = _qkv()
     scale = 0.125
     with pltpu.force_tpu_interpret_mode():
         got = flash_attention(q, k, v, scale, causal=causal, block_q=128,
-                              block_k=128)
+                              block_k=128, layout=layout)
     want = sdpa(q, k, v, scale, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_lse_matches_block_attention():
+@pytest.mark.parametrize("layout", ["folded", "bshd"])
+def test_flash_lse_matches_block_attention(layout):
     from picotron_tpu.ops.attention import _causal_mask, block_attention
 
     q, k, v = _qkv(s=128)
     scale = 0.125
     with pltpu.force_tpu_interpret_mode():
         out, lse = flash_attention_with_lse(q, k, v, scale, causal=True,
-                                            block_q=128, block_k=128)
+                                            block_q=128, block_k=128,
+                                            layout=layout)
     mask = _causal_mask(q.shape[1], k.shape[1], 0)
     want_out, want_lse = block_attention(q, k, v, scale, mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want_out),
@@ -55,13 +58,14 @@ def test_flash_lse_matches_block_attention():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_grads_match_sdpa():
+@pytest.mark.parametrize("layout", ["folded", "bshd"])
+def test_flash_grads_match_sdpa(layout):
     q, k, v = _qkv(s=128)
     scale = 0.125
 
     def loss_flash(q, k, v):
         out = flash_attention(q, k, v, scale, causal=True, block_q=64,
-                              block_k=64)
+                              block_k=64, layout=layout)
         return jnp.sum(out * jnp.cos(out))
 
     def loss_ref(q, k, v):
@@ -107,16 +111,18 @@ def test_rmsnorm_grads_match_reference():
 
 
 def test_flash_blocks_configurable_through_model(tiny_model_kwargs):
-    """model.flash_block_q/k reach the kernel through _attention: a custom
-    (non-default) tiling must not change the math."""
+    """model.flash_block_q/k and flash_layout reach the kernel through
+    _attention: a custom tiling or the bshd layout must not change the
+    math."""
     from picotron_tpu.config import Config
     from picotron_tpu.models.llama import _attention
 
-    def cfg_with(bq, bk):
+    def cfg_with(bq, bk, layout="folded"):
         return Config.from_dict({
             "distributed": {"use_cpu": True},
             "model": dict(tiny_model_kwargs, attention_impl="flash",
-                          flash_block_q=bq, flash_block_k=bk),
+                          flash_block_q=bq, flash_block_k=bk,
+                          flash_layout=layout),
             "training": {"seq_length": 128},
             "dataset": {"name": "synthetic"},
         })
@@ -125,5 +131,8 @@ def test_flash_blocks_configurable_through_model(tiny_model_kwargs):
     with pltpu.force_tpu_interpret_mode():
         got = _attention(q, k, v, cfg_with(32, 128))
         ref = _attention(q, k, v, cfg_with(None, None))
+        bshd = _attention(q, k, v, cfg_with(None, None, layout="bshd"))
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(bshd), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)

@@ -30,19 +30,22 @@ def _qkv(dtype, seed=0):
                  for k in ks)
 
 
-def test_flash_forward_matches_sdpa_on_tpu():
+@pytest.mark.parametrize("layout", ["folded", "bshd"])
+def test_flash_forward_matches_sdpa_on_tpu(layout):
     from picotron_tpu.ops.attention import sdpa
     from picotron_tpu.ops.pallas.flash_attention import flash_attention
 
     q, k, v = _qkv(jnp.bfloat16)
-    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, SCALE))(q, k, v)
+    out = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, SCALE, layout=layout))(q, k, v)
     ref = jax.jit(lambda q, k, v: sdpa(q, k, v, SCALE, causal=True))(q, k, v)
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         rtol=2e-2, atol=2e-2)
 
 
-def test_flash_grads_match_sdpa_on_tpu():
+@pytest.mark.parametrize("layout", ["folded", "bshd"])
+def test_flash_grads_match_sdpa_on_tpu(layout):
     from picotron_tpu.ops.attention import sdpa
     from picotron_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -55,7 +58,8 @@ def test_flash_grads_match_sdpa_on_tpu():
         return f
 
     g_flash = jax.jit(jax.grad(loss(
-        lambda q, k, v: flash_attention(q, k, v, SCALE)), argnums=(0, 1, 2)))(q, k, v)
+        lambda q, k, v: flash_attention(q, k, v, SCALE, layout=layout)),
+        argnums=(0, 1, 2)))(q, k, v)
     g_ref = jax.jit(jax.grad(loss(
         lambda q, k, v: sdpa(q, k, v, SCALE, causal=True)), argnums=(0, 1, 2)))(q, k, v)
     for a, b, name in zip(g_flash, g_ref, "qkv"):
