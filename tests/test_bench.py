@@ -75,3 +75,48 @@ def test_all_sizes_fail_exits(monkeypatch):
     _patched(monkeypatch, {"big": ["out of memory"], "small": ["out of memory"]})
     with pytest.raises(SystemExit, match="failed at all sizes"):
         run_descending(("big", "small"), lambda s: s, tag="t")
+
+
+def _tiny_cfg():
+    from picotron_tpu.config import Config
+
+    return Config.from_dict({
+        "distributed": {"use_cpu": True},
+        "model": dict(num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, hidden_size=64,
+                      intermediate_size=128, vocab_size=256,
+                      max_position_embeddings=64, dtype="float32"),
+        "training": {"seq_length": 32, "micro_batch_size": 1},
+        "dataset": {"name": "synthetic"},
+    })
+
+
+def test_flash_layout_ab_adopts_faster(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(
+        bench, "run",
+        lambda c, **kw: 200.0 if c.model.flash_layout == "bshd" else 100.0)
+    cfg, tok_s = bench.try_flash_layout_ab(_tiny_cfg(), 100.0)
+    assert tok_s == 200.0 and cfg.model.flash_layout == "bshd"
+
+
+def test_flash_layout_ab_failure_keeps_folded(monkeypatch):
+    import bench
+
+    def boom(c, **kw):
+        raise RuntimeError("Mosaic failed to legalize")
+
+    monkeypatch.setattr(bench, "run", boom)
+    base = _tiny_cfg()
+    cfg, tok_s = bench.try_flash_layout_ab(base, 100.0)
+    assert tok_s == 100.0 and cfg is base
+
+
+def test_flash_layout_ab_slower_keeps_folded(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "run", lambda c, **kw: 80.0)
+    base = _tiny_cfg()
+    cfg, tok_s = bench.try_flash_layout_ab(base, 100.0)
+    assert tok_s == 100.0 and cfg is base
