@@ -4,7 +4,10 @@ jax.distributed.initialize, global mesh over all 8 devices, shard_batch's
 multi-process placement, the jitted 4D train step — and writes its loss
 trajectory (and which processes printed) to a JSON file.
 
-Usage: python multihost_worker.py <process_id> <port> <out_json>
+Usage: python multihost_worker.py <process_id> <port> <out_json> [features]
+``features`` is a comma-separated flag list; "zero1" turns on dp-sharded
+optimizer state, whose reduce-scatter/all-gather then cross the process
+boundary (dp is the outermost axis).
 """
 
 import json
@@ -14,6 +17,7 @@ import sys
 
 def main():
     pid, port, out = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    feats = sys.argv[4].split(",") if len(sys.argv) > 4 else []
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -35,7 +39,7 @@ def main():
         # on process 1 — the grad pmean crosses the process boundary, like dp
         # over DCN on a real pod
         "distributed": {"dp_size": 2, "cp_size": 2, "tp_size": 2,
-                        "use_cpu": True},
+                        "use_cpu": True, "zero1": "zero1" in feats},
         "model": dict(num_hidden_layers=4, num_attention_heads=8,
                       num_key_value_heads=4, hidden_size=64,
                       intermediate_size=128, vocab_size=256,

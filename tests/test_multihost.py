@@ -27,7 +27,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_matches_single_process(tmp_path, cfg_factory):
+def _launch_pod(tmp_path, features: str = ""):
+    """Run the 2-process worker pod; returns both processes' JSON results."""
     port = _free_port()
     repo_root = os.path.dirname(os.path.dirname(WORKER))
     env = {k: v for k, v in os.environ.items()
@@ -36,7 +37,8 @@ def test_two_process_matches_single_process(tmp_path, cfg_factory):
     outs = [str(tmp_path / f"p{i}.json") for i in range(2)]
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(i), str(port), outs[i]],
+            [sys.executable, WORKER, str(i), str(port), outs[i]]
+            + ([features] if features else []),
             env=env, cwd=repo_root,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)
@@ -50,8 +52,16 @@ def test_two_process_matches_single_process(tmp_path, cfg_factory):
                 p.wait()
     for i, p in enumerate(procs):
         assert p.returncode == 0, f"worker {i} failed:\n{logs[i][-3000:]}"
+    return [json.load(open(o)) for o in outs]
 
-    results = [json.load(open(o)) for o in outs]
+
+@pytest.mark.parametrize("zero1", [False, True], ids=["plain", "zero1"])
+def test_two_process_matches_single_process(tmp_path, cfg_factory, zero1):
+    """With ZeRO-1, dp being the outermost mesh axis means each dp replica
+    (and each optimizer-state chunk) lives on its own process — the grad
+    reduce-scatter and param all-gather cross hosts — and the trajectory
+    must still equal the single-process run."""
+    results = _launch_pod(tmp_path, features="zero1" if zero1 else "")
     # both processes observe the same (replicated) loss
     np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
                                rtol=1e-6, atol=1e-6)
@@ -61,7 +71,7 @@ def test_two_process_matches_single_process(tmp_path, cfg_factory):
     # and the 2-process trajectory equals the single-process one
     from test_parallel import run_losses
 
-    cfg = cfg_factory(dp=2, cp=2, tp=2, seq=32, mbs=4)
+    cfg = cfg_factory(dp=2, cp=2, tp=2, seq=32, mbs=4, zero1=zero1)
     cfg.model.vocab_size = 256
     ref = run_losses(cfg, steps=4)
     np.testing.assert_allclose(results[0]["losses"], ref, rtol=3e-5, atol=3e-5)
