@@ -19,7 +19,7 @@ out of single-controller JAX:
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Iterator
 
 import numpy as np
 

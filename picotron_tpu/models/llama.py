@@ -29,7 +29,6 @@ scanned, which is also the axis pipeline parallelism shards.
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Any
 
 import jax
