@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -70,7 +71,9 @@ def run(cfg, calls=4, warmup=1, steps_per_call=16):
 
 def _cpu_pinned() -> bool:
     """The caller pinned the CPU platform via JAX_PLATFORMS."""
-    return os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu"
+    from picotron_tpu.utils import cpu_pinned
+
+    return cpu_pinned()
 
 
 def kernel_parity_preflight() -> str:
@@ -195,12 +198,126 @@ def _honor_cpu_env() -> None:
     """JAX_PLATFORMS=cpu must win over the axon site's platform pin BEFORE
     any backend initializes — a dead TPU tunnel blocks the axon client
     constructor forever, so a CPU smoke run must never touch it."""
-    if _cpu_pinned():
-        jax.config.update("jax_platforms", "cpu")
+    from picotron_tpu.utils import honor_cpu_env_pin
+
+    honor_cpu_env_pin()
+
+
+def probe_tunnel(timeout: float = 120.0) -> str:
+    """'tpu' | 'cpu' | 'dead': what a child process finds when it
+    initializes the default JAX backend within `timeout`. On this site the
+    chip sits behind a tunnel whose client blocks FOREVER inside backend
+    init when the tunnel is dead (round-3 postmortem: that hang erased the
+    round's number), so liveness must be established by a killable child,
+    never the calling process. 'cpu' means the backend works but there is no
+    accelerator at all (plain CPU box) — retrying would never help."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices()[0]; "
+             "print(d.platform, d.device_kind)"],
+            capture_output=True, text=True, timeout=timeout)
+        if r.returncode != 0:
+            return "dead"
+        # stdout only: stderr may carry "Unable to initialize backend 'tpu'"
+        # fallback warnings on a CPU-only box with the plugin installed
+        return "tpu" if "tpu" in r.stdout.lower() else "cpu"
+    except subprocess.TimeoutExpired:
+        return "dead"
+
+
+def orchestrate(script: str, metric: str, unit: str,
+                max_total: float = 5400.0) -> None:
+    """Outer harness that makes a bench survive TPU-tunnel flaps.
+
+    Runs `script --inner` (the real bench) as a child with a hard timeout,
+    after a cheap tunnel-liveness probe; retries both with backoff inside a
+    wall-clock budget. On final failure it still prints the one-line JSON
+    artifact with value=null plus the diagnosis — the round artifact is
+    never empty and never blocks the driver (round-3 VERDICT item 1).
+
+    Budget sizing: a healthy worst-case inner run is the 1200 s preflight
+    cap + a multi-config compile sweep + the flash-layout A/B (~30-45 min
+    total), so the 90 min default leaves attempt 1 room to FINISH — a
+    budget that can kill a healthy run just converts a good number into a
+    null artifact. A dead tunnel never gets near it: each probe fails in
+    <= 120 s and the backoffs cap at 300 s."""
+    start = time.time()
+    diagnosis: list[str] = []
+    attempt = 0
+    probe_ok_ever = False
+    while True:
+        attempt += 1
+        remaining = max_total - (time.time() - start)
+        if remaining < 240:
+            diagnosis.append("wall-clock budget exhausted")
+            break
+        backend = probe_tunnel(timeout=min(120.0, remaining))
+        if backend == "dead":
+            diagnosis.append(f"attempt {attempt}: tunnel probe hung/failed")
+            if not probe_ok_ever and attempt >= 6:
+                # ~25+ min of consecutive probe failures: the tunnel is down
+                # for the count, not flapping — publish the diagnosis now
+                # instead of sleeping out the rest of the budget
+                diagnosis.append("tunnel dead across all probes; giving up")
+                break
+            remaining = max_total - (time.time() - start)
+            if remaining < 240:
+                diagnosis.append("wall-clock budget exhausted")
+                break
+            print(f"# {diagnosis[-1]}; backing off", file=sys.stderr)
+            # clamped so the null artifact is printed BEFORE a driver
+            # enforcing max_total as a hard deadline would kill us
+            time.sleep(min(120.0 * attempt, 300.0, remaining - 200))
+            continue
+        probe_ok_ever = True
+        # 'tpu': run the real bench. 'cpu' (a plain CPU box, no pin, no
+        # accelerator): run the same inner child — it detects the CPU
+        # backend and prints the fast smoke record; retrying can't help, so
+        # a failure there is final.
+        remaining = max_total - (time.time() - start)
+        if remaining < 180:
+            diagnosis.append("wall-clock budget exhausted after probe")
+            break
+        try:
+            r = subprocess.run(
+                [sys.executable, script, "--inner"],
+                capture_output=True, text=True, timeout=remaining - 30)
+        except subprocess.TimeoutExpired as e:
+            out = (e.stderr or "") if isinstance(e.stderr, str) else ""
+            diagnosis.append(
+                f"attempt {attempt}: inner bench timed out after "
+                f"{remaining - 30:.0f}s; stderr tail: {out[-300:]!r}")
+            print(f"# {diagnosis[-1]}", file=sys.stderr)
+            continue
+        sys.stderr.write(r.stderr)  # A/B + config notes: keep in the record
+        line = next((l for l in r.stdout.splitlines()
+                     if l.startswith("{")), None)
+        if r.returncode == 0 and line is not None:
+            print(line)
+            return
+        diagnosis.append(
+            f"attempt {attempt}: inner bench rc={r.returncode}; "
+            f"tail: {(r.stdout + r.stderr)[-300:]!r}")
+        if backend == "cpu":
+            break  # no accelerator to wait for; the failure is final
+        print(f"# {diagnosis[-1]}; backing off", file=sys.stderr)
+        time.sleep(max(0.0, min(60.0, max_total - (time.time() - start) - 200)))
+    print(json.dumps({"metric": metric, "value": None, "unit": unit,
+                      "vs_baseline": None,
+                      "error": " | ".join(diagnosis)[-1500:]}))
 
 
 def main():
     _honor_cpu_env()
+    if not _cpu_pinned() and "--inner" not in sys.argv:
+        orchestrate(os.path.abspath(__file__),
+                    metric="smollm_1.7b_mfu_1chip", unit="%")
+        return
+    inner_main()
+
+
+def inner_main():
     parity = kernel_parity_preflight()  # before the parent holds the chip
     from picotron_tpu.utils import on_tpu as _on_tpu
     on_tpu = _on_tpu()

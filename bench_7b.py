@@ -50,9 +50,21 @@ def proxy_cfg(layers: int, mbs: int, seq: int, on_tpu: bool):
 
 
 def main():
-    from bench import _honor_cpu_env, kernel_parity_preflight, run_descending
+    import os
+
+    from bench import _cpu_pinned, _honor_cpu_env, orchestrate
 
     _honor_cpu_env()
+    if not _cpu_pinned() and "--inner" not in sys.argv:
+        orchestrate(os.path.abspath(__file__),
+                    metric="llama2_7b_proxy_mfu_1chip", unit="%")
+        return
+    inner_main()
+
+
+def inner_main():
+    from bench import kernel_parity_preflight, run_descending
+
     parity = kernel_parity_preflight()  # before the parent holds the chip
     from picotron_tpu.models import llama
     from picotron_tpu.utils import get_mfu, on_tpu, peak_flops_per_chip

@@ -45,6 +45,23 @@ def on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def cpu_pinned() -> bool:
+    """The caller pinned the CPU platform via JAX_PLATFORMS."""
+    import os
+
+    return os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu"
+
+
+def honor_cpu_env_pin() -> None:
+    """Make JAX_PLATFORMS=cpu win over a site-pinned accelerator platform
+    BEFORE any backend initializes. On this site the TPU sits behind a
+    tunnel whose client blocks forever inside backend init when the tunnel
+    is dead — CPU-only work must never touch it. Call before the first
+    jax.devices()/computation; no-op without the env pin."""
+    if cpu_pinned():
+        jax.config.update("jax_platforms", "cpu")
+
+
 def peak_flops_per_chip(device=None) -> float | None:
     device = device or jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()

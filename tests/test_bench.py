@@ -120,3 +120,120 @@ def test_flash_layout_ab_slower_keeps_folded(monkeypatch):
     base = _tiny_cfg()
     cfg, tok_s = bench.try_flash_layout_ab(base, 100.0)
     assert tok_s == 100.0 and cfg is base
+
+
+def _fake_clock(monkeypatch):
+    """Patch bench's time.time/time.sleep with a virtual clock so the
+    orchestrator's backoffs run instantly in tests."""
+    import bench
+
+    t = [0.0]
+    monkeypatch.setattr(bench.time, "time", lambda: t[0])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: t.__setitem__(0, t[0] + s))
+    return t
+
+
+def test_orchestrate_dead_tunnel_prints_null_artifact(monkeypatch, capsys):
+    """Round-3 failure mode: tunnel dead the whole window. The artifact must
+    still be a parseable JSON line (value=null + diagnosis), exit 0."""
+    import json
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+
+    def dead_probe(timeout):
+        t[0] += timeout
+        return "dead"
+
+    monkeypatch.setattr(bench, "probe_tunnel", dead_probe)
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=900)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "m" and rec["value"] is None
+    assert rec["vs_baseline"] is None and "probe" in rec["error"]
+
+
+def test_orchestrate_passes_through_inner_success(monkeypatch, capsys):
+    import json
+    import subprocess as sp
+
+    import bench
+
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **kw: sp.CompletedProcess(
+            a, 0, stdout='{"metric": "m", "value": 55.0}\n',
+            stderr="# flash_layout=bshd wins\n"))
+    bench.orchestrate("/x/bench.py", metric="m", unit="%")
+    out = capsys.readouterr()
+    assert json.loads(out.out.strip()) == {"metric": "m", "value": 55.0}
+    assert "bshd wins" in out.err  # A/B record survives into driver stderr
+
+
+def test_orchestrate_retries_inner_failure_then_succeeds(monkeypatch, capsys):
+    import json
+    import subprocess as sp
+
+    import bench
+
+    _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
+    outcomes = [
+        sp.CompletedProcess((), 1, stdout="", stderr="transient flap\n"),
+        sp.CompletedProcess((), 0, stdout='{"metric": "m", "value": 42.0}\n',
+                            stderr=""),
+    ]
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **kw: outcomes.pop(0))
+    bench.orchestrate("/x/bench.py", metric="m", unit="%")
+    assert json.loads(
+        capsys.readouterr().out.strip()) == {"metric": "m", "value": 42.0}
+    assert not outcomes
+
+
+def test_orchestrate_cpu_box_runs_inner_once(monkeypatch, capsys):
+    """A plain CPU machine (probe finds a working CPU backend, no
+    accelerator) must get the fast smoke path — one inner run, no retry
+    loop — instead of burning the backoff budget (round-4 review)."""
+    import json
+    import subprocess as sp
+
+    import bench
+
+    _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "cpu")
+    calls = []
+
+    def fake_run(*a, **kw):
+        calls.append(a)
+        return sp.CompletedProcess(
+            a, 0, stdout='{"metric": "tokens_per_sec_cpu_smoke", "value": 9.0}\n',
+            stderr="")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    bench.orchestrate("/x/bench.py", metric="m", unit="%")
+    assert len(calls) == 1
+    assert json.loads(capsys.readouterr().out.strip())["value"] == 9.0
+
+
+def test_orchestrate_cpu_box_failure_is_final(monkeypatch, capsys):
+    import json
+    import subprocess as sp
+
+    import bench
+
+    _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "cpu")
+    n = [0]
+
+    def fake_run(*a, **kw):
+        n[0] += 1
+        return sp.CompletedProcess(a, 1, stdout="", stderr="boom")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    bench.orchestrate("/x/bench.py", metric="m", unit="%")
+    assert n[0] == 1  # no pointless retries without an accelerator
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None and "rc=1" in rec["error"]
