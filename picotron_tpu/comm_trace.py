@@ -1,0 +1,53 @@
+"""Collective/p2p trace channel — the analogue of the reference's VERBOSE=1
+send/recv tracing (reference pipeline_parallel/pp_communications.py:28 and
+context_parallel/cp_communications.py:33-35 print every op with rank, peer
+and shape).
+
+Under XLA the runtime comm schedule IS the traced program: everything inside
+jit executes exactly as traced, so logging each collective once at trace
+time (op, mesh axis, shape, dtype) reproduces the information content of the
+reference's per-call prints without a host callback in the hot path.
+
+- ``PICOTRON_VERBOSE=1``: one stderr line per collective per trace.
+- ``PICOTRON_VERBOSE=2``: additionally injects ``jax.debug.print`` so every
+  *execution* logs the op tag (slow — debugging only; runs per device under
+  shard_map, the closest analogue of the reference's per-rank prints).
+
+The env var is read at call time, so tests (and running jobs restarted with
+the flag) do not need an import-order dance.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _level() -> int:
+    try:
+        return int(os.environ.get("PICOTRON_VERBOSE", "0") or "0")
+    except ValueError:
+        return 0
+
+
+def log(op: str, axis, x, extra: str = ""):
+    """Record one collective at trace time; identity on ``x``.
+
+    ``axis`` is the mesh axis name (or tuple) the collective runs over —
+    the device-group analogue of the reference's src/dest rank pair.
+    """
+    lvl = _level()
+    if lvl <= 0:
+        return x
+    shape = tuple(getattr(x, "shape", ()) or ())
+    dtype = getattr(x, "dtype", "?")
+    msg = f"[comm] {op} axis={axis} shape={shape} dtype={dtype}"
+    if extra:
+        msg += f" {extra}"
+    print(msg, file=sys.stderr)
+    if lvl >= 2:
+        import jax
+
+        jax.debug.print("[comm-exec] " + op + " axis=" + str(axis)
+                        + " shape=" + str(shape))
+    return x

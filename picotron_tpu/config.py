@@ -167,6 +167,12 @@ class CheckpointConfig:
     # HF-format safetensors file/dir to initialize weights from before training
     # (the reference's bootstrap path, checkpoint.py:50-102)
     hf_bootstrap_path: str = ""
+    # Reference semantics: the reference loads the HF file, then deliberately
+    # re-randomizes — the files act as shape/name templates for pre-training
+    # (reference checkpoint.py:99-100). True = validate the file against the
+    # model (names, shapes) but keep the seed-derived random init; False
+    # (our default) = actually load the weights.
+    hf_bootstrap_reinit: bool = False
 
 
 @dataclass
@@ -270,7 +276,11 @@ class Config:
             raise ValueError(f"unknown pp_engine {d.pp_engine!r} (afab|1f1b)")
         if d.pp_interleave < 1:
             raise ValueError("pp_interleave must be >= 1")
-        if d.pp_interleave > 1 and d.pp_size > 1:
+        if d.pp_interleave > 1:
+            if d.pp_size == 1:
+                # Without this, the interleaved layout path still runs in
+                # init_params and dies in pp_layer_layout with a bare assert.
+                raise ValueError("pp_interleave > 1 requires pp_size > 1")
             if d.pp_engine != "1f1b":
                 raise ValueError("pp_interleave > 1 requires pp_engine='1f1b'")
             if m.num_hidden_layers % (d.pp_size * d.pp_interleave) != 0:
@@ -301,6 +311,18 @@ class Config:
             raise ValueError("lr_min_ratio must be in [0, 1]")
         if t.lr_decay_steps is not None and t.lr_decay_steps <= 0:
             raise ValueError("lr_decay_steps must be > 0 when set")
+        if t.lr_schedule in ("cosine", "linear"):
+            # the decay horizon defaults to total_train_steps
+            # (train_step.lr_schedule); either way a horizon <= warmup would
+            # silently clamp into a near-instant decay
+            horizon = (t.lr_decay_steps if t.lr_decay_steps is not None
+                       else t.total_train_steps)
+            if horizon <= t.lr_warmup_steps:
+                which = ("lr_decay_steps" if t.lr_decay_steps is not None
+                         else "total_train_steps")
+                raise ValueError(
+                    f"{which} ({horizon}) must exceed lr_warmup_steps "
+                    f"({t.lr_warmup_steps}) for a decaying schedule")
         if t.remat not in ("none", "full", "save_attn"):
             raise ValueError(f"unknown remat {t.remat!r} (none|full|save_attn)")
         if t.grad_accum_dtype not in ("float32", "param"):

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from picotron_tpu.ops.attention import NEG_INF, block_attention
+from picotron_tpu.comm_trace import log as _trace
 from picotron_tpu.utils import collective_scan_unroll
 
 
@@ -190,6 +191,7 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag,
         w = jax.nn.sigmoid(blk_lse - lse)[..., None]
         out = out - w * (out - blk_out)
         lse = jnp.logaddexp(lse, blk_lse)
+        _trace("ring.fwd send_recv kv", axis, kv[0], extra=f"ring_steps={n}")
         kv = lax.ppermute(kv, axis, perm)
         return (kv, out, lse), None
 
@@ -307,6 +309,7 @@ def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, block_q, block_k,
         # after n rotations (reference's d_kv_comm channel,
         # context_parallel.py:104-106)
         dkv = (dk_acc + dk_blk, dv_acc + dv_blk)
+        _trace("ring.bwd send_recv kv+dkv", axis, kv[0], extra=f"ring_steps={n}")
         kv, dkv = lax.ppermute((kv, dkv), axis, perm)
         return (kv, dkv, dq), None
 
@@ -347,10 +350,12 @@ def ulysses_attention(q, k, v, scale: float, axis: str, axis_size: int,
     n = axis_size
 
     def seq_to_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+        _trace("ulysses all_to_all seq->heads", axis, x)
         return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
                               tiled=True)
 
     def heads_to_seq(x):  # [B, S, H/n, D] -> [B, S/n, H, D]
+        _trace("ulysses all_to_all heads->seq", axis, x)
         return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
                               tiled=True)
 

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from picotron_tpu.comm_trace import log as _trace
 from picotron_tpu.utils import collective_scan_unroll
 
 
@@ -106,6 +107,7 @@ def pipeline_afab_loss(stage_fn, params, tokens, targets, pp_size, h_shape, h_dt
         h_out, loss_mb = stage_fn(params, h_recv, _take_mb(tokens, mb), _take_mb(targets, mb))
         valid = (t - s >= 0) & (t - s < M)
         contrib = jnp.where(valid, loss_mb, 0.0)  # loss_mb is already last-stage-only
+        _trace("pp.afab send_recv act down", "pp", h_out)
         h_next = lax.ppermute(h_out, "pp", perm) if perm else jnp.zeros_like(h_out)
         return h_next, contrib
 
@@ -244,6 +246,7 @@ def pipeline_1f1b_interleaved(stage_fwd, stage_bwd, params, tokens, targets,
                 buf, jnp.where(fvalid, val, _take_mb(buf, kk % BUF)),
                 kk % BUF, 0),
             sbuf, saved)
+        _trace("pp.1f1b-ilv send_recv act down", "pp", h_out)
         h_next = lax.ppermute(h_out, "pp", down)
         return (h_next, dh_recv, sbuf, gacc, loss_acc)
 
@@ -279,6 +282,7 @@ def pipeline_1f1b_interleaved(stage_fwd, stage_bwd, params, tokens, targets,
                                    gacc[k2], dparams[k2]))
             for k2 in gacc
         }
+        _trace("pp.1f1b-ilv send_recv grad up", "pp", dh_prev)
         dh_next = lax.ppermute(dh_prev, "pp", up)
         return (h_recv, dh_next, sbuf, gacc, loss_acc)
 
@@ -351,6 +355,7 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
                 buf, jnp.where(fvalid, v, _take_mb(buf, mbf % BUF)),
                 mbf % BUF, 0),
             sbuf, saved)
+        _trace("pp.1f1b send_recv act down", "pp", h_out)
         h_next = lax.ppermute(h_out, "pp", down) if down else jnp.zeros_like(h_out)
         return (h_next, dh_recv, sbuf, gacc, loss_acc)
 
@@ -367,6 +372,7 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
         gacc = jax.tree.map(
             lambda a, g: a + jnp.where(bvalid, g, 0).astype(jnp.float32), gacc, dparams
         )
+        _trace("pp.1f1b send_recv grad up", "pp", dh_prev)
         dh_next = lax.ppermute(dh_prev, "pp", up) if up else jnp.zeros_like(dh_prev)
         return (h_recv, dh_next, sbuf, gacc, loss_acc)
 

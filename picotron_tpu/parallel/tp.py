@@ -31,6 +31,8 @@ from functools import partial
 import jax
 from jax import lax
 
+from picotron_tpu.comm_trace import log as _trace
+
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def tp_copy(x, axis: str = "tp"):
@@ -43,6 +45,7 @@ def _tp_copy_fwd(x, axis):
 
 
 def _tp_copy_bwd(axis, _, g):
+    _trace("tp_copy.bwd all_reduce", axis, g)
     return (lax.psum(g, axis),)
 
 
@@ -52,10 +55,12 @@ tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def tp_reduce(x, axis: str = "tp"):
     """psum forward / identity backward (Megatron g, tp_communications.py:35-49)."""
+    _trace("tp_reduce.fwd all_reduce", axis, x)
     return lax.psum(x, axis)
 
 
 def _tp_reduce_fwd(x, axis):
+    _trace("tp_reduce.fwd all_reduce", axis, x)
     return lax.psum(x, axis), None
 
 
@@ -92,6 +97,7 @@ def reduce_scatter_dim(x, axis: str, dim: int):
     """Tiled reduce-scatter along array dimension ``dim`` over mesh axis
     ``axis``. Public building block shared by the SP collectives and the
     ZeRO-1 gradient reduce-scatter (train_step)."""
+    _trace("reduce_scatter", axis, x, extra=f"dim={dim}")
     return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
 
 
@@ -136,10 +142,12 @@ sp_scatter.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
 def tp_gather(x, axis: str = "tp"):
     """All-gather on the last dim forward / take-own-slice backward
     (GatherFromModelParallelRegion, tp_communications.py:51-72)."""
+    _trace("tp_gather.fwd all_gather", axis, x)
     return lax.all_gather(x, axis, axis=-1, tiled=True)
 
 
 def _tp_gather_fwd(x, axis):
+    _trace("tp_gather.fwd all_gather", axis, x)
     return lax.all_gather(x, axis, axis=-1, tiled=True), x.shape[-1]
 
 

@@ -89,9 +89,20 @@ def train(cfg, max_steps_override: Optional[int] = None):
     loader = MicroBatchDataLoader(cfg)
     params, opt_state = ts.init_state(cfg, topo)
     if c.hf_bootstrap_path:
-        params = ckpt_mod.load_hf_safetensors(
-            c.hf_bootstrap_path, m, topo,
-            interleave=cfg.distributed.pp_interleave)
+        # header-only names+shapes check — zero tensor bytes read; guards
+        # BOTH modes against a template that disagrees with the model config
+        ckpt_mod.validate_hf_template(c.hf_bootstrap_path, m)
+        if c.hf_bootstrap_reinit:
+            # reference semantics (checkpoint.py:99-100): the HF file is a
+            # shape template only; training starts from the seed-derived
+            # random init above
+            utils.log0(f"hf_bootstrap_reinit: validated "
+                       f"{c.hf_bootstrap_path} as a shape template; keeping "
+                       f"random init (reference re-randomize semantics)")
+        else:
+            params = ckpt_mod.load_hf_safetensors(
+                c.hf_bootstrap_path, m, topo,
+                interleave=cfg.distributed.pp_interleave)
     spc = t.steps_per_call
     step_fn = ts.build_train_step(cfg, topo, multi_step=spc)
     step_fn_single = step_fn if spc == 1 else None  # lazily built for the tail

@@ -330,31 +330,43 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
         # (data_parallel.py:161-165). With ZeRO-1 the dp share of the mean
         # arrives by reduce-scatter and the update touches only this rank's
         # 1/dp chunk of each (already pp/tp-sharded) param block.
+        from picotron_tpu.comm_trace import log as _trace
+
         if zero1:
             dp = cfg.distributed.dp_size
+            _trace("grad all_reduce(mean) + reduce_scatter (zero1)",
+                   ("cp", "dp"), jax.tree.leaves(grads)[0],
+                   extra=f"leaves={len(jax.tree.leaves(grads))}")
             grads = jax.tree.map(lambda g: lax.pmean(g, "cp"), grads)
             grads = sync_pp_replicated_grads(grads, pspecs)
             if sp_div > 1:
                 grads = sync_sp_norm_grads(grads)
             g_chunks = jax.tree.map(partial(_zero1_scatter, dp=dp), grads)
-            g_chunks = jax.tree.map(lambda g, p: g.astype(p.dtype),
-                                    g_chunks, params)
             if cfg.training.grad_clip > 0:
+                # clip BEFORE the param-dtype downcast: the reference clips
+                # fp32 main_grads (data_parallel.py:161-165 casts after sync)
                 g_chunks = clip_by_global_norm_sharded(
                     g_chunks, cspecs, cfg.training.grad_clip)
+            g_chunks = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                                    g_chunks, params)
             p_chunks = jax.tree.map(partial(_zero1_slice, dp=dp), params)
             updates, opt_state = optimizer.update(g_chunks, opt_state, p_chunks)
             p_chunks = optax.apply_updates(p_chunks, updates)
             params = jax.tree.map(_zero1_unsplit, p_chunks, params)
         else:
+            _trace("grad all_reduce(mean)", ("dp", "cp"),
+                   jax.tree.leaves(grads)[0],
+                   extra=f"leaves={len(jax.tree.leaves(grads))}")
             grads = jax.tree.map(lambda g: lax.pmean(g, ("dp", "cp")), grads)
             grads = sync_pp_replicated_grads(grads, pspecs)
             if sp_div > 1:
                 grads = sync_sp_norm_grads(grads)
-            grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
             if cfg.training.grad_clip > 0:
+                # clip the fp32 grads, then downcast — matches the reference's
+                # fp32-master-grad clipping order
                 grads = clip_by_global_norm_sharded(
                     grads, pspecs, cfg.training.grad_clip)
+            grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
 
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)

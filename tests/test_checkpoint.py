@@ -21,6 +21,11 @@ from picotron_tpu.topology import topology_from_config
 
 from conftest import make_config
 
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+# Only the multi-minute resume/equivalence matrices are excluded from the
+# fast gate; the save->wait->load behavior and both HF bootstrap modes STAY
+# in `make test` so regressions in the async-checkpoint path surface there.
+
 
 def _train(cfg, topo, params, opt_state, loader, steps):
     step = ts.build_train_step(cfg, topo)
@@ -64,6 +69,7 @@ def test_save_resume_bitwise(tiny_model_kwargs, tmp_path):
     mgr.close()
 
 
+@pytest.mark.slow
 def test_resume_under_different_topology(tiny_model_kwargs, tmp_path):
     """Save under dp=8, restore under tp=2/cp=2/dp=2 — the topology-change
     resharding the reference cannot do (checkpoint.py:263)."""
@@ -98,7 +104,7 @@ def test_hf_safetensors_roundtrip(tiny_model_kwargs, tmp_path):
     cfg = make_config(tiny_model_kwargs, tp=1)
     params = llama.init_params(jax.random.PRNGKey(0), cfg.model)
     sft = str(tmp_path / "model.safetensors")
-    ckpt.save_hf_safetensors(params, sft)
+    ckpt.save_hf_safetensors(params, sft, cfg)
 
     topo = topology_from_config(cfg)
     loaded = ckpt.load_hf_safetensors(sft, cfg.model, topo)
@@ -118,7 +124,7 @@ def test_hf_import_sharded_and_tied(tiny_model_kwargs, tmp_path):
     cfg = make_config(tiny_model_kwargs)
     params = llama.init_params(jax.random.PRNGKey(1), cfg.model)
     full = {}
-    ckpt.save_hf_safetensors(params, str(tmp_path / "tmp.safetensors"))
+    ckpt.save_hf_safetensors(params, str(tmp_path / "tmp.safetensors"), cfg)
     from safetensors import safe_open
 
     with safe_open(str(tmp_path / "tmp.safetensors"), framework="np") as f:
@@ -161,6 +167,7 @@ def test_model_config_from_hf(tmp_path):
     assert "architectures" not in got
 
 
+@pytest.mark.slow
 def test_resume_across_uneven_pp_layouts(tiny_model_kwargs, tmp_path):
     """Save under an uneven pp=2 split (5 layers -> padded [6] stack), restore
     under pp=1 ([5] stack) and under uneven pp=4 ([8] stack): real layer rows
@@ -201,6 +208,7 @@ def test_resume_across_uneven_pp_layouts(tiny_model_kwargs, tmp_path):
     mgr.close()
 
 
+@pytest.mark.slow
 def test_train_entry_hf_bootstrap(tiny_model_kwargs, tmp_path):
     """checkpoint.hf_bootstrap_path through the real train() entry: exported
     weights must be what training starts from (the reference's bootstrap
@@ -210,7 +218,7 @@ def test_train_entry_hf_bootstrap(tiny_model_kwargs, tmp_path):
     cfg0 = make_config(tiny_model_kwargs, seq=32, mbs=2)
     params = llama.init_params(jax.random.PRNGKey(7), cfg0.model)
     sft = str(tmp_path / "boot.safetensors")
-    ckpt.save_hf_safetensors(params, sft)
+    ckpt.save_hf_safetensors(params, sft, cfg0)
 
     cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
     cfg.training.total_train_steps = 2
@@ -236,3 +244,49 @@ def test_train_entry_hf_bootstrap(tiny_model_kwargs, tmp_path):
     _, _, got_first_loss = train(cfg1)
     np.testing.assert_allclose(got_first_loss, float(want_first_loss),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_hf_bootstrap_reinit_keeps_random_init(tiny_model_kwargs, tmp_path):
+    """checkpoint.hf_bootstrap_reinit reproduces the reference's re-randomize
+    semantics (reference checkpoint.py:99-100): the safetensors file is
+    validated as a shape template, but training starts from the seed-derived
+    init — the first-step loss matches a no-bootstrap run, not the file."""
+    from picotron_tpu.train import train
+
+    cfg0 = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg0.model)
+    sft = str(tmp_path / "boot.safetensors")
+    ckpt.save_hf_safetensors(params, sft, cfg0)
+
+    def one_step(**ckpt_kw):
+        cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+        cfg.training.total_train_steps = 1
+        for k, v in ckpt_kw.items():
+            setattr(cfg.checkpoint, k, v)
+        return train(cfg)[2]
+
+    plain = one_step()
+    reinit = one_step(hf_bootstrap_path=sft, hf_bootstrap_reinit=True)
+    loaded = one_step(hf_bootstrap_path=sft)
+
+    np.testing.assert_allclose(reinit, plain, rtol=1e-6, atol=1e-6)
+    assert abs(loaded - plain) > 1e-6  # the file's values really differ
+
+
+def test_hf_bootstrap_rejects_shape_mismatch(tiny_model_kwargs, tmp_path):
+    """A template whose shapes disagree with the model config is an error in
+    both bootstrap modes, not a silent mis-load."""
+    from picotron_tpu.train import train
+
+    other = dict(tiny_model_kwargs, hidden_size=tiny_model_kwargs["hidden_size"] * 2)
+    cfg0 = make_config(other, seq=32, mbs=2)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg0.model)
+    sft = str(tmp_path / "boot.safetensors")
+    ckpt.save_hf_safetensors(params, sft, cfg0)
+
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg.training.total_train_steps = 1
+    cfg.checkpoint.hf_bootstrap_path = sft
+    cfg.checkpoint.hf_bootstrap_reinit = True
+    with pytest.raises(ValueError, match="does not match the model config"):
+        train(cfg)

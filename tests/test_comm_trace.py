@@ -1,0 +1,67 @@
+"""PICOTRON_VERBOSE collective tracing (the analogue of the reference's
+VERBOSE=1 send/recv prints, pp_communications.py:28, cp_communications.py:
+33-35): level 1 logs each collective once at trace time — under jit the
+traced sequence IS the runtime comm schedule."""
+
+import pytest
+
+from conftest import make_config
+
+
+def _build_step(tiny_model_kwargs, **kw):
+    import jax
+
+    from picotron_tpu import train_step as ts
+    from picotron_tpu.data import MicroBatchDataLoader
+    from picotron_tpu.topology import topology_from_config
+
+    cfg = make_config(tiny_model_kwargs, **kw)
+    topo = topology_from_config(cfg)
+    params, opt_state = ts.init_state(cfg, topo)
+    step = ts.build_train_step(cfg, topo)
+    loader = MicroBatchDataLoader(cfg)
+    tokens, targets = ts.shard_batch(next(loader), topo)
+    jax.block_until_ready(step(params, opt_state, tokens, targets)[2])
+
+
+def test_verbose_level1_traces_collectives(tiny_model_kwargs, monkeypatch,
+                                           capsys):
+    monkeypatch.setenv("PICOTRON_VERBOSE", "1")
+    _build_step(tiny_model_kwargs, tp=2, pp=2, acc=2, engine="1f1b")
+    err = capsys.readouterr().err
+    assert "[comm] tp_reduce.fwd all_reduce axis=tp" in err
+    assert "[comm] pp.1f1b send_recv act down axis=pp" in err
+    assert "[comm] pp.1f1b send_recv grad up axis=pp" in err
+    assert "[comm] grad all_reduce(mean)" in err
+    # shapes are part of the record, like the reference's prints
+    assert "shape=(" in err and "dtype=" in err
+
+
+@pytest.mark.slow
+def test_verbose_traces_ring_and_ulysses(tiny_model_kwargs, monkeypatch,
+                                         capsys):
+    monkeypatch.setenv("PICOTRON_VERBOSE", "1")
+    _build_step(tiny_model_kwargs, cp=2, seq=64)
+    err = capsys.readouterr().err
+    assert "[comm] ring.fwd send_recv kv axis=cp" in err
+    assert "[comm] ring.bwd send_recv kv+dkv axis=cp" in err
+
+    _build_step(tiny_model_kwargs, cp=2, seq=64, cp_impl="ulysses")
+    err = capsys.readouterr().err
+    assert "[comm] ulysses all_to_all seq->heads axis=cp" in err
+    assert "[comm] ulysses all_to_all heads->seq axis=cp" in err
+
+
+def test_verbose_off_is_silent(tiny_model_kwargs, monkeypatch, capsys):
+    monkeypatch.delenv("PICOTRON_VERBOSE", raising=False)
+    _build_step(tiny_model_kwargs, tp=2)
+    assert "[comm]" not in capsys.readouterr().err
+
+
+def test_bad_verbose_value_is_off(monkeypatch):
+    from picotron_tpu import comm_trace
+
+    monkeypatch.setenv("PICOTRON_VERBOSE", "yes")
+    assert comm_trace._level() == 0
+    monkeypatch.setenv("PICOTRON_VERBOSE", "2")
+    assert comm_trace._level() == 2

@@ -28,3 +28,22 @@ def test_sp_needs_divisible_local_seq(tiny_model_kwargs):
     # cp-local sequence = 12/2 = 6, not divisible by tp 4
     with pytest.raises(ValueError, match="tp_sequence_parallel"):
         make_config(tiny_model_kwargs, tp=4, cp=2, seq=12, sp=True)
+
+
+def test_interleave_requires_pp(tiny_model_kwargs):
+    """pp_interleave > 1 with pp_size == 1 must be a clean config error, not
+    a bare assert deep in init_params' layout path (round-3 ADVICE)."""
+    with pytest.raises(ValueError, match="pp_interleave > 1 requires pp_size"):
+        make_config(tiny_model_kwargs, pp=1, interleave=2)
+
+
+def test_decay_steps_must_exceed_warmup(tiny_model_kwargs):
+    with pytest.raises(ValueError, match="lr_decay_steps"):
+        make_config(tiny_model_kwargs, lr_schedule="cosine",
+                    lr_warmup_steps=100, lr_decay_steps=100)
+
+
+def test_decay_steps_ok_for_constant_schedule(tiny_model_kwargs):
+    # constant schedule never decays; a small lr_decay_steps is inert
+    make_config(tiny_model_kwargs, lr_schedule="constant",
+                lr_warmup_steps=100, lr_decay_steps=50)

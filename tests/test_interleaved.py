@@ -6,6 +6,7 @@ through checkpoints across layouts, and the unit-order/layout helpers are
 self-consistent.
 """
 
+import pytest
 import numpy as np
 
 from conftest import make_config
@@ -14,6 +15,9 @@ from picotron_tpu.checkpoint import CheckpointManager
 from picotron_tpu.data import MicroBatchDataLoader
 from picotron_tpu.models.llama import pp_layer_layout
 from picotron_tpu.topology import topology_from_config
+
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
 
 
 def test_interleaved_layout_is_permutation():
@@ -60,7 +64,7 @@ def test_interleaved_hf_roundtrip(tiny_model_kwargs, tmp_path):
     topo = topology_from_config(cfg)
     plain = llama.init_params(jax.random.PRNGKey(3), cfg.model)
     path = str(tmp_path / "m.safetensors")
-    save_hf_safetensors(plain, path)
+    save_hf_safetensors(plain, path, (4, 1))
 
     inter = load_hf_safetensors(path, cfg.model, topo, interleave=2)
     K, _, positions = pp_layer_layout(4, 2, 2)
