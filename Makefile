@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-isolated bench clean
+.PHONY: native test test-all test-isolated bench clean
 
 native: $(NATIVE_SO)
 
@@ -10,7 +10,12 @@ $(NATIVE_SO): $(NATIVE_SRC)
 	mkdir -p $(dir $@)
 	g++ -O3 -shared -fPIC -std=c++17 $< -o $@
 
+# Fast gate: skips the multi-minute equivalence/e2e matrices (marked
+# pytest.mark.slow) — <5 min on one core. `make test-all` runs everything.
 test: native
+	python -m pytest tests/ -x -q -m "not slow"
+
+test-all: native
 	python -m pytest tests/ -x -q
 
 # One pytest process per test file: the XLA CPU runtime's in-process

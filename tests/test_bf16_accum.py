@@ -8,10 +8,15 @@ deep accumulation (acc=8) the two engines' loss trajectories must agree to
 bf16 compute noise, and training must still learn.
 """
 
+import pytest
+
 import numpy as np
 
 from conftest import make_config
 from test_parallel import run_losses
+
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
 
 
 def test_afab_matches_1f1b_bf16_acc8(tiny_model_kwargs):

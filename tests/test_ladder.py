@@ -11,12 +11,17 @@ a tiny model standing in for the 7B geometry, and the metrics extractor
 parses the produced logs into the sweep CSV.
 """
 
+import pytest
+
 import csv
 import json
 import os
 
 from picotron_tpu.tools.extract_metrics import extract
 from picotron_tpu.tools.submit_jobs import Scheduler, Status
+
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -53,6 +53,7 @@ def test_bad_schedule_rejected(tiny_model_kwargs):
         _tcfg(tiny_model_kwargs, lr_schedule="step")
 
 
+@pytest.mark.slow
 def test_warmup_changes_trajectory_and_topology_agrees(tiny_model_kwargs):
     """A scheduled run trains (and differs from constant lr), and the
     schedule rides the jitted step identically on a sharded topology."""

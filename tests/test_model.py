@@ -1,5 +1,7 @@
 """Single-device model + train-step basics: shapes, determinism, learning."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -55,6 +57,7 @@ def test_loss_decreases_single_device(cfg_factory):
     assert losses[-1] < losses[0] - 0.5, losses
 
 
+@pytest.mark.slow
 def test_grad_accumulation_matches_large_batch(cfg_factory):
     """acc=4 x mbs=1 must equal acc=1 x mbs=4 grads-wise: compare one step's
     loss trajectory (same data, same total batch)."""
@@ -110,6 +113,7 @@ def test_forward_logits_zigzag_layout_roundtrip(cfg_factory):
     np.testing.assert_allclose(zig[:, inv], ref, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_remat_modes_do_not_change_math(cfg_factory):
     """remat trades memory for recompute; all three modes must produce the
     identical loss trajectory (fp32, sdpa path: save_attn's checkpoint names

@@ -2,12 +2,17 @@
 K fused steps must produce the same per-step losses and the same final state
 as K separate dispatches (picotron_tpu/train_step.py build_train_step)."""
 
+import pytest
+
 import jax
 import numpy as np
 
 from picotron_tpu import train_step as ts
 from picotron_tpu.data import MicroBatchDataLoader
 from picotron_tpu.topology import topology_from_config
+
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
 
 
 def test_multi_step_matches_single(cfg_factory):

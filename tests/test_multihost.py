@@ -18,6 +18,9 @@ import sys
 import numpy as np
 import pytest
 
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
+
 WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
 

@@ -14,6 +14,9 @@ from picotron_tpu import train_step as ts
 from picotron_tpu.data import MicroBatchDataLoader
 from picotron_tpu.topology import topology_from_config
 
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
+
 STEPS = 5
 
 

@@ -8,10 +8,15 @@ assert at 1.45 separates the healthy regime from both regressions with
 margin for compiler drift.
 """
 
+import pytest
+
 from conftest import make_config
 from picotron_tpu import train_step as ts
 from picotron_tpu.data import MicroBatchDataLoader
 from picotron_tpu.topology import topology_from_config
+
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
 
 
 def _step_flops(cfg):

@@ -1,5 +1,7 @@
 """Profiler trace window (SURVEY §5.1) and gradient clipping coverage."""
 
+import pytest
+
 import glob
 import os
 
@@ -7,6 +9,9 @@ import numpy as np
 
 from conftest import make_config
 from picotron_tpu.train import train
+
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
 
 
 def test_profiler_window_writes_trace(tiny_model_kwargs, tmp_path):

@@ -135,6 +135,7 @@ def test_zigzag_blocks_match_full_attention(use_flash):
                                        rtol=3e-5, atol=3e-5)
 
 
+@pytest.mark.slow
 def test_zigzag_flash_bwd_matches_einsum_bwd():
     q, k, v = _qkv()
     with pltpu.force_tpu_interpret_mode():

@@ -271,3 +271,39 @@ def test_create_config_sp_zero1_flags(tmp_path):
     cfg = json.load(open(tmp_path / "plain" / "config.json"))
     assert cfg["distributed"]["tp_sequence_parallel"] is False
     assert cfg["distributed"]["zero1"] is False
+
+
+# ---------------------------------------------------------- project_multichip
+
+
+def test_projection_ladder_sane():
+    """The multi-chip projection (docs/PROJECTION.md) must stay internally
+    consistent: MFU below the single-chip anchor, every ladder config fitting
+    v5e HBM, and the BASELINE north star (>= 40% SmolLM on v5e-16) holding
+    under the stated conservative assumptions."""
+    from picotron_tpu.tools import project_multichip as pm
+
+    rows = [pm.project(lc) for lc in pm.LADDER]
+    for lc, r in zip(pm.LADDER, rows):
+        assert 0 < r["mfu"] < 100 * lc.model.eff_1chip
+        # configs must fit v5e HBM unless explicitly tagged as over (the
+        # canonical config-5 is shown alongside a fitting variant)
+        assert r["mem_gb"] < 16.0 or "over HBM" in r["config"], (
+            f"{r['config']} does not fit v5e HBM")
+        assert r["comm_eff"] <= 100 and r["bubble_eff"] <= 100
+    assert any(r["mem_gb"] < 16.0 and "seq8192" in r["config"]
+               for r in rows), "no fitting 7B long-context config"
+    north_star = next(r for r in rows if "cp2" in r["config"]
+                      and "SmolLM" in r["config"])
+    assert north_star["mfu"] >= 40.0
+
+
+def test_projection_param_count_matches_model():
+    """The projector's closed-form n_params must agree with the real model's
+    count (llama.num_params) for both ladder models."""
+    from picotron_tpu.config import SMOLLM_1_7B, ModelConfig
+    from picotron_tpu.models import llama
+    from picotron_tpu.tools import project_multichip as pm
+
+    mc = ModelConfig(**SMOLLM_1_7B)
+    assert pm.SMOLLM.n_params() == llama.num_params(mc)

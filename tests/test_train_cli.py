@@ -7,6 +7,8 @@ top after resume, train.py:214-215): with ``skip_steps`` the resumed run sees
 the same batches the uninterrupted one would.
 """
 
+import pytest
+
 import numpy as np
 
 from picotron_tpu.data import MicroBatchDataLoader
@@ -15,6 +17,7 @@ from picotron_tpu.train import train
 from conftest import make_config
 
 
+@pytest.mark.slow
 def test_train_loop_and_interrupted_resume(tiny_model_kwargs, tmp_path):
     common = dict(dp=2, tp=2, mbs=2, seq=32,
                   total_train_steps=6)

@@ -14,6 +14,9 @@ from conftest import make_config
 from picotron_tpu.models.llama import pp_layer_layout
 from test_parallel import run_losses
 
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
+
 
 def test_layout_matches_reference_rule():
     # 32 layers / pp=5: reference gives 7,7,6,6,6 (remainder to earliest)

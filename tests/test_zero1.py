@@ -7,12 +7,16 @@ exactly — reduce-scatter + chunked update + all-gather is a pure
 reassociation of all-reduce + replicated update.
 """
 
+import pytest
 import jax
 import numpy as np
 
 from picotron_tpu import train_step as ts
 from picotron_tpu.topology import topology_from_config
 from tests.test_parallel import run_losses
+
+# multi-minute equivalence/e2e matrices: excluded from `make test`
+pytestmark = pytest.mark.slow
 
 
 def test_zero1_matches_replicated(cfg_factory):
