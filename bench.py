@@ -336,8 +336,10 @@ def inner_main():
     # so lighter remat is parity behavior and the saved recompute FLOPs
     # turn into MFU — on the 16 GB v5e the search lands on save_attn@mbs2,
     # 54.8-55.3% across runs vs full@mbs4's 53.9%; larger-HBM chips get the
-    # larger save_attn batches first. (remat="none" fails TPU compilation
-    # at this scale; it stays a config option.)
+    # larger save_attn batches first. (remat="none" is an HBM wall at this
+    # scale: ~14.5 GB static state + 6+ GB of unrematerialized residuals
+    # on a 16 GB chip — docs/BENCH_7B.md has the arithmetic; it stays a
+    # config option for smaller models / larger chips.)
     sizes = ((("save_attn", 8), ("save_attn", 4), ("save_attn", 2),
               ("full", 4), ("save_attn", 1), ("full", 2),
               ("full", 1)) if on_tpu else (("full", 2),))
