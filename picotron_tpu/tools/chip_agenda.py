@@ -1,0 +1,116 @@
+"""One-shot on-chip work agenda for a flaky-tunnel site.
+
+The TPU behind this rig's tunnel dies for hours at a time (rounds 3-4);
+when it comes back there may be only a short window. This tool runs the
+whole chip-blocked agenda unattended, in priority order, saving every
+artifact under ``docs/chip_runs/<utc-stamp>/`` so one live window converts
+into committed evidence:
+
+1. kernel parity  — PICOTRON_TEST_TPU=1 pytest tests/test_tpu_kernels.py
+2. bench          — python bench.py          (includes the bshd A/B)
+3. bench_7b       — python bench_7b.py       (includes the bshd A/B)
+4. profile        — a jax.profiler trace of the winning SmolLM config
+                    (via train.py's profiler window on a short run)
+
+Each step gets its own timeout and log file; a step failing (tunnel dying
+mid-window) does not stop the later ones from being attempted. Run:
+
+    python -m picotron_tpu.tools.chip_agenda [out_dir]
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run_step(name: str, cmd: list[str], out_dir: str, timeout: float,
+             env: dict | None = None) -> dict:
+    """Run one agenda step, streaming combined stdout+stderr STRAIGHT to the
+    log file — in-memory capture would lose the whole window's output when a
+    timeout fires (CPython discards captured output on TimeoutExpired). The
+    child gets its own session so a timeout kills the entire process GROUP:
+    the benches spawn their own children, and an orphan would keep holding
+    the TPU for every later step."""
+    import signal
+
+    log = os.path.join(out_dir, f"{name}.log")
+    print(f"== {name}: {' '.join(cmd)} (timeout {timeout:.0f}s)", flush=True)
+    with open(log, "w") as f:
+        p = subprocess.Popen(cmd, cwd=REPO, env=env or dict(os.environ),
+                             stdout=f, stderr=subprocess.STDOUT,
+                             start_new_session=True)
+        try:
+            rc = p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+            p.wait()
+            rc = -9
+            f.write(f"\n[timed out after {timeout:.0f}s; process group "
+                    f"killed]\n")
+    with open(log) as f:
+        tail = f.read()[-400:].replace("\n", " ")
+    print(f"   -> rc={rc} log={log}\n   tail: {tail}", flush=True)
+    return {"step": name, "rc": rc, "log": log}
+
+
+def main():
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ")
+    out_dir = (sys.argv[1] if len(sys.argv) > 1
+               else os.path.join(REPO, "docs", "chip_runs", stamp))
+    os.makedirs(out_dir, exist_ok=True)
+    results = []
+
+    env = dict(os.environ, PICOTRON_TEST_TPU="1")
+    results.append(run_step(
+        "kernel_parity",
+        [sys.executable, "-m", "pytest", "-q", "tests/test_tpu_kernels.py"],
+        out_dir, timeout=1500, env=env))
+
+    # the benches carry their own orchestrator (probe/retry/null-artifact)
+    results.append(run_step(
+        "bench", [sys.executable, "bench.py"], out_dir, timeout=5700))
+    results.append(run_step(
+        "bench_7b", [sys.executable, "bench_7b.py"], out_dir, timeout=5700))
+
+    # profiler trace of the winning single-chip config: short real training
+    # run with the profiler window over steps [4, 6)
+    prof_dir = os.path.join(out_dir, "profile")
+    from picotron_tpu.config import SMOLLM_1_7B  # plain dict, no jax import
+
+    cfg = {
+        "distributed": {"dp_size": 1, "pp_size": 1, "cp_size": 1,
+                        "tp_size": 1},
+        "model": dict(SMOLLM_1_7B),
+        "training": {"seq_length": 2048, "micro_batch_size": 2,
+                     "gradient_accumulation_steps": 1, "remat": "save_attn",
+                     "learning_rate": 3e-4, "total_train_steps": 6,
+                     "steps_per_call": 1},
+        "dataset": {"name": "synthetic"},
+        "logging": {"profile_start": 4, "profile_stop": 6,
+                    "profile_dir": prof_dir},
+    }
+    cfg_path = os.path.join(out_dir, "profile_cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f, indent=2)
+    results.append(run_step(
+        "profile", [sys.executable, "train.py", "--config", cfg_path],
+        out_dir, timeout=1800))
+
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results))
+    return 0 if all(r["rc"] == 0 for r in results) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -99,6 +99,7 @@ class Ladder:
     mbs: int = 1
     acc: int = 8   # microbatches per step (>= pp so 1F1B fills)
     zero1: bool = False  # dp-shard optimizer state (needed to FIT 7B on v5e)
+    interleave: int = 1  # virtual pipeline stages (pp_interleave): bubble /= v
     tag: str = ""  # annotation carried into the printed config column
 
     @property
@@ -146,7 +147,9 @@ def project(lc: Ladder) -> dict:
     # ---- PP p2p per microbatch ----
     pp_bytes = B * (S // lc.cp) * m.H * BYTES_ACT / max(
         1, lc.tp)  # SP: boundary is seq-sharded over tp
-    t_pp = (2 * pp_bytes / ICI_BW) if lc.pp > 1 else 0.0  # fwd act + bwd grad
+    # interleaving multiplies boundary crossings by v (each microbatch
+    # traverses v*pp chunks) — the cost side of the bubble credit
+    t_pp = (2 * pp_bytes * lc.interleave / ICI_BW) if lc.pp > 1 else 0.0
 
     # ---- DP gradient sync per step (amortized over acc microbatches) ----
     shard_params = m.n_params() / (lc.tp * lc.pp)
@@ -161,7 +164,9 @@ def project(lc: Ladder) -> dict:
 
     t_comm = t_tp + t_cp + t_pp + t_dp
     comm_eff = t_compute / (t_compute + t_comm)
-    bubble_eff = lc.acc / (lc.acc + lc.pp - 1)
+    # interleaved 1F1B shrinks the fill/drain bubble by the virtual-stage
+    # factor (parallel/pp.py::pipeline_1f1b_interleaved; equivalence-tested)
+    bubble_eff = lc.acc / (lc.acc + (lc.pp - 1) / lc.interleave)
 
     mfu = m.eff_1chip * comm_eff * bubble_eff
 
@@ -196,6 +201,8 @@ LADDER = [
            tag="canonical; ~1 GB over HBM"),
     Ladder(5, LLAMA7B, dp=1, tp=2, pp=4, cp=2, seq=8192,
            tag="fits-v5e variant"),
+    Ladder(5, LLAMA7B, dp=1, tp=2, pp=4, cp=2, seq=8192, interleave=2,
+           tag="fits-v5e variant + pp_interleave 2"),
 ]
 
 

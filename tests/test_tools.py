@@ -307,3 +307,22 @@ def test_projection_param_count_matches_model():
 
     mc = ModelConfig(**SMOLLM_1_7B)
     assert pm.SMOLLM.n_params() == llama.num_params(mc)
+
+
+# --------------------------------------------------------------- chip_agenda
+
+
+def test_chip_agenda_run_step(tmp_path):
+    """The on-chip agenda runner must survive per-step timeouts/failures and
+    always leave a log artifact (a tunnel dying mid-window must not lose the
+    earlier steps' evidence)."""
+    import sys
+
+    from picotron_tpu.tools.chip_agenda import run_step
+
+    ok = run_step("ok", [sys.executable, "-c", "print('x')"], str(tmp_path),
+                  timeout=30)
+    assert ok["rc"] == 0 and os.path.exists(ok["log"])
+    to = run_step("to", [sys.executable, "-c", "import time; time.sleep(9)"],
+                  str(tmp_path), timeout=1)
+    assert to["rc"] == -9 and "timed out" in open(to["log"]).read()
