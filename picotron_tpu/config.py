@@ -342,10 +342,10 @@ class Config:
             if b is not None and (b < 8 or b & (b - 1) != 0):
                 raise ValueError(
                     f"{name} must be a power of two >= 8, got {b}")
-        if t.grad_accum_dtype == "param" and d.pp_size > 1:
-            # the pipeline schedules accumulate in fp32 (the reference's
-            # main_grad policy); 'param' is a single-stage memory optimization
-            raise ValueError("grad_accum_dtype='param' requires pp_size == 1")
+        # grad_accum_dtype='param' is valid on every topology: the pipeline
+        # engines accept acc_dtype (fp32 default = the reference's main_grad
+        # policy; param dtype halves the accumulator + the dp sync wire and
+        # is what lets 7B fit 16 GB v5e chips at tp2/pp2 — docs/PROJECTION.md)
         if t.seq_length > m.max_position_embeddings:
             raise ValueError(
                 f"seq_length {t.seq_length} > max_position_embeddings "

@@ -116,8 +116,9 @@ def pipeline_afab_loss(stage_fn, params, tokens, targets, pp_size, h_shape, h_dt
     return lax.psum(jnp.sum(contribs), "pp") / M
 
 
-def pipeline_afab(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
-    """(loss, grads_fp32) via autodiff through the forward pipeline.
+def pipeline_afab(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype,
+                  acc_dtype=jnp.float32):
+    """(loss, grads) via autodiff through the forward pipeline.
 
     Gradients accumulate across microbatch ticks in float32 — the reference's
     main_grad policy (data_parallel.py:66,81) — via a dtype trick: the
@@ -126,7 +127,14 @@ def pipeline_afab(stage_fn, params, tokens, targets, pp_size, h_shape, h_dtype):
     cast-transposed to fp32 before the scan transpose sums it. With fp32
     compute dtype the casts are identity and XLA removes them. Costs one
     fp32 param copy; AFAB is the correctness oracle, 1F1B the production
-    engine."""
+    engine. With ``acc_dtype`` = the param dtype the cast trick is skipped
+    and the scan transpose accumulates cotangents natively in param dtype
+    (the opt-in memory saver)."""
+    if all(p.dtype == acc_dtype for p in jax.tree.leaves(params)):
+        return jax.value_and_grad(
+            lambda p: pipeline_afab_loss(stage_fn, p, tokens, targets,
+                                         pp_size, h_shape, h_dtype)
+        )(params)
     dtypes = jax.tree.map(lambda p: p.dtype, params)
     params32 = jax.tree.map(lambda p: p.astype(jnp.float32), params)
 
@@ -159,7 +167,8 @@ def _full_tick(fwd_half, bwd_half):
 
 
 def pipeline_1f1b_interleaved(stage_fwd, stage_bwd, params, tokens, targets,
-                              pp_size, v, h_shape, h_dtype):
+                              pp_size, v, h_shape, h_dtype,
+                              acc_dtype=jnp.float32):
     """Interleaved (virtual-stage) 1F1B: each device holds ``v``
     non-contiguous model chunks (chunk-major rows of its 'pp' shard, layout
     ``llama.pp_layer_layout(L, pp, v)``), shrinking the pipeline bubble by
@@ -219,7 +228,7 @@ def pipeline_1f1b_interleaved(stage_fwd, stage_bwd, params, tokens, targets,
         m = g * pp_size + j % pp_size
         return c, m
 
-    gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), params)
     h0 = jnp.zeros(h_shape, h_dtype)
     tok0, tgt0 = _take_mb(tokens, 0), _take_mb(targets, 0)
     t_pred = jnp.bool_(True)
@@ -273,12 +282,12 @@ def pipeline_1f1b_interleaved(stage_fwd, stage_bwd, params, tokens, targets,
             lambda acc, g: lax.dynamic_update_slice_in_dim(
                 acc,
                 lax.dynamic_slice_in_dim(acc, c * Kv, Kv, 0)
-                + g.astype(jnp.float32),
+                + g.astype(acc_dtype),
                 c * Kv, 0),
             gacc["layers"], dparams["layers"])
         gacc = {
             k2: (glayers if k2 == "layers"
-                 else jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                 else jax.tree.map(lambda a, g: a + g.astype(acc_dtype),
                                    gacc[k2], dparams[k2]))
             for k2 in gacc
         }
@@ -298,8 +307,11 @@ def pipeline_1f1b_interleaved(stage_fwd, stage_bwd, params, tokens, targets,
 
 
 def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
-                  h_shape, h_dtype):
-    """(loss, grads_fp32) via the interleaved one-forward-one-backward schedule.
+                  h_shape, h_dtype, acc_dtype=jnp.float32):
+    """(loss, grads) via the one-forward-one-backward schedule; gradients
+    accumulate across microbatch ticks in ``acc_dtype`` (float32 default =
+    the reference's main_grad policy; param dtype is the opt-in memory
+    saver that lets 7B-class configs fit v5e HBM — docs/PROJECTION.md).
 
     Tick t: stage s forwards microbatch ``t - s`` and backwards microbatch
     ``t - (2*pp - 2 - s)`` (both masked to [0, M)). The last stage backwards a
@@ -329,7 +341,7 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
     BUF = 2 * pp_size - 1  # max in-flight microbatches = 2*pp - 2 - 2*s < BUF
     down, up = _down_perm(pp_size), _up_perm(pp_size)
 
-    gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), params)
     h0 = jnp.zeros(h_shape, h_dtype)
     tok0, tgt0 = _take_mb(tokens, 0), _take_mb(targets, 0)
     saved_shape = jax.eval_shape(
@@ -370,7 +382,7 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
         dloss = jnp.where(is_last & bvalid, 1.0 / M, 0.0).astype(jnp.float32)
         dparams, dh_prev = stage_bwd(params, saved_b, tok_b, tgt_b, dh_out, dloss)
         gacc = jax.tree.map(
-            lambda a, g: a + jnp.where(bvalid, g, 0).astype(jnp.float32), gacc, dparams
+            lambda a, g: a + jnp.where(bvalid, g, 0).astype(acc_dtype), gacc, dparams
         )
         _trace("pp.1f1b send_recv grad up", "pp", dh_prev)
         dh_next = lax.ppermute(dh_prev, "pp", up) if up else jnp.zeros_like(dh_prev)

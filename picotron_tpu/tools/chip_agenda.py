@@ -57,7 +57,8 @@ def run_step(name: str, cmd: list[str], out_dir: str, timeout: float,
             f.write(f"\n[timed out after {timeout:.0f}s; process group "
                     f"killed]\n")
     with open(log) as f:
-        tail = f.read()[-400:].replace("\n", " ")
+        f.seek(max(0, os.path.getsize(log) - 400))
+        tail = f.read().replace("\n", " ")
     print(f"   -> rc={rc} log={log}\n   tail: {tail}", flush=True)
     return {"step": name, "rc": rc, "log": log}
 

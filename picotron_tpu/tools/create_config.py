@@ -126,6 +126,7 @@ def create_single_config(
     total_train_steps: Optional[int] = None,
     seed: Optional[int] = None,
     remat: Optional[str] = None,
+    grad_accum_dtype: Optional[str] = None,
     steps_per_call: Optional[int] = None,
     template_path: str = TEMPLATE_PATH,
     exist_ok: bool = False,
@@ -185,6 +186,8 @@ def create_single_config(
         t["seed"] = seed
     if remat is not None:
         t["remat"] = remat
+    if grad_accum_dtype is not None:
+        t["grad_accum_dtype"] = grad_accum_dtype
     if steps_per_call is not None:
         t["steps_per_call"] = steps_per_call
 
@@ -261,6 +264,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--remat", type=str, default=None,
                    choices=("none", "full", "save_attn"))
+    p.add_argument("--grad_accum_dtype", type=str, default=None,
+                   choices=("float32", "param"),
+                   help="microbatch grad accumulator dtype: float32 (the "
+                        "reference's main-grad policy, default) or 'param' "
+                        "(bf16 — halves grad memory + dp sync wire)")
     p.add_argument("--steps_per_call", type=int, default=None,
                    help="optimizer steps fused per device dispatch")
     p.add_argument("--use_wandb", action="store_true")
@@ -297,7 +305,9 @@ def main(argv=None) -> int:
         lr_warmup_steps=args.lr_warmup_steps, lr_min_ratio=args.lr_min_ratio,
         lr_decay_steps=args.lr_decay_steps,
         total_train_steps=args.total_train_steps,
-        seed=args.seed, remat=args.remat, steps_per_call=args.steps_per_call,
+        seed=args.seed, remat=args.remat,
+        grad_accum_dtype=args.grad_accum_dtype,
+        steps_per_call=args.steps_per_call,
         template_path=args.template, exist_ok=args.overwrite,
     )
     print(f"config created: {path}")

@@ -44,10 +44,10 @@ ICI_BW = 4.5e10             # bytes/s one-way per link per direction
 #   all_reduce: 2x that.
 RING_BW = 2 * ICI_BW
 BYTES_ACT = 2               # bf16 activations
-# Gradients are synced in fp32: the dp/cp pmean and the ZeRO-1
-# reduce-scatter run on the fp32 accumulators, and the downcast to param
-# dtype happens after sync+clip (train_step.py) — so the wire and the grad
-# buffer both carry 4 bytes/param.
+# Default grad bytes: sync and accumulation run in fp32 (the dp/cp pmean
+# and the ZeRO-1 reduce-scatter see the accumulators; downcast happens
+# after sync+clip, train_step.py). Rows with grad_accum='param' override
+# this to 2 B in project() — bf16 accumulators are synced as bf16.
 BYTES_GRAD = 4
 
 # measured single-chip compute efficiency anchors (docs/BENCH_7B.md)
@@ -100,6 +100,8 @@ class Ladder:
     acc: int = 8   # microbatches per step (>= pp so 1F1B fills)
     zero1: bool = False  # dp-shard optimizer state (needed to FIT 7B on v5e)
     interleave: int = 1  # virtual pipeline stages (pp_interleave): bubble /= v
+    # training.grad_accum_dtype: "float32" | "param" (bf16 accumulators)
+    grad_accum: str = "float32"
     tag: str = ""  # annotation carried into the printed config column
 
     @property
@@ -152,14 +154,15 @@ def project(lc: Ladder) -> dict:
     t_pp = (2 * pp_bytes * lc.interleave / ICI_BW) if lc.pp > 1 else 0.0
 
     # ---- DP gradient sync per step (amortized over acc microbatches) ----
+    bytes_grad = 2 if lc.grad_accum == "param" else BYTES_GRAD
     shard_params = m.n_params() / (lc.tp * lc.pp)
     if lc.zero1:
         # reduce-scatter grads + all-gather updated params: each costs one
         # ring pass — the same total wire bytes as the plain all-reduce
-        t_dp_full = (ring_ag_or_rs(shard_params * BYTES_GRAD, lc.dp)
+        t_dp_full = (ring_ag_or_rs(shard_params * bytes_grad, lc.dp)
                      + ring_ag_or_rs(shard_params * 2, lc.dp))
     else:
-        t_dp_full = ring_ar(shard_params * BYTES_GRAD, lc.dp)
+        t_dp_full = ring_ar(shard_params * bytes_grad, lc.dp)
     t_dp = 0.25 * t_dp_full / lc.acc  # mostly overlapped with backward
 
     t_comm = t_tp + t_cp + t_pp + t_dp
@@ -170,15 +173,18 @@ def project(lc: Ladder) -> dict:
 
     mfu = m.eff_1chip * comm_eff * bubble_eff
 
-    # ---- memory sanity (bytes/chip): params bf16 + adam m,v fp32 + grads;
-    # ZeRO-1 dp-shards the optimizer moments. Activations/temp buffers are
+    # ---- memory sanity (bytes/chip): params bf16 (2) + Adam m,v in param
+    # dtype (optax zeros_like -> bf16, 4 total; NOT the fp32 8 a torch
+    # fp32-state setup would need) + the grad accumulator (4 fp32 / 2
+    # param). ZeRO-1 dp-shards the moments. Activations/temp buffers are
     # excluded (remat keeps them small; stated in docs/PROJECTION.md) ----
-    opt_bytes = 8 / lc.dp if lc.zero1 else 8
-    mem = shard_params * (2 + opt_bytes + BYTES_GRAD)
+    opt_bytes = 4 / lc.dp if lc.zero1 else 4
+    mem = shard_params * (2 + opt_bytes + bytes_grad)
     return dict(
         config=(f"{m.name} dp{lc.dp}/tp{lc.tp}/pp{lc.pp}/cp{lc.cp} seq{S}"
                 + (" (ZeRO-1)" if lc.zero1 else "")
                 + (f" [{lc.tag}]" if lc.tag else "")),
+        grad_accum=lc.grad_accum,
         chips=lc.chips, mfu=100 * mfu, comm_eff=100 * comm_eff,
         bubble_eff=100 * bubble_eff,
         t_compute_ms=1e3 * t_compute, t_tp_ms=1e3 * t_tp, t_cp_ms=1e3 * t_cp,
@@ -190,19 +196,22 @@ def project(lc: Ladder) -> dict:
 LADDER = [
     Ladder(3, SMOLLM, dp=2, tp=2, pp=2, cp=1, seq=2048),
     Ladder(3, SMOLLM, dp=2, tp=2, pp=2, cp=2, seq=2048),  # v5e-16 north star
-    # 7B does NOT fit a 16 GB v5e at tp2/pp2 with dp-replicated optimizer
-    # state (1.68B params/chip x 14 B = 23 GB) — the GPU reference fits in
-    # 80 GB H100s; on v5e config 4 requires our ZeRO-1. Config 5's canonical
-    # dp2/tp2/pp2/cp2 is ~0.8 GB over even WITH ZeRO-1 (the fp32 grad
-    # accumulator alone is 6.7 GB/chip); the pp4/dp1 variant carries the
-    # same 16-chip 4D workload with headroom, so both are shown.
+    # 7B does NOT fit a 16 GB v5e at tp2/pp2 with dp-replicated grads+state
+    # (1.68B params/chip x 10 B = 16.8 GB) — the GPU reference fits in 80 GB
+    # H100s; on v5e the tp2/pp2 configs need our ZeRO-1 (13.5 GB), and
+    # grad_accum_dtype='param' (bf16 accumulators, supported by all three
+    # pipeline engines) buys another 3.4 GB of activation headroom at
+    # seq 8192. The pp4/dp1 rows carry the same 16-chip 4D workload with
+    # deeper model sharding instead.
     Ladder(4, LLAMA7B, dp=4, tp=2, pp=2, cp=1, seq=1024, zero1=True),
     Ladder(5, LLAMA7B, dp=2, tp=2, pp=2, cp=2, seq=8192, zero1=True,
-           tag="canonical; ~1 GB over HBM"),
+           tag="canonical"),
+    Ladder(5, LLAMA7B, dp=2, tp=2, pp=2, cp=2, seq=8192, zero1=True,
+           grad_accum="param", tag="canonical + bf16 grad accum"),
     Ladder(5, LLAMA7B, dp=1, tp=2, pp=4, cp=2, seq=8192,
-           tag="fits-v5e variant"),
+           tag="pp4 variant"),
     Ladder(5, LLAMA7B, dp=1, tp=2, pp=4, cp=2, seq=8192, interleave=2,
-           tag="fits-v5e variant + pp_interleave 2"),
+           tag="pp4 variant + pp_interleave 2"),
 ]
 
 

@@ -300,8 +300,8 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
         stage_fn = lambda p, h, tok, tgt: llama.stage_apply(p, h, tok, tgt, cos, sin, cfg)
         h_shape = (tokens.shape[1], tokens.shape[2] // sp_div,
                    cfg.model.hidden_size)
+        acc_dt = dt if cfg.training.grad_accum_dtype == "param" else jnp.float32
         if pp == 1:
-            acc_dt = dt if cfg.training.grad_accum_dtype == "param" else jnp.float32
             loss, grads = no_pipeline(stage_fn, params, tokens, targets,
                                       h_shape, dt, acc_dt)
         elif engine == "1f1b" and cfg.distributed.pp_interleave > 1:
@@ -313,17 +313,18 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
                                 fi, la)
             loss, grads = pipeline_1f1b_interleaved(
                 stage_fwd, stage_bwd, params, tokens, targets, pp, vch,
-                h_shape, dt)
+                h_shape, dt, acc_dtype=acc_dt)
         elif engine == "1f1b":
             stage_fwd = lambda p, h, tok, tgt: llama.stage_fwd_save(
                 p, h, tok, tgt, cos, sin, cfg)
             stage_bwd = lambda p, saved, tok, tgt, dh, dl: llama.stage_bwd(
                 p, saved, tok, tgt, dh, dl, cos, sin, cfg)
             loss, grads = pipeline_1f1b(stage_fwd, stage_bwd, params, tokens,
-                                        targets, pp, h_shape, dt)
+                                        targets, pp, h_shape, dt,
+                                        acc_dtype=acc_dt)
         else:
             loss, grads = pipeline_afab(stage_fn, params, tokens, targets, pp,
-                                        h_shape, dt)
+                                        h_shape, dt, acc_dtype=acc_dt)
 
         # grad sync: mean over the fused dp×cp group (data_parallel.py:47,83),
         # psum over pp for stage-replicated params, cast fp32 -> param dtype

@@ -76,3 +76,17 @@ def test_zero1_state_is_dp_sharded(cfg_factory):
         shard = leaf.sharding.shard_shape(leaf.shape)
         assert shard[0] * 4 == leaf.shape[0], (
             f"leaf {leaf.shape} shard {shard} is not 1/dp")
+
+
+def test_zero1_param_dtype_accum_bf16(cfg_factory):
+    """ZeRO-1 with bf16 (param-dtype) grad accumulators — the projected
+    'canonical + bf16 grad accum' 7B configuration (docs/PROJECTION.md):
+    the bf16 grads must flow through the reduce-scatter + sharded clip +
+    chunked-optimizer path and track the replicated-optimizer trajectory
+    to bf16 tolerance."""
+    kw = dict(dp=2, pp=2, acc=2, engine="1f1b", seq=32, mbs=1,
+              dtype="bfloat16", grad_accum_dtype="param", grad_clip=1.0)
+    base = run_losses(cfg_factory(**kw), steps=6)
+    got = run_losses(cfg_factory(**kw, zero1=True), steps=6)
+    np.testing.assert_allclose(got, base, rtol=0.02, atol=0.02)
+    assert min(base[-3:]) < base[0], f"did not trend down: {base}"
