@@ -104,14 +104,32 @@ def ring_attention(q, k, v, scale: float, axis: str, axis_size: int,
                    zigzag: bool = False, block_q: int | None = None,
                    block_k: int | None = None,
                    flash_layout: str = "folded"):
-    """q, k, v: [B, S_local, H, D] (kv heads already GQA-repeated, as the
-    reference repeats before the ring, model.py:141-142). Returns [B,S,H,D].
+    """q: [B, S_local, Hq, D]; k, v: [B, S_local, Hkv, D] with
+    Hq % Hkv == 0. Returns [B, S, Hq, D]. GQA-aware: unlike the reference
+    (which repeats kv heads BEFORE the ring, model.py:141-142), the ring
+    circulates the compact Hkv-head K/V and dK/dV — Hq/Hkv x less ICI
+    traffic for grouped-query models — expanding to Hq only at each block
+    compute (and group-summing the grads back, the repeat's transpose).
     use_flash selects the Pallas block kernel (TPU) over the XLA einsum;
     zigzag expects the zigzag_perm() sequence layout and balances causal
     work across ranks."""
     out, _ = _ring_fwd_impl(q, k, v, scale, axis, axis_size, causal,
                             use_flash, zigzag, block_q, block_k, flash_layout)
     return out
+
+
+def _gqa_expand(x, g: int):
+    """[B, S, Hkv, D] -> [B, S, Hkv*g, D] by repeating each kv head g times
+    (identity when g == 1)."""
+    return jnp.repeat(x, g, axis=2) if g > 1 else x
+
+
+def _gqa_fold(dx, g: int):
+    """Transpose of _gqa_expand: group-sum [B, S, Hkv*g, D] -> [B, S, Hkv, D]."""
+    if g == 1:
+        return dx
+    b, s, h, d = dx.shape
+    return dx.reshape(b, s, h // g, g, d).sum(axis=3)
 
 
 def _block_fwd(q, kt, vt, scale, src, rank, causal, use_flash, n, zigzag,
@@ -175,12 +193,17 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag,
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     b, s, h, d = q.shape
+    if h % k.shape[2]:
+        raise ValueError(
+            f"ring_attention: q heads ({h}) must be a multiple of kv heads "
+            f"({k.shape[2]})")
+    g = h // k.shape[2]  # GQA group size; the ring carries Hkv-head chunks
     out0 = jnp.zeros((b, s, h, d), jnp.float32)
     lse0 = jnp.full((b, s, h), NEG_INF, jnp.float32)
 
     def step(carry, t):
         kv, out, lse = carry
-        kt, vt = kv
+        kt, vt = _gqa_expand(kv[0], g), _gqa_expand(kv[1], g)
         src = (rank - t) % n
         blk_out, blk_lse = _block_fwd(q, kt, vt, scale, src, rank, causal,
                                       use_flash, n, zigzag, block_q, block_k,
@@ -282,17 +305,20 @@ def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, block_q, block_k,
     rank = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     b, s, h, d = q.shape
+    g = h // k.shape[2]  # dK/dV ride the ring group-summed to Hkv heads
 
     # D_i = sum_j dO_ij * O_ij (softmax backward rowsum, the reference's manual
     # 6-step derivation, context_parallel.py:130-155)
     D = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
     dq0 = jnp.zeros((b, s, h, d), jnp.float32)
-    dkv0 = (jnp.zeros((b, s, h, d), jnp.float32), jnp.zeros((b, s, h, d), jnp.float32))
+    hkv = h // g
+    dkv0 = (jnp.zeros((b, s, hkv, d), jnp.float32),
+            jnp.zeros((b, s, hkv, d), jnp.float32))
 
     def step(carry, t):
         kv, dkv, dq = carry
-        kt, vt = kv
+        kt, vt = _gqa_expand(kv[0], g), _gqa_expand(kv[1], g)
         dk_acc, dv_acc = dkv
         src = (rank - t) % n
         if use_flash:
@@ -307,8 +333,8 @@ def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, block_q, block_k,
         dq = dq + dq_blk
         # accumulators travel the ring with their kv chunk and arrive home
         # after n rotations (reference's d_kv_comm channel,
-        # context_parallel.py:104-106)
-        dkv = (dk_acc + dk_blk, dv_acc + dv_blk)
+        # context_parallel.py:104-106), group-summed to the compact Hkv heads
+        dkv = (dk_acc + _gqa_fold(dk_blk, g), dv_acc + _gqa_fold(dv_blk, g))
         _trace("ring.bwd send_recv kv+dkv", axis, kv[0], extra=f"ring_steps={n}")
         kv, dkv = lax.ppermute((kv, dkv), axis, perm)
         return (kv, dkv, dq), None

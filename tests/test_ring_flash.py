@@ -6,8 +6,10 @@ threaded device executor), so the flash-block math is validated here by
 decomposing a 2-chunk causal attention by hand on ONE device — exactly the
 per-step computation the ring performs (picotron_tpu/parallel/cp.py) minus
 the ppermute. The ring's collective schedule itself is covered by the
-einsum-path topology-equivalence tests in test_parallel.py; einsum and
-flash paths share the merge/backward glue tested here.
+einsum-path topology-equivalence tests in test_parallel.py — and by the
+GQA ring test at the bottom of this file, which CAN run the full ring in a
+2-device shard_map because it uses the einsum path (use_flash=False), not
+Pallas. Einsum and flash paths share the merge/backward glue tested here.
 """
 
 from contextlib import nullcontext
@@ -183,4 +185,64 @@ def test_block_fwd_custom_tiles_match_default():
     np.testing.assert_allclose(np.asarray(o_cus), np.asarray(o_def),
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(l_cus), np.asarray(l_def),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_ring_matches_full_attention_and_grads():
+    """GQA-aware ring (compact Hkv-head K/V on the wire): forward and
+    (dq, dk, dv) must match full causal attention over pre-repeated K/V,
+    with dk/dv group-summed back to the compact heads — the transpose of
+    the repeat the reference performs before its ring (model.py:141-142)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from picotron_tpu.parallel.cp import ring_attention
+
+    n = 2
+    hq, hkv = 4, 2
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, S, hq, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, hkv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, hkv, D), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(9), (B, S, hq, D), jnp.float32)
+
+    devs = jax.devices()[:n]
+    mesh = Mesh(np.array(devs), ("cp",))
+    spec = P(None, "cp")
+
+    def shard_fn(q, k, v, wl):
+        def ring_loss(q, k, v):
+            out = ring_attention(q, k, v, SCALE, "cp", n, True, False)
+            return jnp.sum(out * wl), out
+
+        (loss, out), grads = jax.value_and_grad(
+            ring_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+        return out, grads, jax.lax.psum(loss, "cp")
+
+    out, (dq, dk, dv), loss = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(spec, spec, spec, spec),
+        out_specs=((spec, (spec, spec, spec), P())), check_vma=False,
+    ))(q, k, v, w)
+
+    # reference: plain causal attention over pre-repeated K/V
+    g = hq // hkv
+    kr, vr = jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+    def ref_loss(q, k, v):
+        o = sdpa(q, k, v, SCALE, causal=True)
+        return jnp.sum(o * w), o
+
+    (rl, ro), (rdq, rdkr, rdvr) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2), has_aux=True)(q, kr, vr)
+    # fold the reference's repeated-head grads to the compact layout
+    rdk = rdkr.reshape(B, S, hkv, g, D).sum(axis=3)
+    rdv = rdvr.reshape(B, S, hkv, g, D).sum(axis=3)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ro),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(loss), float(rl), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
                                rtol=2e-5, atol=2e-5)
