@@ -265,14 +265,14 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
     v = (x @ lp["wv"]).reshape(B, S, nkv, D)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    ring_cp = (cfg.distributed.cp_size > 1
-               and cfg.distributed.cp_impl == "ring")
-    if nkv != nh and not ring_cp:
-        # GQA: repeat kv heads before the kernel (model.py:141-142). The
-        # ring path does NOT pre-repeat: it circulates compact Hkv-head
-        # K/V (Hq/Hkv x less ICI traffic) and expands per block inside
-        # parallel/cp.py; Ulysses keeps the repeat (its all-to-all
-        # head-shards, and Hkv/tp % cp would over-constrain configs).
+    cp, cp_impl = cfg.distributed.cp_size, cfg.distributed.cp_impl
+    # GQA + context parallelism: the compact Hkv-head K/V ride the wire
+    # (Hq/Hkv x less ICI traffic than the reference's pre-repeat,
+    # model.py:141-142) whenever the CP algorithm supports it — always for
+    # the ring (expand per block), for Ulysses when the local kv heads
+    # split evenly over cp (expand after the all-to-all).
+    compact_cp = cp > 1 and (cp_impl == "ring" or nkv % cp == 0)
+    if nkv != nh and not compact_cp:
         k = jnp.repeat(k, nh // nkv, axis=2)
         v = jnp.repeat(v, nh // nkv, axis=2)
     o = _attention(q, k, v, cfg).reshape(B, S, nh * D)

@@ -369,10 +369,13 @@ def ulysses_attention(q, k, v, scale: float, axis: str, axis_size: int,
                       block_q: int | None = None,
                       block_k: int | None = None,
                       flash_layout: str = "folded"):
-    """q, k, v: [B, S_local, H, D], sequence CONTIGUOUSLY sharded over
-    ``axis`` (no zigzag — Ulysses is load-balanced by construction) and
-    H % axis_size == 0 (kv heads already GQA-repeated). Returns
-    [B, S_local, H, D]."""
+    """q: [B, S_local, Hq, D]; k, v: [B, S_local, Hkv, D], sequence
+    CONTIGUOUSLY sharded over ``axis`` (no zigzag — Ulysses is
+    load-balanced by construction), Hq % axis_size == 0. GQA-aware: when
+    Hkv % axis_size == 0 the compact kv heads ride the all-to-alls
+    (Hq/Hkv x less wire on 2 of the 3 inbound reshards) and are expanded
+    to Hq only after resharding; otherwise the caller pre-repeats (the
+    model layer handles this). Returns [B, S_local, Hq, D]."""
     n = axis_size
 
     def seq_to_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
@@ -385,10 +388,22 @@ def ulysses_attention(q, k, v, scale: float, axis: str, axis_size: int,
         return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
                               tiled=True)
 
+    g = q.shape[2] // k.shape[2]
+    if (q.shape[2] % k.shape[2] or q.shape[2] % n
+            or (g > 1 and k.shape[2] % n)):
+        raise ValueError(
+            f"ulysses_attention: q heads ({q.shape[2]}) must be a multiple "
+            f"of kv heads ({k.shape[2]}) and divisible by cp ({n}), and "
+            f"compact GQA kv heads must be divisible by cp — pre-repeat kv "
+            f"otherwise")
     if n == 1:
         qf, kf, vf = q, k, v
     else:
         qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # expand AFTER the reshard: [B, S, Hkv/n, D] -> [B, S, Hq/n, D]; the
+    # grads of repeat (a group-sum) transpose back through the reverse
+    # all-to-all automatically
+    kf, vf = _gqa_expand(kf, g), _gqa_expand(vf, g)
     if use_flash:
         from picotron_tpu.ops.pallas.flash_attention import flash_attention
 

@@ -188,14 +188,16 @@ def test_block_fwd_custom_tiles_match_default():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_gqa_ring_matches_full_attention_and_grads():
-    """GQA-aware ring (compact Hkv-head K/V on the wire): forward and
-    (dq, dk, dv) must match full causal attention over pre-repeated K/V,
-    with dk/dv group-summed back to the compact heads — the transpose of
-    the repeat the reference performs before its ring (model.py:141-142)."""
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_gqa_cp_matches_full_attention_and_grads(mode):
+    """GQA-aware context parallelism (compact Hkv-head K/V on the wire, both
+    algorithms): forward, loss, and (dq, dk, dv) must match full causal
+    attention over pre-repeated K/V, with dk/dv group-summed back to the
+    compact heads — the transpose of the repeat the reference performs
+    before its ring (model.py:141-142)."""
     from jax.sharding import Mesh, PartitionSpec as P
 
-    from picotron_tpu.parallel.cp import ring_attention
+    from picotron_tpu.parallel.cp import ring_attention, ulysses_attention
 
     n = 2
     hq, hkv = 4, 2
@@ -205,17 +207,23 @@ def test_gqa_ring_matches_full_attention_and_grads():
     v = jax.random.normal(ks[2], (B, S, hkv, D), jnp.float32)
     w = jax.random.normal(jax.random.PRNGKey(9), (B, S, hq, D), jnp.float32)
 
-    devs = jax.devices()[:n]
-    mesh = Mesh(np.array(devs), ("cp",))
+    if mode == "ring":
+        attn = lambda q, k, v: ring_attention(q, k, v, SCALE, "cp", n, True,
+                                              False)
+    else:
+        attn = lambda q, k, v: ulysses_attention(q, k, v, SCALE, "cp", n,
+                                                 True, False)
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("cp",))
     spec = P(None, "cp")
 
     def shard_fn(q, k, v, wl):
-        def ring_loss(q, k, v):
-            out = ring_attention(q, k, v, SCALE, "cp", n, True, False)
+        def loss_fn(q, k, v):
+            out = attn(q, k, v)
             return jnp.sum(out * wl), out
 
         (loss, out), grads = jax.value_and_grad(
-            ring_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+            loss_fn, argnums=(0, 1, 2), has_aux=True)(q, k, v)
         return out, grads, jax.lax.psum(loss, "cp")
 
     out, (dq, dk, dv), loss = jax.jit(jax.shard_map(
@@ -237,12 +245,18 @@ def test_gqa_ring_matches_full_attention_and_grads():
     rdk = rdkr.reshape(B, S, hkv, g, D).sum(axis=3)
     rdv = rdvr.reshape(B, S, hkv, g, D).sum(axis=3)
 
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ro),
-                               rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(float(loss), float(rl), rtol=2e-5)
-    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq),
-                               rtol=2e-5, atol=2e-5)
-    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
-                               rtol=2e-5, atol=2e-5)
-    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
-                               rtol=2e-5, atol=2e-5)
+    for got, want in ((out, ro), (dq, rdq), (dk, rdk), (dv, rdv)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_ulysses_rejects_indivisible_compact_heads():
+    """Compact kv heads that do not split over cp must be a clear error at
+    the API boundary, not a shape crash inside the all-to-all."""
+    from picotron_tpu.parallel.cp import ulysses_attention
+
+    q = jnp.zeros((1, 8, 6, 4), jnp.float32)
+    k = v = jnp.zeros((1, 8, 3, 4), jnp.float32)
+    with pytest.raises(ValueError, match="divisible by cp"):
+        ulysses_attention(q, k, v, 1.0, "cp", 2, True, False)
