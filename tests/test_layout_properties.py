@@ -1,0 +1,85 @@
+"""Property-based invariants for the layout/permutation machinery.
+
+These structures (stacked-layer layouts, zigzag sequence permutations, the
+GQA expand/fold pair) are where a silent indexing bug would corrupt training
+while every shape still checks out — so their algebraic invariants get
+hypothesis coverage across the whole small-parameter space, not just the
+handful of geometries the equivalence matrices use.
+"""
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from picotron_tpu.models.llama import pp_layer_layout
+from picotron_tpu.parallel.cp import (
+    chunk_positions,
+    zigzag_inverse_perm,
+    zigzag_perm,
+)
+
+
+@settings(deadline=None, max_examples=60)
+@given(st.integers(1, 6), st.integers(1, 6), st.integers(1, 4),
+       st.integers(0, 20))
+def test_pp_layer_layout_is_an_injection_with_early_remainder(pp, v, kfac,
+                                                              extra):
+    """Every real layer occupies exactly one stacked row (injectivity); with
+    interleave the layer count must divide pp*v, and uneven remainders go to
+    the EARLIEST stages (the reference's distribute_layers rule,
+    pipeline_parallel.py:33-36)."""
+    if v > 1:
+        L = pp * v * kfac
+    else:
+        L = pp + extra  # any L >= pp
+    K, counts, positions = pp_layer_layout(L, pp, v)
+    assert len(positions) == L
+    assert len(set(positions)) == L, "two layers share a stacked row"
+    assert all(0 <= p < K * pp for p in positions)
+    assert sum(counts) == L and len(counts) == pp
+    # remainder layers land on the earliest stages: counts non-increasing
+    assert all(a >= b for a, b in zip(counts, counts[1:]))
+    assert max(counts) <= K
+
+
+@settings(deadline=None, max_examples=40)
+@given(st.integers(1, 8), st.integers(1, 8))
+def test_zigzag_perm_roundtrip_and_ownership(n, h):
+    """zigzag_perm/inverse are true inverses, and contiguous shard r of the
+    permuted sequence owns exactly original chunks (r, 2n-1-r) — the
+    property chunk_positions encodes for the ring's causal masks."""
+    S = 2 * n * h
+    perm = zigzag_perm(S, n)
+    inv = zigzag_inverse_perm(S, n)
+    assert sorted(perm) == list(range(S))
+    np.testing.assert_array_equal(perm[inv], np.arange(S))
+    np.testing.assert_array_equal(inv[perm], np.arange(S))
+    s_local = S // n
+    for r in range(n):
+        shard = perm[r * s_local:(r + 1) * s_local]
+        np.testing.assert_array_equal(
+            shard, np.asarray(chunk_positions(r, s_local, n, True)))
+
+
+@settings(deadline=None, max_examples=40)
+@given(st.integers(1, 4), st.integers(1, 4), st.integers(1, 3),
+       st.integers(1, 3), st.integers(0, 2 ** 31 - 1))
+def test_gqa_expand_fold_are_transposes(hkv, g, s, d, seed):
+    """<expand(x), y> == <x, fold(y)> — fold is the exact transpose of
+    expand (what autodiff needs for the compact-GQA grads), and
+    fold(expand(x)) == g * x."""
+    import jax.numpy as jnp
+
+    from picotron_tpu.parallel.cp import _gqa_expand, _gqa_fold
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((1, s, hkv, d)).astype(np.float32)
+    y = rng.standard_normal((1, s, hkv * g, d)).astype(np.float32)
+    ex = np.asarray(_gqa_expand(jnp.asarray(x), g))
+    fy = np.asarray(_gqa_fold(jnp.asarray(y), g))
+    # fp32 sum reassociation between the two reductions; atol guards the
+    # near-zero dot products small random draws produce
+    np.testing.assert_allclose(np.sum(ex * y), np.sum(x * fy), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(_gqa_fold(jnp.asarray(ex), g)), g * x, rtol=1e-6)
