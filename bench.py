@@ -203,7 +203,7 @@ def _honor_cpu_env() -> None:
     honor_cpu_env_pin()
 
 
-def probe_tunnel(timeout: float = 120.0) -> str:
+def probe_tunnel(timeout: float = 90.0) -> str:
     """'tpu' | 'cpu' | 'dead': what a child process finds when it
     initializes the default JAX backend within `timeout`. On this site the
     chip sits behind a tunnel whose client blocks FOREVER inside backend
@@ -241,7 +241,8 @@ def orchestrate(script: str, metric: str, unit: str,
     total), so the 90 min default leaves attempt 1 room to FINISH — a
     budget that can kill a healthy run just converts a good number into a
     null artifact. A dead tunnel never gets near it: each probe fails in
-    <= 120 s and the backoffs cap at 300 s."""
+    <= 90 s, the backoffs cap at 180 s, and six consecutive probe
+    failures publish the null artifact at ~21 min."""
     start = time.time()
     diagnosis: list[str] = []
     attempt = 0
@@ -252,12 +253,16 @@ def orchestrate(script: str, metric: str, unit: str,
         if remaining < 240:
             diagnosis.append("wall-clock budget exhausted")
             break
-        backend = probe_tunnel(timeout=min(120.0, remaining))
+        # 90 s probe: a live tunnel initializes the backend in 10-35 s
+        # (round-3 measurements); a dead one hangs forever, so waiting
+        # longer only delays the verdict
+        backend = probe_tunnel(timeout=min(90.0, remaining))
         if backend == "dead":
             diagnosis.append(f"attempt {attempt}: tunnel probe hung/failed")
             if not probe_ok_ever and attempt >= 6:
-                # ~25+ min of consecutive probe failures: the tunnel is down
+                # ~20 min of consecutive probe failures: the tunnel is down
                 # for the count, not flapping — publish the diagnosis now
+                # (inside the window round 3 proved the driver waits)
                 # instead of sleeping out the rest of the budget
                 diagnosis.append("tunnel dead across all probes; giving up")
                 break
@@ -268,7 +273,7 @@ def orchestrate(script: str, metric: str, unit: str,
             print(f"# {diagnosis[-1]}; backing off", file=sys.stderr)
             # clamped so the null artifact is printed BEFORE a driver
             # enforcing max_total as a hard deadline would kill us
-            time.sleep(min(120.0 * attempt, 300.0, remaining - 200))
+            time.sleep(min(60.0 * attempt, 180.0, remaining - 200))
             continue
         probe_ok_ever = True
         # 'tpu': run the real bench. 'cpu' (a plain CPU box, no pin, no
