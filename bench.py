@@ -226,6 +226,45 @@ def probe_tunnel(timeout: float = 90.0) -> str:
         return "dead"
 
 
+def _run_inner(script: str, timeout: float):
+    """Run `script --inner` in its OWN session so a timeout kills the whole
+    process GROUP — the inner bench spawns a pytest preflight grandchild
+    (kernel_parity_preflight) that would otherwise survive as an orphan
+    holding the TPU/tunnel for every later step. Output goes to temp FILES,
+    not pipes: on this CPython, communicate()-after-timeout silently drops
+    the partial output (measured: both TimeoutExpired.stderr and the second
+    communicate() come back empty), and the timeout diagnosis is exactly
+    the clue the round artifact must carry. Returns a CompletedProcess on
+    exit, or the partial stderr/stdout string on timeout."""
+    import signal
+    import tempfile
+
+    # binary files + errors='replace': a SIGKILL mid-write can truncate a
+    # multibyte character, and a decode crash here would break the
+    # never-empty-artifact contract
+    with tempfile.TemporaryFile() as fo, tempfile.TemporaryFile() as fe:
+        p = subprocess.Popen([sys.executable, script, "--inner"],
+                             stdout=fo, stderr=fe,
+                             start_new_session=True)
+        timed_out = False
+        try:
+            p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+            p.wait()
+        fo.seek(0)
+        fe.seek(0)
+        out = fo.read().decode("utf-8", errors="replace")
+        err = fe.read().decode("utf-8", errors="replace")
+    if timed_out:
+        return err or out or ""
+    return subprocess.CompletedProcess(p.args, p.returncode, out, err)
+
+
 def orchestrate(script: str, metric: str, unit: str,
                 max_total: float = 5400.0) -> None:
     """Outer harness that makes a bench survive TPU-tunnel flaps.
@@ -284,15 +323,11 @@ def orchestrate(script: str, metric: str, unit: str,
         if remaining < 180:
             diagnosis.append("wall-clock budget exhausted after probe")
             break
-        try:
-            r = subprocess.run(
-                [sys.executable, script, "--inner"],
-                capture_output=True, text=True, timeout=remaining - 30)
-        except subprocess.TimeoutExpired as e:
-            out = (e.stderr or "") if isinstance(e.stderr, str) else ""
+        r = _run_inner(script, timeout=remaining - 30)
+        if isinstance(r, str):  # timed out; r = partial stderr
             diagnosis.append(
                 f"attempt {attempt}: inner bench timed out after "
-                f"{remaining - 30:.0f}s; stderr tail: {out[-300:]!r}")
+                f"{remaining - 30:.0f}s; stderr tail: {(r or '')[-300:]!r}")
             print(f"# {diagnosis[-1]}", file=sys.stderr)
             continue
         sys.stderr.write(r.stderr)  # A/B + config notes: keep in the record

@@ -15,6 +15,11 @@ reference's per-call prints without a host callback in the hot path.
 
 The env var is read at call time, so tests (and running jobs restarted with
 the flag) do not need an import-order dance.
+
+Caveat: collectives that autodiff DERIVES as transposes of traced ones
+(e.g. the reverse all-to-alls in the Ulysses backward) carry no trace call
+of their own — their forward counterpart's line stands for the pair, the
+same way the reference logs a send/recv pair once.
 """
 
 from __future__ import annotations

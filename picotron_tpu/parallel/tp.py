@@ -90,6 +90,7 @@ def all_gather_dim(x, axis: str, dim: int):
     """Tiled all-gather along array dimension ``dim`` over mesh axis ``axis``.
     Public building block shared by the SP collectives and the ZeRO-1 param
     all-gather (train_step)."""
+    _trace("all_gather", axis, x, extra=f"dim={dim}")
     return lax.all_gather(x, axis, axis=dim, tiled=True)
 
 

@@ -56,9 +56,10 @@ def run_step(name: str, cmd: list[str], out_dir: str, timeout: float,
             rc = -9
             f.write(f"\n[timed out after {timeout:.0f}s; process group "
                     f"killed]\n")
-    with open(log) as f:
+    with open(log, "rb") as f:
         f.seek(max(0, os.path.getsize(log) - 400))
-        tail = f.read().replace("\n", " ")
+        # binary + replace: a byte-offset seek can land mid-UTF-8-char
+        tail = f.read().decode("utf-8", errors="replace").replace("\n", " ")
     print(f"   -> rc={rc} log={log}\n   tail: {tail}", flush=True)
     return {"step": name, "rc": rc, "log": log}
 

@@ -157,8 +157,9 @@ def project(lc: Ladder) -> dict:
     bytes_grad = 2 if lc.grad_accum == "param" else BYTES_GRAD
     shard_params = m.n_params() / (lc.tp * lc.pp)
     if lc.zero1:
-        # reduce-scatter grads + all-gather updated params: each costs one
-        # ring pass — the same total wire bytes as the plain all-reduce
+        # reduce-scatter the accumulator-dtype grads + all-gather the bf16
+        # updated params: 6 B/param at fp32 accum vs the plain all-reduce's
+        # 2 x 4 = 8 — ZeRO-1 is cheaper on the wire, not just on memory
         t_dp_full = (ring_ag_or_rs(shard_params * bytes_grad, lc.dp)
                      + ring_ag_or_rs(shard_params * 2, lc.dp))
     else:

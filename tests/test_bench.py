@@ -162,10 +162,10 @@ def test_orchestrate_passes_through_inner_success(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
     monkeypatch.setattr(
-        bench.subprocess, "run",
-        lambda *a, **kw: sp.CompletedProcess(
-            a, 0, stdout='{"metric": "m", "value": 55.0}\n',
-            stderr="# flash_layout=bshd wins\n"))
+        bench, "_run_inner",
+        lambda script, timeout: sp.CompletedProcess(
+            script, 0, '{"metric": "m", "value": 55.0}\n',
+            "# flash_layout=bshd wins\n"))
     bench.orchestrate("/x/bench.py", metric="m", unit="%")
     out = capsys.readouterr()
     assert json.loads(out.out.strip()) == {"metric": "m", "value": 55.0}
@@ -185,8 +185,8 @@ def test_orchestrate_retries_inner_failure_then_succeeds(monkeypatch, capsys):
         sp.CompletedProcess((), 0, stdout='{"metric": "m", "value": 42.0}\n',
                             stderr=""),
     ]
-    monkeypatch.setattr(bench.subprocess, "run",
-                        lambda *a, **kw: outcomes.pop(0))
+    monkeypatch.setattr(bench, "_run_inner",
+                        lambda script, timeout: outcomes.pop(0))
     bench.orchestrate("/x/bench.py", metric="m", unit="%")
     assert json.loads(
         capsys.readouterr().out.strip()) == {"metric": "m", "value": 42.0}
@@ -206,13 +206,13 @@ def test_orchestrate_cpu_box_runs_inner_once(monkeypatch, capsys):
     monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "cpu")
     calls = []
 
-    def fake_run(*a, **kw):
-        calls.append(a)
+    def fake_run(script, timeout):
+        calls.append(script)
         return sp.CompletedProcess(
-            a, 0, stdout='{"metric": "tokens_per_sec_cpu_smoke", "value": 9.0}\n',
-            stderr="")
+            script, 0, '{"metric": "tokens_per_sec_cpu_smoke", "value": 9.0}\n',
+            "")
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_run_inner", fake_run)
     bench.orchestrate("/x/bench.py", metric="m", unit="%")
     assert len(calls) == 1
     assert json.loads(capsys.readouterr().out.strip())["value"] == 9.0
@@ -228,11 +228,11 @@ def test_orchestrate_cpu_box_failure_is_final(monkeypatch, capsys):
     monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "cpu")
     n = [0]
 
-    def fake_run(*a, **kw):
+    def fake_run(script, timeout):
         n[0] += 1
-        return sp.CompletedProcess(a, 1, stdout="", stderr="boom")
+        return sp.CompletedProcess(script, 1, "", "boom")
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_run_inner", fake_run)
     bench.orchestrate("/x/bench.py", metric="m", unit="%")
     assert n[0] == 1  # no pointless retries without an accelerator
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])

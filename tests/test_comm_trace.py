@@ -24,12 +24,19 @@ def _build_step(tiny_model_kwargs, **kw):
     jax.block_until_ready(step(params, opt_state, tokens, targets)[2])
 
 
+@pytest.mark.parametrize("sp", [False, True])
 def test_verbose_level1_traces_collectives(tiny_model_kwargs, monkeypatch,
-                                           capsys):
+                                           capsys, sp):
     monkeypatch.setenv("PICOTRON_VERBOSE", "1")
-    _build_step(tiny_model_kwargs, tp=2, pp=2, acc=2, engine="1f1b")
+    _build_step(tiny_model_kwargs, tp=2, pp=2, acc=2, engine="1f1b", sp=sp)
     err = capsys.readouterr().err
-    assert "[comm] tp_reduce.fwd all_reduce axis=tp" in err
+    if sp:
+        # SP: both halves of each collective pair are in the record
+        assert "[comm] all_gather axis=tp" in err
+        assert "[comm] reduce_scatter axis=tp" in err
+    else:
+        # plain TP: the Megatron f/g all-reduces
+        assert "[comm] tp_reduce.fwd all_reduce axis=tp" in err
     assert "[comm] pp.1f1b send_recv act down axis=pp" in err
     assert "[comm] pp.1f1b send_recv grad up axis=pp" in err
     assert "[comm] grad all_reduce(mean)" in err
