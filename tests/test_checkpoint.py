@@ -11,7 +11,6 @@ import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 
 from picotron_tpu import checkpoint as ckpt
 from picotron_tpu import train_step as ts
