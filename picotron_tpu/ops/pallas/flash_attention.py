@@ -11,12 +11,18 @@ Two data layouts share the same kernel bodies (``model.flash_layout``):
 - "folded" (default, battle-tested): the model's [B, S, H, D] is folded to
   [B*H, S, D] around the pallas_call; the grid walks (batch*head, q-block).
   The fold is a host-side transpose+reshape copy of every operand per call.
-- "bshd" (opt-in until A/B'd on hardware): the kernels consume [B, S, H, D]
-  directly — grid (batch, head, q-block), the head dimension squeezed out
-  by a size-None BlockSpec entry — so each kernel instance sees identical
-  [block, D] tiles with ZERO host-side transpose copies (the fold costs ~2
-  HBM round trips of q/k/v/out fwd and q/k/v/out/dout bwd that XLA cannot
-  fuse into the custom call).
+- "bshd" (interpret-mode only — REJECTED on hardware): the kernels consume
+  [B, S, H, D] directly — grid (batch, head, q-block), the head dimension
+  squeezed out by a size-None BlockSpec entry — avoiding the fold's
+  transpose copies. Measured on a v5e chip 2026-07-30
+  (docs/chip_runs/20260730T221221Z/kernel_parity.log): Mosaic refuses to
+  lower it — the last two block dims must be (8k, 128m) or span the whole
+  axis, and in [B, S, H, D] the head axis is second-to-last, so a
+  squeezed head block is structurally un-lowerable regardless of D. The
+  only hardware paths are (a) this folded layout or (b) for D % 128 == 0
+  geometries, a merged [B, S, H*D] view with the head index as a grid
+  axis selecting 128-aligned lane slices. "folded" stays the production
+  default; bshd remains as the interpret-mode record of the experiment.
 
 K/V for one head live whole in VMEM (S*D*2B ~ 1 MB at S=8192, D=64)
 while scores exist only as a [block_q, block_k] VMEM tile — the MXU sees

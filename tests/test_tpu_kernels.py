@@ -23,6 +23,20 @@ pytestmark = pytest.mark.skipif(
 B, S, H, D = 2, 1024, 4, 64
 SCALE = 0.125
 
+# Measured on a v5e chip 2026-07-30 (docs/chip_runs/20260730T221221Z):
+# Mosaic's lowering requires the last two block dims be (8k, 128m) or whole;
+# in [B, S, H, D] the head axis is second-to-last, so the bshd layout's
+# squeezed (size-1) head block can never lower on hardware — structural,
+# not a tolerance issue. The layout stays interpret-verified; production
+# keeps "folded". strict=False so a future Mosaic that lifts the
+# restriction doesn't turn this record into a bench-preflight failure.
+BSHD = pytest.param(
+    "bshd",
+    marks=pytest.mark.xfail(
+        reason="Mosaic rejects a squeezed head axis as the second-to-last "
+               "block dim (needs 8k/128m or whole-axis blocks)",
+        strict=False))
+
 
 def _qkv(dtype, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
@@ -30,7 +44,7 @@ def _qkv(dtype, seed=0):
                  for k in ks)
 
 
-@pytest.mark.parametrize("layout", ["folded", "bshd"])
+@pytest.mark.parametrize("layout", ["folded", BSHD])
 def test_flash_forward_matches_sdpa_on_tpu(layout):
     from picotron_tpu.ops.attention import sdpa
     from picotron_tpu.ops.pallas.flash_attention import flash_attention
@@ -44,7 +58,7 @@ def test_flash_forward_matches_sdpa_on_tpu(layout):
         rtol=2e-2, atol=2e-2)
 
 
-@pytest.mark.parametrize("layout", ["folded", "bshd"])
+@pytest.mark.parametrize("layout", ["folded", BSHD])
 def test_flash_grads_match_sdpa_on_tpu(layout):
     from picotron_tpu.ops.attention import sdpa
     from picotron_tpu.ops.pallas.flash_attention import flash_attention
