@@ -167,29 +167,35 @@ def run_descending(sizes, make_cfg, tag, **run_kw):
 
 
 def try_flash_layout_ab(cfg, tok_s_folded, **run_kw):
-    """One extra timed run of the winning config with the transpose-free
-    flash_layout='bshd' kernels. Any failure (Mosaic rejection, OOM, ...)
-    keeps the battle-tested folded layout — the A/B can only improve the
-    published number, never lose it. Returns (cfg, tokens_per_sec)."""
+    """One extra timed run of the winning config with a transpose-free
+    flash layout: 'merged' when the geometry allows it (head_dim % 128 ==
+    0, e.g. the 7B proxy's D=128), else 'bshd' — which Mosaic is known to
+    reject on hardware (docs/chip_runs/20260730T221221Z), kept so the
+    refusal stays in the bench record. Any failure keeps the battle-tested
+    folded layout — the A/B can only improve the published number, never
+    lose it. Returns (cfg, tokens_per_sec)."""
     import copy
     import gc
 
+    from picotron_tpu.ops.pallas.flash_attention import LANE
+
+    alt = "merged" if cfg.model.head_dim % LANE == 0 else "bshd"
     cfg2 = copy.deepcopy(cfg)
-    cfg2.model.flash_layout = "bshd"
+    cfg2.model.flash_layout = alt
     jax.clear_caches()
     gc.collect()
     try:
         tok_s = run(cfg2, **run_kw)
     except Exception as e:
-        print(f"# flash_layout=bshd failed; keeping folded "
+        print(f"# flash_layout={alt} failed; keeping folded "
               f"({str(e)[:160]})", file=sys.stderr)
         return cfg, tok_s_folded
     if tok_s > tok_s_folded:
-        print(f"# flash_layout=bshd wins: {tok_s:.0f} vs {tok_s_folded:.0f} "
+        print(f"# flash_layout={alt} wins: {tok_s:.0f} vs {tok_s_folded:.0f} "
               f"tok/s (+{100 * (tok_s / tok_s_folded - 1):.1f}%)",
               file=sys.stderr)
         return cfg2, tok_s
-    print(f"# flash_layout=bshd slower: {tok_s:.0f} vs {tok_s_folded:.0f} "
+    print(f"# flash_layout={alt} slower: {tok_s:.0f} vs {tok_s_folded:.0f} "
           f"tok/s; keeping folded", file=sys.stderr)
     return cfg, tok_s_folded
 

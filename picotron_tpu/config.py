@@ -328,9 +328,18 @@ class Config:
         if t.grad_accum_dtype not in ("float32", "param"):
             raise ValueError(
                 f"unknown grad_accum_dtype {t.grad_accum_dtype!r} (float32|param)")
-        if m.flash_layout not in ("folded", "bshd"):
+        if m.flash_layout not in ("folded", "bshd", "merged"):
             raise ValueError(
-                f"unknown flash_layout {m.flash_layout!r} (folded|bshd)")
+                f"unknown flash_layout {m.flash_layout!r} "
+                f"(folded|bshd|merged)")
+        if m.flash_layout == "merged":
+            from picotron_tpu.ops.pallas.flash_attention import LANE
+
+            if m.head_dim % LANE:
+                raise ValueError(
+                    f"flash_layout 'merged' needs head_dim % {LANE} == 0 "
+                    f"(Mosaic lane tiling); got head_dim={m.head_dim} — "
+                    f"use 'folded'")
         for name, b in (("flash_block_q", m.flash_block_q),
                         ("flash_block_k", m.flash_block_k)):
             # Powers of two keep the kernel's halve-until-divides fallback

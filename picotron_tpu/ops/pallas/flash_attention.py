@@ -6,7 +6,7 @@ Same asymptotics as FlashAttention-2: O(S) memory (never materializes the
 [S, S] score matrix in HBM), online softmax in fp32, log-sum-exp saved for
 the backward, which re-derives P per block.
 
-Two data layouts share the same kernel bodies (``model.flash_layout``):
+Three data layouts share the same kernel bodies (``model.flash_layout``):
 
 - "folded" (default, battle-tested): the model's [B, S, H, D] is folded to
   [B*H, S, D] around the pallas_call; the grid walks (batch*head, q-block).
@@ -19,10 +19,14 @@ Two data layouts share the same kernel bodies (``model.flash_layout``):
   lower it — the last two block dims must be (8k, 128m) or span the whole
   axis, and in [B, S, H, D] the head axis is second-to-last, so a
   squeezed head block is structurally un-lowerable regardless of D. The
-  only hardware paths are (a) this folded layout or (b) for D % 128 == 0
-  geometries, a merged [B, S, H*D] view with the head index as a grid
-  axis selecting 128-aligned lane slices. "folded" stays the production
-  default; bshd remains as the interpret-mode record of the experiment.
+  only hardware paths are (a) this folded layout or (b) the "merged"
+  layout below. "folded" stays the production default; bshd remains as
+  the interpret-mode record of the experiment.
+- "merged" (head_dim % 128 == 0 geometries, e.g. Llama-2-7B's D=128): the
+  [B, S, H, D] operands are viewed as [B, S, H*D] — a free reshape, minor
+  dims merge — and the head grid axis selects a D-wide LANE-aligned slice
+  of the last dim, which Mosaic accepts. Same zero-transpose-copy win the
+  bshd experiment wanted, within the tiling rules.
 
 K/V for one head live whole in VMEM (S*D*2B ~ 1 MB at S=8192, D=64)
 while scores exist only as a [block_q, block_k] VMEM tile — the MXU sees
@@ -121,7 +125,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
 
 def _fwd(q, k, v, scale, causal, block_q, block_k, layout="folded"):
     """folded: q [BH,Sq,D] -> (out [BH,Sq,D], lse [BH,Sq,LANE]).
-    bshd: q [B,Sq,H,D] -> (out [B,Sq,H,D], lse [B,Sq,H,LANE]).
+    bshd/merged: q [B,Sq,H,D] -> (out [B,Sq,H,D], lse [B,Sq,H,LANE]).
     LSE is the broadcast-lane fp32 layout. Sq and Sk may differ
     (ring-attention half blocks); causal requires Sq == Sk (aligned
     positions)."""
@@ -130,7 +134,33 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, layout="folded"):
     assert not causal or sq == sk
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
-    if layout == "folded":
+    post = lambda out, lse: (out, lse)
+    if layout == "merged":
+        # [B, S, H, D] viewed as [B, S, H*D] (free: minor dims merge), the
+        # head index a grid axis selecting a D-wide lane slice — needs
+        # D % 128 == 0 to satisfy Mosaic's lane tiling, and in exchange the
+        # kernels consume the model layout with ZERO transpose copies.
+        b, h = q.shape[0], q.shape[2]
+        q, k, v = (x.reshape(x.shape[0], x.shape[1], h * d)
+                   for x in (q, k, v))
+        grid = (b, h, sq // bq)
+        blk_axis = 2
+        in_specs = [
+            pl.BlockSpec((1, bq, d), lambda b_, hh, i: (b_, i, hh)),
+            pl.BlockSpec((1, sk, d), lambda b_, hh, i: (b_, 0, hh)),
+            pl.BlockSpec((1, sk, d), lambda b_, hh, i: (b_, 0, hh)),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, bq, d), lambda b_, hh, i: (b_, i, hh)),
+            pl.BlockSpec((1, bq, LANE), lambda b_, hh, i: (b_, i, hh)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((b, sq, h * d), q.dtype),
+            jax.ShapeDtypeStruct((b, sq, h * LANE), jnp.float32),
+        ]
+        post = lambda out, lse: (out.reshape(b, sq, h, d),
+                                 lse.reshape(b, sq, h, LANE))
+    elif layout == "folded":
         bh = q.shape[0]
         grid = (bh, sq // bq)
         blk_axis = 1
@@ -170,7 +200,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, layout="folded"):
         grid=grid, in_specs=in_specs, out_specs=out_specs,
         out_shape=out_shape,
     )(q, k, v)
-    return out, lse
+    return post(out, lse)
 
 
 # --------------------------------------------------------------------------- #
@@ -279,6 +309,28 @@ def _bwd(scale, causal, block_q, block_k, layout, res, dout):
         dq_shape = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
         dkv_shape = [jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
                      jax.ShapeDtypeStruct((bh, sk, d), v.dtype)]
+    elif layout == "merged":
+        b, h = q.shape[0], q.shape[2]
+        hd = h * d
+        q, out, dout = (x.reshape(b, sq, hd) for x in (q, out, dout))
+        k, v = (x.reshape(b, sk, hd) for x in (k, v))
+        lse = lse.reshape(b, sq, h * LANE)
+        dq_grid, dkv_grid, blk_axis = (b, h, sq // bq), (b, h, sk // bk), 2
+
+        def spec(n, lane=False):
+            w = LANE if lane else d
+            if n is None:
+                return pl.BlockSpec((1, sq, w), lambda b_, hh, i: (b_, 0, hh))
+            return pl.BlockSpec((1, n, w), lambda b_, hh, i: (b_, i, hh))
+
+        def kspec(n):
+            if n is None:
+                return pl.BlockSpec((1, sk, d), lambda b_, hh, i: (b_, 0, hh))
+            return pl.BlockSpec((1, n, d), lambda b_, hh, i: (b_, i, hh))
+
+        dq_shape = jax.ShapeDtypeStruct((b, sq, hd), q.dtype)
+        dkv_shape = [jax.ShapeDtypeStruct((b, sk, hd), k.dtype),
+                     jax.ShapeDtypeStruct((b, sk, hd), v.dtype)]
     else:
         b, h = q.shape[0], q.shape[2]
         dq_grid, dkv_grid, blk_axis = (b, h, sq // bq), (b, h, sk // bk), 2
@@ -322,6 +374,11 @@ def _bwd(scale, causal, block_q, block_k, layout, res, dout):
         grid=dkv_grid, in_specs=dkv_in, out_specs=dkv_out,
         out_shape=dkv_shape,
     )(q, k, v, out, dout, lse)
+    if layout == "merged":  # back to the [B, S, H, D] primal shape (free)
+        b, h = dq.shape[0], dq.shape[-1] // d
+        dq = dq.reshape(b, sq, h, d)
+        dk = dk.reshape(b, sk, h, d)
+        dv = dv.reshape(b, sk, h, d)
     return dq, dk, dv
 
 
@@ -330,9 +387,14 @@ def _bwd(scale, causal, block_q, block_k, layout, res, dout):
 # --------------------------------------------------------------------------- #
 
 
-def _check_layout(layout: str) -> None:
-    if layout not in ("folded", "bshd"):
-        raise ValueError(f"unknown flash layout {layout!r} (folded|bshd)")
+def _check_layout(layout: str, d: int | None = None) -> None:
+    if layout not in ("folded", "bshd", "merged"):
+        raise ValueError(
+            f"unknown flash layout {layout!r} (folded|bshd|merged)")
+    if layout == "merged" and d is not None and d % LANE:
+        raise ValueError(
+            f"flash layout 'merged' needs head_dim % {LANE} == 0 (the head "
+            f"slice must be a whole lane tile); got head_dim={d}")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -359,18 +421,19 @@ def flash_attention(q, k, v, scale: float | None = None, causal: bool = True,
                     block_k: int | None = None,
                     layout: str = "folded"):
     """q, k, v: [B, S, H, D] with equal head counts. Returns [B, S, H, D].
-    layout="bshd" runs the kernels on the model layout directly (no fold
-    copies); "folded" is the default until the bshd variant is A/B'd on
-    hardware."""
-    _check_layout(layout)
+    layout="merged" (head_dim % 128 == 0 only) and layout="bshd"
+    (interpret-mode only; Mosaic rejects it on hardware) run the kernels on
+    the model layout directly with no fold copies; "folded" is the
+    always-available default."""
     b, s, h, d = q.shape
+    _check_layout(layout, d)
     block_q = block_q or DEFAULT_BLOCK_Q
     block_k = block_k or DEFAULT_BLOCK_K
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    if layout == "bshd":
+    if layout in ("bshd", "merged"):
         return _flash_core(q, k, v, float(scale), causal, block_q, block_k,
-                           "bshd")
+                           layout)
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     out = _flash_core(fold(q), fold(k), fold(v), float(scale), causal,
                       block_q, block_k, "folded")
@@ -388,12 +451,12 @@ def flash_block_grads(q, k, v, out, lse, dout, scale: float,
     reference context_parallel.py:112-155). q/out/dout are [B, Sq, H, D],
     k/v are [B, Sk, H, D] (Sq != Sk allowed for ring half-blocks, non-causal
     only); lse is [B, Sq, H] fp32. Returns (dq, dk, dv)."""
-    _check_layout(layout)
     b, sq, h, d = q.shape
+    _check_layout(layout, d)
     block_q = block_q or DEFAULT_BLOCK_Q
     block_k = block_k or DEFAULT_BLOCK_K
-    if layout == "bshd":
-        return _bwd(scale, causal, block_q, block_k, "bshd",
+    if layout in ("bshd", "merged"):
+        return _bwd(scale, causal, block_q, block_k, layout,
                     (q, k, v, out, lse), dout)
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     lse_c = lse.transpose(0, 2, 1).reshape(b * h, sq)
@@ -412,15 +475,15 @@ def flash_attention_with_lse(q, k, v, scale: float | None = None,
     """Forward-only variant returning (out [B,Sq,H,D], lse [B,Sq,H]) — the
     building block for ring attention's LSE merge. Sq != Sk allowed
     (non-causal only)."""
-    _check_layout(layout)
     b, s, h, d = q.shape
+    _check_layout(layout, d)
     block_q = block_q or DEFAULT_BLOCK_Q
     block_k = block_k or DEFAULT_BLOCK_K
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    if layout == "bshd":
+    if layout in ("bshd", "merged"):
         out, lse = _fwd(q, k, v, float(scale), causal, block_q, block_k,
-                        "bshd")
+                        layout)
         return out, lse[..., 0]
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     out, lse = _fwd(fold(q), fold(k), fold(v), float(scale), causal,

@@ -122,6 +122,25 @@ def test_flash_layout_ab_slower_keeps_folded(monkeypatch):
     assert tok_s == 100.0 and cfg is base
 
 
+def test_flash_layout_ab_picks_merged_for_lane_aligned_heads(monkeypatch):
+    """head_dim % 128 == 0 (the 7B geometry) must A/B the hardware-lowerable
+    'merged' layout, not the Mosaic-rejected 'bshd'."""
+    import bench
+
+    tried = []
+
+    def fake_run(c, **kw):
+        tried.append(c.model.flash_layout)
+        return 200.0
+
+    monkeypatch.setattr(bench, "run", fake_run)
+    base = _tiny_cfg()
+    base.model.hidden_size = 512  # 4 heads -> head_dim 128
+    cfg, tok_s = bench.try_flash_layout_ab(base, 100.0)
+    assert tried == ["merged"]
+    assert tok_s == 200.0 and cfg.model.flash_layout == "merged"
+
+
 def _fake_clock(monkeypatch):
     """Patch bench's time.time/time.sleep with a virtual clock so the
     orchestrator's backoffs run instantly in tests."""

@@ -27,10 +27,14 @@ def _qkv(b=2, s=256, h=2, d=64, seed=0):
     return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
 
 
-@pytest.mark.parametrize("layout", ["folded", "bshd"])
+# merged requires head_dim % 128 == 0, so its cases run at d=128
+LAYOUT_D = [("folded", 64), ("bshd", 64), ("merged", 128)]
+
+
+@pytest.mark.parametrize("layout,d", LAYOUT_D)
 @pytest.mark.parametrize("causal", [True, False])
-def test_flash_forward_matches_sdpa(causal, layout):
-    q, k, v = _qkv()
+def test_flash_forward_matches_sdpa(causal, layout, d):
+    q, k, v = _qkv(d=d)
     scale = 0.125
     with pltpu.force_tpu_interpret_mode():
         got = flash_attention(q, k, v, scale, causal=causal, block_q=128,
@@ -40,11 +44,11 @@ def test_flash_forward_matches_sdpa(causal, layout):
                                rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("layout", ["folded", "bshd"])
-def test_flash_lse_matches_block_attention(layout):
+@pytest.mark.parametrize("layout,d", LAYOUT_D)
+def test_flash_lse_matches_block_attention(layout, d):
     from picotron_tpu.ops.attention import _causal_mask, block_attention
 
-    q, k, v = _qkv(s=128)
+    q, k, v = _qkv(s=128, d=d)
     scale = 0.125
     with pltpu.force_tpu_interpret_mode():
         out, lse = flash_attention_with_lse(q, k, v, scale, causal=True,
@@ -58,9 +62,9 @@ def test_flash_lse_matches_block_attention(layout):
                                rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("layout", ["folded", "bshd"])
-def test_flash_grads_match_sdpa(layout):
-    q, k, v = _qkv(s=128)
+@pytest.mark.parametrize("layout,d", LAYOUT_D)
+def test_flash_grads_match_sdpa(layout, d):
+    q, k, v = _qkv(s=128, d=d)
     scale = 0.125
 
     def loss_flash(q, k, v):
@@ -108,6 +112,42 @@ def test_rmsnorm_grads_match_reference():
     rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
     np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=5e-5, atol=5e-5)
     np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=5e-5, atol=5e-5)
+
+
+def test_merged_block_grads_match_einsum():
+    """The ring-attention building block in the merged layout: block
+    backward fed an external out/lse must match AD through the einsum
+    block (full-attend block, the ring's off-diagonal case)."""
+    from picotron_tpu.ops.attention import block_attention
+    from picotron_tpu.ops.pallas.flash_attention import (
+        flash_attention_with_lse, flash_block_grads)
+
+    q, k, v = _qkv(s=128, d=128, seed=7)
+    scale = 0.125
+    with pltpu.force_tpu_interpret_mode():
+        out, lse = flash_attention_with_lse(q, k, v, scale, causal=False,
+                                            block_q=64, block_k=64,
+                                            layout="merged")
+    do = jax.random.normal(jax.random.PRNGKey(8), out.shape)
+    with pltpu.force_tpu_interpret_mode():
+        dq, dk, dv = flash_block_grads(q, k, v, out, lse, do, scale,
+                                       causal=False, block_q=64, block_k=64,
+                                       layout="merged")
+
+    def ref_f(q, k, v):
+        o, _ = block_attention(q, k, v, scale, mask=None)
+        return jnp.sum(o * do)
+
+    rq, rk, rv = jax.grad(ref_f, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip((dq, dk, dv), (rq, rk, rv), "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5, err_msg=f"d{name}")
+
+
+def test_merged_layout_rejects_unaligned_head_dim():
+    q, k, v = _qkv(d=64)
+    with pytest.raises(ValueError, match="head_dim % 128"):
+        flash_attention(q, k, v, 0.125, layout="merged")
 
 
 def test_flash_blocks_configurable_through_model(tiny_model_kwargs):

@@ -31,25 +31,29 @@ SCALE = 0.125
 # keeps "folded". strict=False so a future Mosaic that lifts the
 # restriction doesn't turn this record into a bench-preflight failure.
 BSHD = pytest.param(
-    "bshd",
+    "bshd", 64,
     marks=pytest.mark.xfail(
         reason="Mosaic rejects a squeezed head axis as the second-to-last "
                "block dim (needs 8k/128m or whole-axis blocks)",
         strict=False))
 
+# merged requires head_dim % 128 == 0 (Llama-2-7B geometry), so it runs
+# at D=128; folded covers the D=64 SmolLM geometry
+LAYOUT_D = [("folded", 64), BSHD, ("merged", 128)]
 
-def _qkv(dtype, seed=0):
+
+def _qkv(dtype, seed=0, d=D):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
-    return tuple(jax.random.normal(k, (B, S, H, D), jnp.float32).astype(dtype)
+    return tuple(jax.random.normal(k, (B, S, H, d), jnp.float32).astype(dtype)
                  for k in ks)
 
 
-@pytest.mark.parametrize("layout", ["folded", BSHD])
-def test_flash_forward_matches_sdpa_on_tpu(layout):
+@pytest.mark.parametrize("layout,d", LAYOUT_D)
+def test_flash_forward_matches_sdpa_on_tpu(layout, d):
     from picotron_tpu.ops.attention import sdpa
     from picotron_tpu.ops.pallas.flash_attention import flash_attention
 
-    q, k, v = _qkv(jnp.bfloat16)
+    q, k, v = _qkv(jnp.bfloat16, d=d)
     out = jax.jit(lambda q, k, v: flash_attention(
         q, k, v, SCALE, layout=layout))(q, k, v)
     ref = jax.jit(lambda q, k, v: sdpa(q, k, v, SCALE, causal=True))(q, k, v)
@@ -58,12 +62,12 @@ def test_flash_forward_matches_sdpa_on_tpu(layout):
         rtol=2e-2, atol=2e-2)
 
 
-@pytest.mark.parametrize("layout", ["folded", BSHD])
-def test_flash_grads_match_sdpa_on_tpu(layout):
+@pytest.mark.parametrize("layout,d", LAYOUT_D)
+def test_flash_grads_match_sdpa_on_tpu(layout, d):
     from picotron_tpu.ops.attention import sdpa
     from picotron_tpu.ops.pallas.flash_attention import flash_attention
 
-    q, k, v = _qkv(jnp.bfloat16, seed=1)
+    q, k, v = _qkv(jnp.bfloat16, seed=1, d=d)
 
     def loss(attn):
         def f(q, k, v):
