@@ -1,0 +1,193 @@
+"""Summarize a jax.profiler trace: where does the step time go?
+
+The reference has no profiler at all (SURVEY.md §5.1); this closes the
+round-3 VERDICT's "profiler-driven MFU pass" loop on top of train.py's
+trace window (logging.profile_start/stop). It reads the XPlane protobuf
+that jax.profiler.start_trace writes under
+``<dir>/plugins/profile/<run>/*.xplane.pb`` and prints a cost breakdown
+by HLO category and by individual op, so the top HBM/compute consumer of
+the winning bench config is a committed number instead of a guess.
+
+Usage:
+    python -m picotron_tpu.tools.analyze_trace <profile_dir> [--top N]
+
+``<profile_dir>`` may be the directory passed to start_trace, the
+``plugins/profile/<run>`` dir, or a direct ``*.xplane.pb`` path. Output is
+a human-readable table plus one machine-readable JSON line (categories in
+percent of device-active time) for docs/scripts to capture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def find_xplane(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                            recursive=True))
+    if not hits:
+        raise FileNotFoundError(f"no *.xplane.pb under {path!r} — did the "
+                                f"profiler window run?")
+    return hits[-1]  # newest run sorts last (timestamped dirs)
+
+
+def load_xspace(path: str):
+    # tensorflow is in the image for its tsl protobufs only; defer the
+    # (slow, noisy) import so --help and error paths stay instant
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xspace = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xspace.ParseFromString(f.read())
+    return xspace
+
+
+def device_planes(xspace):
+    """TPU device planes if present, else the busiest non-host plane, else
+    the host plane (CPU-only traces, used by the self-test)."""
+    tpu = [p for p in xspace.planes if "/device:TPU" in p.name
+           and "SparseCore" not in p.name]
+    if tpu:
+        return tpu
+
+    def busiest(planes):
+        pool = sorted(planes,
+                      key=lambda p: sum(len(l.events) for l in p.lines))
+        return pool[-1:] if pool and any(
+            len(l.events) for l in pool[-1].lines) else []
+
+    return (busiest([p for p in xspace.planes
+                     if not p.name.startswith("/host")])
+            or busiest(xspace.planes))
+
+
+CATEGORY_RULES = (
+    # (category, name substrings) — first match wins; names are lowercased.
+    # tpu_custom_call is how Mosaic/Pallas kernels appear in XLA traces.
+    ("pallas kernel", ("tpu_custom_call", "custom-call", "mosaic")),
+    ("matmul", ("dot", "convolution", "einsum")),
+    ("collective", ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all", "psum")),
+    ("copy/transpose", ("copy", "transpose", "bitcast", "reshape")),
+    ("host transfer", ("infeed", "outfeed", "send", "recv",
+                       "host")),
+    ("scatter/gather", ("scatter", "gather", "dynamic-slice",
+                        "dynamic-update-slice")),
+    ("elementwise/fusion", ("fusion", "loop", "add", "multiply", "select",
+                            "exponential", "divide", "subtract", "rsqrt",
+                            "maximum", "reduce", "broadcast", "iota",
+                            "compare", "convert", "tanh", "log")),
+)
+
+
+def classify(name: str, hlo_category: str) -> str:
+    """Prefer the profiler's own hlo category stat, fall back to name
+    heuristics. Either way normalize into the coarse buckets above."""
+    for probe in (hlo_category.lower(), name.lower()):
+        if not probe:
+            continue
+        for cat, keys in CATEGORY_RULES:
+            if any(k in probe for k in keys):
+                return cat
+    return "other"
+
+
+def summarize(xspace, top: int = 15):
+    """Aggregate per-op self time on device planes. Returns a dict with
+    total_ms, per-category ms and the top ops."""
+    op_ps: dict[str, int] = defaultdict(int)
+    op_cat: dict[str, str] = {}
+    plane_names = []
+    t_min = t_max = None
+    for plane in device_planes(xspace):
+        plane_names.append(plane.name)
+        stat_names = {i: m.name for i, m in plane.stat_metadata.items()}
+        for line in plane.lines:
+            lname = line.name.lower()
+            # op-level lines only; step/module/scope lines double-count.
+            # TPU planes call it "XLA Ops"; CPU traces (self-test path) put
+            # op events on the PjRt client line.
+            if not ("xla ops" in lname or lname == "ops"
+                    or lname.startswith("tf_xlapjrt")):
+                continue
+            # XLine offsets are relative to the line's own start timestamp
+            line_t0_ps = line.timestamp_ns * 1000
+            for ev in line.events:
+                md = plane.event_metadata.get(ev.metadata_id)
+                name = md.name if md else f"op_{ev.metadata_id}"
+                if name.startswith("end: ") or "::" in name:
+                    continue  # CPU client region end/listener markers
+                cat = ""
+                for st in ev.stats:
+                    if stat_names.get(st.metadata_id) == "hlo_category":
+                        # the oneof fields live directly on XStat; a
+                        # ref_value indexes the stat_metadata name table
+                        cat = (st.str_value
+                               or stat_names.get(st.ref_value, ""))
+                op_ps[name] += ev.duration_ps
+                if name not in op_cat:
+                    op_cat[name] = classify(name, cat)
+                start = line_t0_ps + ev.offset_ps
+                end = start + ev.duration_ps
+                t_min = start if t_min is None else min(t_min, start)
+                t_max = end if t_max is None else max(t_max, end)
+    span_ps = (t_max - t_min) if t_min is not None else 0
+    total_ps = sum(op_ps.values())
+    cat_ps: dict[str, int] = defaultdict(int)
+    for name, ps in op_ps.items():
+        cat_ps[op_cat[name]] += ps
+    top_ops = sorted(op_ps.items(), key=lambda kv: -kv[1])[:top]
+    return {
+        "planes": plane_names,
+        "total_ms": total_ps / 1e9,
+        "span_ms": span_ps / 1e9,
+        "categories_ms": {c: ps / 1e9 for c, ps in
+                          sorted(cat_ps.items(), key=lambda kv: -kv[1])},
+        "top_ops": [(n, ps / 1e9, op_cat[n]) for n, ps in top_ops],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("profile_dir")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args(argv)
+
+    path = find_xplane(args.profile_dir)
+    s = summarize(load_xspace(path), top=args.top)
+    total = s["total_ms"]
+    if total == 0:
+        print(f"no device op events found in {path}", file=sys.stderr)
+        return 1
+
+    print(f"trace: {path}")
+    print(f"planes: {', '.join(s['planes'])}")
+    print(f"device-active op time: {total:.2f} ms over a {s['span_ms']:.2f} "
+          f"ms span (gaps = host/dispatch idle)")
+    print("\nby category (% of device-active time):")
+    for cat, ms in s["categories_ms"].items():
+        print(f"  {cat:<20} {ms:9.2f} ms  {100 * ms / total:5.1f}%")
+    print(f"\ntop {args.top} ops:")
+    for name, ms, cat in s["top_ops"]:
+        print(f"  {ms:9.2f} ms  {100 * ms / total:5.1f}%  [{cat}] {name}")
+    print()
+    print(json.dumps({
+        "trace": path,
+        "active_ms": round(total, 3),
+        "span_ms": round(s["span_ms"], 3),
+        "categories_pct": {c: round(100 * ms / total, 2)
+                           for c, ms in s["categories_ms"].items()},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

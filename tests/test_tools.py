@@ -326,3 +326,41 @@ def test_chip_agenda_run_step(tmp_path):
     to = run_step("to", [sys.executable, "-c", "import time; time.sleep(9)"],
                   str(tmp_path), timeout=1)
     assert to["rc"] == -9 and "timed out" in open(to["log"]).read()
+
+
+# ------------------------------------------------------------- analyze_trace
+
+
+def test_analyze_trace_summarizes_a_real_capture(tmp_path, capsys):
+    """Generate a real jax.profiler capture (CPU backend) and check the
+    analyzer finds the op events and attributes the matmul-dominated cost
+    correctly — the same code path the chip agenda's profile step feeds."""
+    import jax
+    import jax.numpy as jnp
+
+    from picotron_tpu.tools import analyze_trace as at
+
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda a: jnp.tanh(a @ a) @ a)
+    jax.block_until_ready(f(x))  # compile outside the window
+    jax.profiler.start_trace(str(tmp_path))
+    for _ in range(3):
+        jax.block_until_ready(f(x))
+    jax.profiler.stop_trace()
+
+    rc = at.main([str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    line = [l for l in out.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["active_ms"] > 0
+    assert "matmul" in rec["categories_pct"]
+    # two dot_generals vs one tanh: matmuls must dominate
+    assert rec["categories_pct"]["matmul"] > 50
+
+
+def test_analyze_trace_missing_dir_is_a_clear_error(tmp_path):
+    from picotron_tpu.tools import analyze_trace as at
+
+    with pytest.raises(FileNotFoundError, match="xplane"):
+        at.find_xplane(str(tmp_path))
