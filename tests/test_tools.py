@@ -364,3 +364,20 @@ def test_analyze_trace_missing_dir_is_a_clear_error(tmp_path):
 
     with pytest.raises(FileNotFoundError, match="xplane"):
         at.find_xplane(str(tmp_path))
+
+
+def test_measure_cond_gating_small(capsys):
+    """The cond-gating micro-bench (VERDICT r3 weak #3) runs end-to-end on
+    the CPU mesh and reports every field the round record needs. The
+    TPU-magnitude claim itself (gated-false ~ free) is only checkable on
+    hardware — chip_agenda runs the full-size version there."""
+    from picotron_tpu.tools import measure_cond_gating as mcg
+
+    rc = mcg.main(["--small"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    for k in ("loss_owner_ms", "loss_gated_other_ms",
+              "loss_maskedboth_other_ms", "embed_owner_ms",
+              "embed_gated_other_ms", "embed_maskedboth_other_ms"):
+        assert rec[k] > 0
