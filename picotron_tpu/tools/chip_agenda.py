@@ -17,11 +17,15 @@ into committed evidence:
 Each step gets its own timeout and log file; a step failing (tunnel dying
 mid-window) does not stop the later ones from being attempted. Run:
 
-    python -m picotron_tpu.tools.chip_agenda [out_dir]
+    python -m picotron_tpu.tools.chip_agenda [out_dir] [--only a,b,...]
+
+``--only`` reruns a subset — tunnel_watch uses it so a second window only
+repeats the steps the first window lost to a flap.
 """
 
 from __future__ import annotations
 
+import argparse
 import datetime
 import json
 import os
@@ -29,6 +33,39 @@ import subprocess
 import sys
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# The agenda, in priority order. tunnel_watch imports this so its step set
+# and worst-case budget stay in lockstep with the agenda's.
+STEP_TIMEOUTS = {
+    "kernel_parity": 1500,
+    "bench": 5700,
+    "bench_7b": 5700,
+    "profile": 1800,
+    "cond_gating": 1500,
+}
+
+
+# Process group of the step currently executing, for the SIGTERM handler:
+# each step runs in its OWN session (so a step timeout can kill the step's
+# whole tree), which means anyone killing the *agenda* would orphan the
+# in-flight step — and an orphan holds the TPU for the rest of the window.
+# tunnel_watch SIGTERMs the agenda on its global cap; the handler forwards
+# a SIGKILL to the live step's group before dying.
+_current_pgid: int | None = None
+
+
+def _install_term_handler() -> None:
+    import signal
+
+    def _handler(signum, frame):
+        if _current_pgid is not None:
+            try:
+                os.killpg(_current_pgid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _handler)
 
 
 def run_step(name: str, cmd: list[str], out_dir: str, timeout: float,
@@ -41,12 +78,28 @@ def run_step(name: str, cmd: list[str], out_dir: str, timeout: float,
     the TPU for every later step."""
     import signal
 
+    global _current_pgid
     log = os.path.join(out_dir, f"{name}.log")
     print(f"== {name}: {' '.join(cmd)} (timeout {timeout:.0f}s)", flush=True)
+    pgid_file = os.path.join(out_dir, "current_step.pgid")
+    # PYTHONUNBUFFERED for EVERY step: stdout goes to a file (block-
+    # buffered), and a wedged step gets SIGKILLed by its timeout — without
+    # write-through the log would be 0 bytes with no clue what hung
+    step_env = dict(env or os.environ, PYTHONUNBUFFERED="1")
     with open(log, "w") as f:
-        p = subprocess.Popen(cmd, cwd=REPO, env=env or dict(os.environ),
+        p = subprocess.Popen(cmd, cwd=REPO, env=step_env,
                              stdout=f, stderr=subprocess.STDOUT,
                              start_new_session=True)
+        try:
+            _current_pgid = os.getpgid(p.pid)
+        except ProcessLookupError:
+            _current_pgid = None
+        # last-resort breadcrumb: if BOTH the agenda and its SIGTERM
+        # handler are killed outright, the watcher reads this file and
+        # killpgs the step itself (the step's own session survives a kill
+        # of the agenda's group)
+        with open(pgid_file, "w") as pf:
+            pf.write(str(_current_pgid or ""))
         try:
             rc = p.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
@@ -58,6 +111,12 @@ def run_step(name: str, cmd: list[str], out_dir: str, timeout: float,
             rc = -9
             f.write(f"\n[timed out after {timeout:.0f}s; process group "
                     f"killed]\n")
+        finally:
+            _current_pgid = None
+            try:
+                os.remove(pgid_file)
+            except OSError:
+                pass
     with open(log, "rb") as f:
         f.seek(max(0, os.path.getsize(log) - 400))
         # binary + replace: a byte-offset seek can land mid-UTF-8-char
@@ -67,58 +126,83 @@ def run_step(name: str, cmd: list[str], out_dir: str, timeout: float,
 
 
 def main():
+    _install_term_handler()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir", nargs="?", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated step names to run (default: all)")
+    args = ap.parse_args()
+
     stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y%m%dT%H%M%SZ")
-    out_dir = (sys.argv[1] if len(sys.argv) > 1
-               else os.path.join(REPO, "docs", "chip_runs", stamp))
+    out_dir = args.out_dir or os.path.join(REPO, "docs", "chip_runs", stamp)
     os.makedirs(out_dir, exist_ok=True)
-    results = []
 
-    env = dict(os.environ, PICOTRON_TEST_TPU="1")
-    results.append(run_step(
-        "kernel_parity",
-        [sys.executable, "-m", "pytest", "-q", "tests/test_tpu_kernels.py"],
-        out_dir, timeout=1500, env=env))
+    def profile_cfg_path():
+        # profiler trace of the winning single-chip config: short real
+        # training run with the profiler window over steps [4, 6)
+        from picotron_tpu.config import SMOLLM_1_7B  # plain dict, no jax
 
-    # the benches carry their own orchestrator (probe/retry/null-artifact)
-    results.append(run_step(
-        "bench", [sys.executable, "bench.py"], out_dir, timeout=5700))
-    results.append(run_step(
-        "bench_7b", [sys.executable, "bench_7b.py"], out_dir, timeout=5700))
+        cfg = {
+            "distributed": {"dp_size": 1, "pp_size": 1, "cp_size": 1,
+                            "tp_size": 1},
+            "model": dict(SMOLLM_1_7B),
+            "training": {"seq_length": 2048, "micro_batch_size": 2,
+                         "gradient_accumulation_steps": 1,
+                         "remat": "save_attn", "learning_rate": 3e-4,
+                         "total_train_steps": 6, "steps_per_call": 1},
+            "dataset": {"name": "synthetic"},
+            "logging": {"profile_start": 4, "profile_stop": 6,
+                        "profile_dir": os.path.join(out_dir, "profile")},
+        }
+        path = os.path.join(out_dir, "profile_cfg.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f, indent=2)
+        return path
 
-    # profiler trace of the winning single-chip config: short real training
-    # run with the profiler window over steps [4, 6)
-    prof_dir = os.path.join(out_dir, "profile")
-    from picotron_tpu.config import SMOLLM_1_7B  # plain dict, no jax import
-
-    cfg = {
-        "distributed": {"dp_size": 1, "pp_size": 1, "cp_size": 1,
-                        "tp_size": 1},
-        "model": dict(SMOLLM_1_7B),
-        "training": {"seq_length": 2048, "micro_batch_size": 2,
-                     "gradient_accumulation_steps": 1, "remat": "save_attn",
-                     "learning_rate": 3e-4, "total_train_steps": 6,
-                     "steps_per_call": 1},
-        "dataset": {"name": "synthetic"},
-        "logging": {"profile_start": 4, "profile_stop": 6,
-                    "profile_dir": prof_dir},
+    # name -> cmd-thunk; thunks so profile_cfg.json is only written when
+    # its step is selected. The benches carry their own orchestrator
+    # (probe/retry/null-artifact). cond_gating measures the on-hardware
+    # cost of lax.cond stage gating (round-3 VERDICT weak #3).
+    # -v: the log must show which test is in flight — a wedged remote
+    # compile otherwise leaves no way to tell WHAT hung (the
+    # 20260731T0103 window died exactly like that)
+    tpu_env = dict(os.environ, PICOTRON_TEST_TPU="1")
+    step_cmds = {
+        "kernel_parity": lambda: (
+            [sys.executable, "-m", "pytest", "-v",
+             "tests/test_tpu_kernels.py"], tpu_env),
+        "bench": lambda: ([sys.executable, "bench.py"], None),
+        "bench_7b": lambda: ([sys.executable, "bench_7b.py"], None),
+        "profile": lambda: (
+            [sys.executable, "train.py", "--config", profile_cfg_path()],
+            None),
+        "cond_gating": lambda: (
+            [sys.executable, "-m", "picotron_tpu.tools.measure_cond_gating"],
+            None),
     }
-    cfg_path = os.path.join(out_dir, "profile_cfg.json")
-    with open(cfg_path, "w") as f:
-        json.dump(cfg, f, indent=2)
-    results.append(run_step(
-        "profile", [sys.executable, "train.py", "--config", cfg_path],
-        out_dir, timeout=1800))
+    assert set(step_cmds) == set(STEP_TIMEOUTS)
+    known = set(STEP_TIMEOUTS)
+    only = set(args.only.split(",")) if args.only else known
+    if only - known:
+        ap.error(f"unknown step(s) {sorted(only - known)}; "
+                 f"known: {sorted(known)}")
 
-    # cond-gating cost on hardware (round-3 VERDICT weak #3): is the
-    # masked stage's embed/loss really ~free under lax.cond?
-    results.append(run_step(
-        "cond_gating",
-        [sys.executable, "-m", "picotron_tpu.tools.measure_cond_gating"],
-        out_dir, timeout=1500))
+    results = []
+    summary_path = os.path.join(out_dir, "summary.json")
 
-    with open(os.path.join(out_dir, "summary.json"), "w") as f:
-        json.dump(results, f, indent=2)
+    def flush_summary():
+        # after EVERY step, not just at the end: a SIGTERM mid-window must
+        # not cost the watcher the record of steps that already passed
+        with open(summary_path, "w") as f:
+            json.dump(results, f, indent=2)
+
+    for name, timeout in STEP_TIMEOUTS.items():
+        if name not in only:
+            continue
+        cmd, env = step_cmds[name]()
+        results.append(run_step(name, cmd, out_dir, timeout, env=env))
+        flush_summary()
     print(json.dumps(results))
     return 0 if all(r["rc"] == 0 for r in results) else 1
 

@@ -381,3 +381,78 @@ def test_measure_cond_gating_small(capsys):
               "loss_maskedboth_other_ms", "embed_owner_ms",
               "embed_gated_other_ms", "embed_maskedboth_other_ms"):
         assert rec[k] > 0
+
+
+def test_chip_agenda_rejects_unknown_step(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "picotron_tpu.tools.chip_agenda",
+         str(tmp_path), "--only", "bogus"],
+        capture_output=True, text=True)
+    assert r.returncode == 2
+    assert "unknown step" in r.stderr
+
+
+def test_tunnel_watch_resumes_and_exits_on_complete(tmp_path, capsys):
+    """A watcher whose state file already records every step as passed must
+    exit 0 without probing the tunnel (state is how a restarted watcher —
+    or a later round — avoids re-burning a live window)."""
+    from picotron_tpu.tools import tunnel_watch as tw
+
+    state = tmp_path / "state.json"
+    tw.save_state(str(state), {"passed": {s: "x" for s in tw.ALL_STEPS}})
+    rc = tw.main(["--state", str(state), "--interval", "1",
+                  "--budget-hours", "0.001"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "done: passed=" in out and "given_up=[]" in out
+
+
+def test_tunnel_watch_budget_exhausts(tmp_path, monkeypatch, capsys):
+    from picotron_tpu.tools import tunnel_watch as tw
+
+    monkeypatch.setattr(tw, "probe_tunnel", lambda timeout=90.0: "dead")
+    monkeypatch.setattr(tw.time, "sleep", lambda s: None)
+    rc = tw.main(["--state", str(tmp_path / "s.json"),
+                  "--interval", "1", "--budget-hours", "-1"])
+    assert rc == 1
+    assert "budget exhausted" in capsys.readouterr().out
+
+
+def test_chip_agenda_term_handler_kills_step_group():
+    """tunnel_watch SIGTERMs the agenda on its global cap; the agenda's
+    handler must forward a SIGKILL to the in-flight step's process group
+    (each step runs in its own session) — an orphaned step would hold the
+    TPU for the rest of the live window."""
+    import signal
+
+    from picotron_tpu.tools import chip_agenda as ca
+
+    sleeper = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        start_new_session=True)
+    old = signal.getsignal(signal.SIGTERM)
+    try:
+        ca._install_term_handler()
+        ca._current_pgid = os.getpgid(sleeper.pid)
+        with pytest.raises(SystemExit) as ei:
+            os.kill(os.getpid(), signal.SIGTERM)
+        assert ei.value.code == 128 + signal.SIGTERM
+        assert sleeper.wait(timeout=10) == -signal.SIGKILL
+    finally:
+        ca._current_pgid = None
+        signal.signal(signal.SIGTERM, old)
+        if sleeper.poll() is None:
+            sleeper.kill()
+
+
+def test_tunnel_watch_gives_up_on_failed_steps(tmp_path, capsys):
+    """--max-step-failures 0 marks every unpassed step given-up at once:
+    the watcher exits 1 (not 0) and names them, instead of hammering a
+    deterministically failing step for the whole budget."""
+    from picotron_tpu.tools import tunnel_watch as tw
+
+    rc = tw.main(["--state", str(tmp_path / "s.json"),
+                  "--max-step-failures", "0", "--budget-hours", "1"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "given_up=" in out and "bench" in out
