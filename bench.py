@@ -271,6 +271,72 @@ def _run_inner(script: str, timeout: float):
     return subprocess.CompletedProcess(p.args, p.returncode, out, err)
 
 
+def _round_start_epoch(repo: str) -> float | None:
+    """Commit time of the newest BENCH_r*.json — the driver writes one at
+    every round boundary, so captures older than this belong to a previous
+    round's code and must never be republished as this round's number."""
+    try:
+        r = subprocess.run(
+            ["git", "log", "-1", "--format=%ct", "--", "BENCH_r*.json"],
+            cwd=repo, capture_output=True, text=True, timeout=30)
+        return float(r.stdout.strip()) if r.returncode == 0 else None
+    except (OSError, ValueError, subprocess.TimeoutExpired):
+        return None
+
+
+def latest_captured_record(metric: str, max_age_hours: float = 18.0,
+                           base: str | None = None,
+                           after_epoch: float | None = None):
+    """Freshest real (non-null) one-line JSON record for ``metric`` that an
+    earlier IN-ROUND bench run captured under docs/chip_runs/<UTC-stamp>/
+    (chip_agenda / tunnel_watch step logs). The flaky-tunnel failure mode
+    this exists for: a live window mid-round produced a real number, the
+    tunnel is dead again when the driver publishes — a validated number
+    captured by this same pipeline hours ago beats a null artifact. The
+    age cap keeps records from a previous round (or a stale checkout) from
+    masquerading as this round's. Returns (record, run_dir) or None."""
+    import datetime
+    import glob
+
+    here = base or os.path.dirname(os.path.abspath(__file__))
+    if after_epoch is None:
+        after_epoch = _round_start_epoch(here)
+    best = None
+    for log in glob.glob(os.path.join(here, "docs", "chip_runs", "*",
+                                      "*.log")):
+        stamp = os.path.basename(os.path.dirname(log))
+        try:
+            t = datetime.datetime.strptime(
+                stamp, "%Y%m%dT%H%M%SZ").replace(
+                tzinfo=datetime.timezone.utc)
+        except ValueError:
+            continue
+        age_h = (datetime.datetime.now(datetime.timezone.utc)
+                 - t).total_seconds() / 3600
+        if age_h > max_age_hours:
+            continue
+        if after_epoch is not None and t.timestamp() <= after_epoch:
+            continue  # captured before this round started: previous code
+        try:
+            with open(log, errors="replace") as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            if not line.startswith('{"metric"'):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if (rec.get("metric") == metric
+                    and rec.get("value") is not None
+                    and "stale_from" not in rec):  # originals only
+                if best is None or stamp > best[2]:
+                    best = (rec, os.path.dirname(log), stamp)
+    return (best[0], best[1]) if best else None
+
+
 def orchestrate(script: str, metric: str, unit: str,
                 max_total: float = 5400.0) -> None:
     """Outer harness that makes a bench survive TPU-tunnel flaps.
@@ -349,6 +415,23 @@ def orchestrate(script: str, metric: str, unit: str,
             break  # no accelerator to wait for; the failure is final
         print(f"# {diagnosis[-1]}; backing off", file=sys.stderr)
         time.sleep(max(0.0, min(60.0, max_total - (time.time() - start) - 200)))
+    # last resort before a null artifact: a real number captured earlier
+    # this round by a live-window agenda/watcher run of this same bench.
+    # Gated on the tunnel never having probed alive — if the tunnel WAS
+    # alive and the inner bench kept failing, that's a code problem and a
+    # stale number would mask it (the note would also be a lie).
+    stale = None if probe_ok_ever else latest_captured_record(metric)
+    if stale is not None:
+        rec, run_dir = stale
+        rec["stale_from"] = run_dir
+        rec["note"] = ("tunnel dead at publish time; value captured "
+                       "earlier this round by the in-session chip agenda "
+                       f"(log dir {os.path.basename(run_dir)})")
+        rec["error"] = " | ".join(diagnosis)[-800:]
+        print(f"# publishing stale in-round capture from {run_dir}",
+              file=sys.stderr)
+        print(json.dumps(rec))
+        return
     print(json.dumps({"metric": metric, "value": None, "unit": unit,
                       "vs_baseline": None,
                       "error": " | ".join(diagnosis)[-1500:]}))

@@ -256,3 +256,113 @@ def test_orchestrate_cpu_box_failure_is_final(monkeypatch, capsys):
     assert n[0] == 1  # no pointless retries without an accelerator
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["value"] is None and "rc=1" in rec["error"]
+
+
+def test_latest_captured_record_picks_newest_real_capture(tmp_path):
+    """The stale-capture fallback must pick the NEWEST in-age original
+    record for the metric, skipping nulls, other metrics, re-published
+    stale records, out-of-age dirs, and unparseable junk."""
+    import json
+
+    import bench
+
+    runs = tmp_path / "docs" / "chip_runs"
+
+    def write(stamp, name, lines):
+        d = runs / stamp
+        d.mkdir(parents=True, exist_ok=True)
+        (d / name).write_text("\n".join(lines) + "\n")
+
+    import datetime
+
+    def stamp(hours_ago):
+        t = (datetime.datetime.now(datetime.timezone.utc)
+             - datetime.timedelta(hours=hours_ago))
+        return t.strftime("%Y%m%dT%H%M%SZ")
+
+    old, mid, new = stamp(30), stamp(5), stamp(1)
+    write(old, "bench.log",
+          [json.dumps({"metric": "m", "value": 99.0})])  # too old
+    write(mid, "bench.log",
+          ["# noise", "{not json",
+           json.dumps({"metric": "m", "value": 54.0, "unit": "%"})])
+    write(new, "bench.log",
+          [json.dumps({"metric": "m", "value": None}),     # null: skip
+           json.dumps({"metric": "other", "value": 77.0}),  # other metric
+           json.dumps({"metric": "m", "value": 50.0,
+                       "stale_from": "x"})])               # re-publish: skip
+    got = bench.latest_captured_record("m", base=str(tmp_path))
+    assert got is not None
+    rec, run_dir = got
+    assert rec["value"] == 54.0 and run_dir.endswith(mid)
+    assert bench.latest_captured_record("nope", base=str(tmp_path)) is None
+
+
+def test_orchestrate_dead_tunnel_publishes_stale_capture(monkeypatch, capsys):
+    """Tunnel dead at publish time but a live window earlier in the round
+    captured a real number: publish THAT (with provenance + the dead-tunnel
+    diagnosis), not a null artifact."""
+    import json
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+
+    def dead_probe(timeout):
+        t[0] += timeout
+        return "dead"
+
+    monkeypatch.setattr(bench, "probe_tunnel", dead_probe)
+    monkeypatch.setattr(
+        bench, "latest_captured_record",
+        lambda metric: ({"metric": metric, "value": 55.3, "unit": "%",
+                         "vs_baseline": 2.5}, "/r/docs/chip_runs/X"))
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=900)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 55.3 and rec["vs_baseline"] == 2.5
+    assert rec["stale_from"].endswith("X") and "probe" in rec["error"]
+
+
+def test_latest_captured_record_excludes_previous_round(tmp_path):
+    """Captures stamped before the round boundary (the newest BENCH_r*.json
+    commit) are a previous round's code — never republishable."""
+    import datetime
+    import json
+    import time
+
+    import bench
+
+    t = (datetime.datetime.now(datetime.timezone.utc)
+         - datetime.timedelta(hours=2))
+    d = tmp_path / "docs" / "chip_runs" / t.strftime("%Y%m%dT%H%M%SZ")
+    d.mkdir(parents=True)
+    (d / "bench.log").write_text(
+        json.dumps({"metric": "m", "value": 42.0}) + "\n")
+    assert bench.latest_captured_record("m", base=str(tmp_path)) is not None
+    assert bench.latest_captured_record(
+        "m", base=str(tmp_path), after_epoch=time.time()) is None
+
+
+def test_orchestrate_live_tunnel_inner_failures_never_publish_stale(
+        monkeypatch, capsys):
+    """A live tunnel with a persistently failing inner bench is a CODE
+    problem; the stale fallback must not mask it with an old number."""
+    import json
+    import subprocess as sp
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
+
+    def failing_inner(script, timeout):
+        t[0] += 120
+        return sp.CompletedProcess(script, 1, "", "boom\n")
+
+    monkeypatch.setattr(bench, "_run_inner", failing_inner)
+    monkeypatch.setattr(
+        bench, "latest_captured_record",
+        lambda metric: ({"metric": metric, "value": 55.3}, "/x"))
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=900)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None and "rc=1" in rec["error"]
