@@ -358,6 +358,11 @@ def orchestrate(script: str, metric: str, unit: str,
     diagnosis: list[str] = []
     attempt = 0
     probe_ok_ever = False
+    # an inner run EXITED without a valid artifact (rc!=0, or rc==0 with
+    # the JSON line missing) — either way the inner code is broken, unlike
+    # a hang/timeout, which is the tunnel's infra signature
+    code_failure = False
+    inner_hung = False
     while True:
         attempt += 1
         remaining = max_total - (time.time() - start)
@@ -397,6 +402,7 @@ def orchestrate(script: str, metric: str, unit: str,
             break
         r = _run_inner(script, timeout=remaining - 30)
         if isinstance(r, str):  # timed out; r = partial stderr
+            inner_hung = True
             diagnosis.append(
                 f"attempt {attempt}: inner bench timed out after "
                 f"{remaining - 30:.0f}s; stderr tail: {(r or '')[-300:]!r}")
@@ -408,6 +414,7 @@ def orchestrate(script: str, metric: str, unit: str,
         if r.returncode == 0 and line is not None:
             print(line)
             return
+        code_failure = True
         diagnosis.append(
             f"attempt {attempt}: inner bench rc={r.returncode}; "
             f"tail: {(r.stdout + r.stderr)[-300:]!r}")
@@ -417,14 +424,24 @@ def orchestrate(script: str, metric: str, unit: str,
         time.sleep(max(0.0, min(60.0, max_total - (time.time() - start) - 200)))
     # last resort before a null artifact: a real number captured earlier
     # this round by a live-window agenda/watcher run of this same bench.
-    # Gated on the tunnel never having probed alive — if the tunnel WAS
-    # alive and the inner bench kept failing, that's a code problem and a
-    # stale number would mask it (the note would also be a lie).
-    stale = None if probe_ok_ever else latest_captured_record(metric)
+    # Gated on no inner run having exited artifact-less — that's a code
+    # problem a stale number would mask. Hangs are the infra signature
+    # (dead probes, or a half-alive tunnel whose remote compiles wedge —
+    # 20260731T0103's failure mode): there a validated in-round capture
+    # beats a null artifact.
+    stale = None if code_failure else latest_captured_record(metric)
     if stale is not None:
         rec, run_dir = stale
         rec["stale_from"] = run_dir
-        rec["note"] = ("tunnel dead at publish time; value captured "
+        if not probe_ok_ever:
+            why = "tunnel dead at publish time"
+        elif inner_hung:
+            why = ("tunnel half-alive at publish time (probes ok, inner "
+                   "bench hung)")
+        else:
+            why = ("wall-clock budget exhausted before an inner run "
+                   "completed")
+        rec["note"] = (f"{why}; value captured "
                        "earlier this round by the in-session chip agenda "
                        f"(log dir {os.path.basename(run_dir)})")
         rec["error"] = " | ".join(diagnosis)[-800:]

@@ -366,3 +366,31 @@ def test_orchestrate_live_tunnel_inner_failures_never_publish_stale(
     bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=900)
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["value"] is None and "rc=1" in rec["error"]
+
+
+def test_orchestrate_half_alive_tunnel_publishes_stale_capture(
+        monkeypatch, capsys):
+    """Probes succeed but every inner run HANGS (a half-alive tunnel whose
+    remote compiles wedge — the 20260731T0103 window's failure mode).
+    Unlike an rc!=0 code failure, a hang is infra: a validated in-round
+    capture must be published over a null artifact."""
+    import json
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
+
+    def hanging_inner(script, timeout):
+        t[0] += timeout  # consumed its whole timeout, returned partial tail
+        return "partial stderr"
+
+    monkeypatch.setattr(bench, "_run_inner", hanging_inner)
+    monkeypatch.setattr(
+        bench, "latest_captured_record",
+        lambda metric: ({"metric": metric, "value": 55.3, "unit": "%",
+                         "vs_baseline": 2.5}, "/r/docs/chip_runs/X"))
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=900)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 55.3 and rec["stale_from"].endswith("X")
+    assert "half-alive" in rec["note"] and "timed out" in rec["error"]
