@@ -112,6 +112,62 @@ def kernel_parity_preflight() -> str:
     return lines[-1] if lines else ""
 
 
+class EntryTimeout(Exception):
+    """A single ladder entry (compile + timed runs) exceeded its watchdog."""
+
+
+# Inner exit code for "the TPU infra is sick, not the bench code" (EX_TEMPFAIL
+# from sysexits). The orchestrator must distinguish this from an rc=1 code
+# failure: an infra bail-out keeps the stale-capture fallback eligible.
+EX_INFRA = 75
+
+
+class _entry_watchdog:
+    """SIGALRM deadline around one ladder entry. The 20260731T0316 window
+    showed why: the tunneled compile service wedged silently on ONE compile
+    for 50+ minutes (the client sleeps in an interruptible poll loop, so
+    the alarm lands) and a single entry consumed the orchestrator's whole
+    budget. Bounding each entry converts a sick compile service from
+    'window lost' into 'one entry's cap lost, ladder moves on'. Main
+    thread only; seconds <= 0 disables."""
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def __enter__(self):
+        import signal
+
+        if self.seconds <= 0:
+            return self
+        def _fire(signum, frame):
+            raise EntryTimeout(
+                f"ladder entry exceeded its {self.seconds:.0f}s watchdog "
+                f"(wedged remote compile?)")
+        self._prev = signal.signal(signal.SIGALRM, _fire)
+        signal.setitimer(signal.ITIMER_REAL, self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+
+        if self.seconds <= 0:
+            return False
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, self._prev)
+        return False
+
+
+def _entry_timeout_s() -> float:
+    """Per-entry watchdog for run_descending. Sized so a healthy entry
+    (compile ~2-6 min on this tunnel + ~30 s of timed runs) never trips
+    it, while a wedge costs at most this instead of the whole budget.
+    Override with PICOTRON_BENCH_ENTRY_TIMEOUT (seconds; 0 disables)."""
+    try:
+        return float(os.environ.get("PICOTRON_BENCH_ENTRY_TIMEOUT", "900"))
+    except ValueError:
+        return 900.0
+
+
 def classify_bench_error(msg: str) -> str:
     """'oom' = definite out-of-HBM (descend to a smaller size); 'opaque' =
     the tunneled-TPU compile service surfaced an error with no status (it
@@ -135,20 +191,40 @@ def run_descending(sizes, make_cfg, tag, **run_kw):
     import gc
 
     last_err = None
+    trips = 0
     for size in sizes:
         cfg = make_cfg(size)
         for attempt in range(2):
             try:
-                return cfg, run(cfg, **run_kw)
+                with _entry_watchdog(_entry_timeout_s()):
+                    return cfg, run(cfg, **run_kw)
             except Exception as e:
                 msg = str(e).lower()
                 last_err = msg
-                kind = classify_bench_error(msg)
+                # a watchdog trip is indistinguishable from a transient
+                # service wedge: same policy as an opaque service error
+                # (retry this size once, then descend) — but a SECOND trip
+                # means the service is sick for the day; paying the cap
+                # again on every remaining size would consume the very
+                # budget the watchdog protects, so bail out with the
+                # infra exit code (orchestrator retries / falls back)
+                if isinstance(e, EntryTimeout):
+                    trips += 1
+                    if trips >= 2:
+                        print(f"# {tag}: {trips} watchdog trips — compile "
+                              f"service wedged; giving up early ({msg})",
+                              file=sys.stderr)
+                        raise SystemExit(EX_INFRA) from None
+                    kind = "opaque"
+                else:
+                    kind = classify_bench_error(msg)
                 if kind == "raise":
                     raise
-                # the traceback pins the failed attempt's device arrays via
-                # frame refs; drop it before collecting so HBM is actually
-                # freed for the next attempt
+                # the exception's traceback pins the failed attempt's
+                # device arrays via frame refs; break it explicitly so the
+                # collect below can actually free HBM for the next attempt
+                e.__traceback__ = None
+                del e
                 jax.clear_caches()
                 gc.collect()
                 if kind == "oom":
@@ -185,7 +261,8 @@ def try_flash_layout_ab(cfg, tok_s_folded, **run_kw):
     jax.clear_caches()
     gc.collect()
     try:
-        tok_s = run(cfg2, **run_kw)
+        with _entry_watchdog(_entry_timeout_s()):
+            tok_s = run(cfg2, **run_kw)
     except Exception as e:
         print(f"# flash_layout={alt} failed; keeping folded "
               f"({str(e)[:160]})", file=sys.stderr)
@@ -363,6 +440,7 @@ def orchestrate(script: str, metric: str, unit: str,
     # a hang/timeout, which is the tunnel's infra signature
     code_failure = False
     inner_hung = False
+    infra_bail = False  # inner exited EX_INFRA: diagnosed a sick service
     while True:
         attempt += 1
         remaining = max_total - (time.time() - start)
@@ -414,7 +492,10 @@ def orchestrate(script: str, metric: str, unit: str,
         if r.returncode == 0 and line is not None:
             print(line)
             return
-        code_failure = True
+        if r.returncode == EX_INFRA:  # inner diagnosed a sick service and
+            infra_bail = True         # bailed; not a code bug
+        else:
+            code_failure = True
         diagnosis.append(
             f"attempt {attempt}: inner bench rc={r.returncode}; "
             f"tail: {(r.stdout + r.stderr)[-300:]!r}")
@@ -438,6 +519,9 @@ def orchestrate(script: str, metric: str, unit: str,
         elif inner_hung:
             why = ("tunnel half-alive at publish time (probes ok, inner "
                    "bench hung)")
+        elif infra_bail:
+            why = ("compile service wedged at publish time (inner bench "
+                   "bailed out after repeated watchdog trips)")
         else:
             why = ("wall-clock budget exhausted before an inner run "
                    "completed")

@@ -77,6 +77,90 @@ def test_all_sizes_fail_exits(monkeypatch):
         run_descending(("big", "small"), lambda s: s, tag="t")
 
 
+def test_entry_watchdog_interrupts_wedged_entry(monkeypatch):
+    """The 20260731T0316 failure mode: an entry's remote compile wedges in
+    an interruptible sleep. The watchdog must fire instead of letting the
+    wedge consume the whole budget; a transient wedge (one trip) retries
+    the same size and succeeds."""
+    import time as _time
+
+    import bench
+
+    monkeypatch.setenv("PICOTRON_BENCH_ENTRY_TIMEOUT", "1")
+    calls = []
+
+    def fake_run(cfg, **kw):
+        calls.append(cfg)
+        if len(calls) == 1:
+            _time.sleep(30)  # wedge: only the alarm can end this
+        return 42.0
+
+    monkeypatch.setattr(bench, "run", fake_run)
+    t0 = _time.monotonic()
+    cfg, tok_s = run_descending(("big", "small"), lambda s: s, tag="t")
+    assert (cfg, tok_s) == ("big", 42.0)
+    assert calls == ["big", "big"]  # one trip, retry same size, success
+    assert _time.monotonic() - t0 < 10
+
+
+def test_second_watchdog_trip_bails_with_infra_code(monkeypatch):
+    """A persistently wedged service must not pay the cap on every size:
+    the second trip exits EX_INFRA so the orchestrator can retry/fall back
+    without misreading it as a code failure."""
+    import time as _time
+
+    import bench
+
+    monkeypatch.setenv("PICOTRON_BENCH_ENTRY_TIMEOUT", "1")
+    monkeypatch.setattr(bench, "run",
+                        lambda cfg, **kw: _time.sleep(30) or 0.0)
+    with pytest.raises(SystemExit) as ei:
+        run_descending(("big", "small"), lambda s: s, tag="t")
+    assert ei.value.code == bench.EX_INFRA
+
+
+def test_orchestrate_infra_bail_publishes_stale_capture(monkeypatch, capsys):
+    """An inner EX_INFRA exit (watchdog gave up on a sick compile service)
+    keeps the stale-capture fallback eligible, unlike an rc=1 code failure."""
+    import json
+    import subprocess as sp
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
+
+    def infra_inner(script, timeout):
+        t[0] += 120
+        return sp.CompletedProcess(script, bench.EX_INFRA, "", "wedged\n")
+
+    monkeypatch.setattr(bench, "_run_inner", infra_inner)
+    monkeypatch.setattr(
+        bench, "latest_captured_record",
+        lambda metric: ({"metric": metric, "value": 55.3, "unit": "%",
+                         "vs_baseline": 2.5}, "/r/docs/chip_runs/X"))
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=900)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 55.3 and "wedged" in rec["note"]
+    assert f"rc={bench.EX_INFRA}" in rec["error"]
+
+
+def test_entry_watchdog_disabled_and_cleared(monkeypatch):
+    """0 disables the watchdog; after a successful entry no alarm is left
+    pending to fire mid-publish."""
+    import signal
+
+    import bench
+
+    monkeypatch.setenv("PICOTRON_BENCH_ENTRY_TIMEOUT", "0")
+    monkeypatch.setattr(bench, "run", lambda cfg, **kw: 5.0)
+    assert run_descending(("a",), lambda s: s, tag="t") == ("a", 5.0)
+
+    monkeypatch.setenv("PICOTRON_BENCH_ENTRY_TIMEOUT", "60")
+    assert run_descending(("a",), lambda s: s, tag="t") == ("a", 5.0)
+    assert signal.getitimer(signal.ITIMER_REAL) == (0.0, 0.0)
+
+
 def _tiny_cfg():
     from picotron_tpu.config import Config
 
