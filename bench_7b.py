@@ -20,6 +20,8 @@ from __future__ import annotations
 import json
 import sys
 
+from picotron_tpu.bench_record import BENCH_METRICS
+
 LLAMA2_7B_GEOM = dict(
     name="meta-llama/Llama-2-7b (proxy geometry)",
     num_attention_heads=32, num_key_value_heads=32, hidden_size=4096,
@@ -52,14 +54,15 @@ def proxy_cfg(layers: int, mbs: int, seq: int, on_tpu: bool):
 def main():
     import os
 
-    from bench import _cpu_pinned, _honor_cpu_env, orchestrate
+    from bench import (_cpu_pinned, _honor_cpu_env, orchestrate,
+                       run_inner_guarded)
 
     _honor_cpu_env()
     if not _cpu_pinned() and "--inner" not in sys.argv:
         orchestrate(os.path.abspath(__file__),
-                    metric="llama2_7b_proxy_mfu_1chip", unit="%")
+                    metric=BENCH_METRICS["bench_7b"], unit="%")
         return
-    inner_main()
+    run_inner_guarded(inner_main)
 
 
 def inner_main():
@@ -104,7 +107,7 @@ def inner_main():
         return
     mfu = get_mfu(tok_s, n_params, m.num_hidden_layers, m.hidden_size,
                   cfg.training.seq_length, peak)
-    print(json.dumps({"metric": "llama2_7b_proxy_mfu_1chip",
+    print(json.dumps({"metric": BENCH_METRICS["bench_7b"],
                       "value": round(mfu, 2), "unit": "%",
                       "vs_baseline": round(mfu / 38.0, 3)}))
     print(f"# layers={m.num_hidden_layers} mbs={cfg.training.micro_batch_size} "

@@ -15,9 +15,10 @@ has passed, or 1 when the time budget runs out.
 
 State (which steps have passed, where their artifacts live) persists to
 a JSON file, so a restarted watcher — or a later round — resumes instead
-of repeating captured evidence. Nothing in this process ever imports
-jax: probing and work both happen in killable children, so the watcher
-itself can never hang on the tunnel.
+of repeating captured evidence. Nothing in this process ever initializes
+a jax backend (that, not the import, is what hangs on a dead tunnel):
+probing and work both happen in killable children, so the watcher itself
+can never hang on the tunnel.
 """
 
 from __future__ import annotations
@@ -53,6 +54,50 @@ def probe_tunnel(timeout: float = 90.0) -> str:
         return "dead"
 
 
+# backend-init-free, like everything else this module pulls in (the
+# package import chain does load the jax MODULE; only backend init — which
+# this process never does — can hang on a dead tunnel)
+from picotron_tpu.bench_record import (  # noqa: E402
+    BENCH_METRICS as BENCH_STEP_METRICS,
+    iter_metric_records,
+)
+
+
+def step_captured(step: str, rc: int, log_path: str) -> bool:
+    """Whether a finished agenda step actually produced its evidence.
+
+    rc==0 alone is NOT that for the bench steps: their orchestrator exits
+    0 even when it publishes a null artifact or republishes an earlier
+    stale capture (the never-empty contract, bench.py:orchestrate). Were
+    rc the test, a diagnosed-failure bench would be marked passed and
+    never retried in a later window — the 20260731T0316 window's bench
+    ended exactly that way. A bench step counts only when its own log
+    (``log_path``, from the agenda's summary record) carries a real,
+    non-stale record of the step's on-TPU metric."""
+    if rc != 0:
+        return False
+    metric = BENCH_STEP_METRICS.get(step)
+    if metric is None:
+        return True
+    return any(rec.get("metric") == metric
+               and rec.get("value") is not None
+               and "stale_from" not in rec
+               for rec in iter_metric_records(log_path))
+
+
+def null_artifact_blames_code(log_path: str) -> bool:
+    """Whether a bench step's rc==0 null artifact diagnoses a CODE failure.
+
+    orchestrate stamps ``"code_failure": true`` into the null artifact
+    when an inner run exited artifact-less WITHOUT an infra signature
+    (bench.py:orchestrate) — deterministic, worth a strike, or the
+    watcher would re-run a broken bench every live window for the whole
+    budget. Infra verdicts (hangs, EX_INFRA bail-outs, tunnel-death
+    crash tails, dead probes) carry no such stamp and stay retryable."""
+    return any(rec.get("value") is None and rec.get("code_failure")
+               for rec in iter_metric_records(log_path))
+
+
 def load_state(path: str) -> dict:
     try:
         with open(path) as f:
@@ -62,6 +107,26 @@ def load_state(path: str) -> dict:
     if not isinstance(state, dict) or not isinstance(
             state.get("passed"), dict):
         state = {"passed": {}}
+    # Revalidate resumed bench entries against their actual evidence: a
+    # state file written by an older watcher (whose pass criterion was
+    # rc==0 alone) can claim a bench passed when its artifact was null.
+    # The agenda's summary.json in the recorded out_dir carries each
+    # step's rc and log path; anything unverifiable is retried.
+    for step in [s for s in state["passed"] if s in BENCH_STEP_METRICS]:
+        out_dir = state["passed"][step]
+        ok = False
+        try:
+            with open(os.path.join(out_dir, "summary.json")) as f:
+                for r in json.load(f):
+                    if r.get("step") == step and step_captured(
+                            step, r.get("rc", 1), r.get("log", "")):
+                        ok = True
+        except (OSError, ValueError):
+            pass
+        if not ok:
+            log(f"resumed state claimed {step} passed but {out_dir} has "
+                f"no real capture — retrying it")
+            del state["passed"][step]
     return state
 
 
@@ -169,28 +234,51 @@ def main(argv=None):
             try:
                 with open(os.path.join(out_dir, "summary.json")) as f:
                     for r in json.load(f):
-                        if r["rc"] == 0:
+                        if step_captured(r["step"], r["rc"],
+                                         r.get("log", "")):
                             state["passed"][r["step"]] = out_dir
                             fails.pop(r["step"], None)
                             progressed = True
                         else:
-                            failed_steps.append(r["step"])
+                            failed_steps.append(
+                                (r["step"], r["rc"], r.get("log", "")))
             except (OSError, ValueError) as e:
                 log(f"no readable summary from {out_dir}: {e}")
             if failed_steps:
-                # a step that died because the tunnel flapped mid-run is
-                # NOT a real failure — only count strikes when the tunnel
-                # is still alive right after the run (a deterministic
-                # on-TPU failure keeps failing on a live tunnel; a flap
-                # shows up as probe=dead here and costs no strike)
-                if probe_tunnel() == "tpu":
-                    for s in failed_steps:
+                # Strikes are for DETERMINISTIC failures: a step that
+                # exited rc!=0, or a bench whose rc==0 null artifact
+                # blames the inner code (crash, not hang). A step that
+                # died to a flap, or a bench that diagnosed its own infra
+                # problem (hangs, EX_INFRA bail-outs, dead probes), stays
+                # pending strike-free — the whole point is retrying those
+                # in a later, healthier window. Strikes only count when
+                # the tunnel is still alive right after the run: a
+                # deterministic failure keeps failing on a live tunnel,
+                # a flap shows up as probe=dead here.
+                # Two strikeable classes: rc!=0 steps, and benches whose
+                # rc==0 null artifact was stamped code_failure by their
+                # orchestrator. BOTH stay probe-gated: orchestrate's
+                # infra-signature blocklist is necessarily incomplete
+                # (an unlisted transport error from a mid-run tunnel
+                # death still stamps code_failure), and a wrong strike
+                # permanently gives the step up while a delayed one only
+                # costs a retry window. Soft failures (diagnosed infra)
+                # never strike — retrying them in a healthier window is
+                # the watcher's whole point.
+                hard = [s for s, rc, lp in failed_steps
+                        if rc != 0 or null_artifact_blames_code(lp)]
+                soft = [s for s, rc, lp in failed_steps if s not in hard]
+                if hard and probe_tunnel() == "tpu":
+                    for s in hard:
                         fails[s] = fails.get(s, 0) + 1
-                    log(f"failed on live tunnel: "
-                        f"{ {s: fails[s] for s in failed_steps} }")
-                else:
-                    log(f"steps {failed_steps} failed but tunnel is down "
-                        f"— counting as a flap, no strike")
+                    log(f"deterministic failures on a live tunnel: "
+                        f"{ {s: fails[s] for s in hard} }")
+                elif hard:
+                    log(f"steps {hard} failed but tunnel is down — "
+                        f"counting as a flap, no strike")
+                if soft:
+                    log(f"steps {soft} produced no evidence (flap/infra) "
+                        f"— no strike, still pending")
             save_state(args.state, state)
             if progressed:
                 continue  # re-probe immediately: momentum, use the window

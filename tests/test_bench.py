@@ -119,6 +119,106 @@ def test_second_watchdog_trip_bails_with_infra_code(monkeypatch):
     assert ei.value.code == bench.EX_INFRA
 
 
+def test_run_inner_guarded_verdicts():
+    """The inner converts ITS OWN terminal failure into the exit-code
+    verdict: infra-signature exceptions (tunnel died mid-run) and the
+    preflight's backend-init-hung SystemExit exit EX_INFRA; genuine code
+    failures propagate (rc=1); success passes through."""
+    import bench
+
+    def raises(e):
+        def f():
+            raise e
+        return f
+
+    with pytest.raises(SystemExit) as ei:
+        bench.run_inner_guarded(
+            raises(RuntimeError("UNAVAILABLE: socket closed")))
+    assert ei.value.code == bench.EX_INFRA
+    with pytest.raises(SystemExit) as ei:
+        bench.run_inner_guarded(raises(SystemExit(
+            "TPU kernel parity preflight timed out: backend init hung")))
+    assert ei.value.code == bench.EX_INFRA
+    with pytest.raises(SystemExit) as ei:  # the watchdog's own bail-out
+        bench.run_inner_guarded(raises(SystemExit(bench.EX_INFRA)))
+    assert ei.value.code == bench.EX_INFRA
+    with pytest.raises(ValueError, match="boom"):
+        bench.run_inner_guarded(raises(ValueError("boom")))
+    with pytest.raises(SystemExit, match="failed at all sizes"):
+        bench.run_inner_guarded(raises(SystemExit(
+            "bench failed at all sizes: out of memory")))
+    bench.run_inner_guarded(lambda: None)
+
+
+def test_orchestrate_code_failure_null_is_stamped(monkeypatch, capsys):
+    """A genuine code crash (no infra signature) publishes a null artifact
+    carrying code_failure=true so the watcher can strike it."""
+    import json
+    import subprocess as sp
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
+
+    def failing_inner(script, timeout):
+        t[0] += 120
+        return sp.CompletedProcess(script, 1, "", "ImportError: boom\n")
+
+    monkeypatch.setattr(bench, "_run_inner", failing_inner)
+    monkeypatch.setattr(bench, "latest_captured_record",
+                        lambda metric: None)
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=900)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None and rec["code_failure"] is True
+
+
+def test_orchestrate_last_verdict_wins(monkeypatch, capsys):
+    """An early rc=1 crash (e.g. an unlisted transport error text) must
+    not stick a code verdict onto a run whose LAST attempt was diagnosed
+    infra — the stale fallback stays eligible and no code_failure stamp
+    is written."""
+    import json
+    import subprocess as sp
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
+    attempts = []
+
+    def inner(script, timeout):
+        t[0] += 120
+        attempts.append(1)
+        if len(attempts) == 1:
+            return sp.CompletedProcess(script, 1, "", "weird crash\n")
+        return sp.CompletedProcess(script, bench.EX_INFRA, "", "wedged\n")
+
+    monkeypatch.setattr(bench, "_run_inner", inner)
+    monkeypatch.setattr(
+        bench, "latest_captured_record",
+        lambda metric: ({"metric": metric, "value": 55.3, "unit": "%",
+                         "vs_baseline": 2.5}, "/r/docs/chip_runs/X"))
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=900)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 55.3 and "infra sick" in rec["note"]
+    assert len(attempts) >= 2
+
+
+def test_run_inner_guarded_first_line_classification():
+    """A deterministic failure whose message EMBEDS a log tail with
+    transport noise (the parity preflight's 'FAILED:\\n<tail>' format)
+    must stay a code failure — only the first line classifies."""
+    import bench
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit) as ei:
+        bench.run_inner_guarded(lambda: (_ for _ in ()).throw(SystemExit(
+            "TPU kernel parity tests FAILED:\n...UNAVAILABLE: socket "
+            "closed...deadline exceeded...")))
+    assert ei.value.code != bench.EX_INFRA
+
+
 def test_orchestrate_infra_bail_publishes_stale_capture(monkeypatch, capsys):
     """An inner EX_INFRA exit (watchdog gave up on a sick compile service)
     keeps the stale-capture fallback eligible, unlike an rc=1 code failure."""
@@ -141,7 +241,7 @@ def test_orchestrate_infra_bail_publishes_stale_capture(monkeypatch, capsys):
                          "vs_baseline": 2.5}, "/r/docs/chip_runs/X"))
     bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=900)
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert rec["value"] == 55.3 and "wedged" in rec["note"]
+    assert rec["value"] == 55.3 and "infra sick" in rec["note"]
     assert f"rc={bench.EX_INFRA}" in rec["error"]
 
 

@@ -396,10 +396,26 @@ def test_tunnel_watch_resumes_and_exits_on_complete(tmp_path, capsys):
     """A watcher whose state file already records every step as passed must
     exit 0 without probing the tunnel (state is how a restarted watcher —
     or a later round — avoids re-burning a live window)."""
+    import json
+
     from picotron_tpu.tools import tunnel_watch as tw
 
+    run = tmp_path / "run"
+    run.mkdir()
+    summary = []
+    for s in tw.ALL_STEPS:
+        log = run / f"{s}.log"
+        metric = tw.BENCH_STEP_METRICS.get(s)
+        if metric:  # bench steps must show REAL evidence to stay passed
+            log.write_text(json.dumps(
+                {"metric": metric, "value": 55.3, "unit": "%"}) + "\n")
+        else:
+            log.write_text("ok\n")
+        summary.append({"step": s, "rc": 0, "log": str(log)})
+    (run / "summary.json").write_text(json.dumps(summary))
     state = tmp_path / "state.json"
-    tw.save_state(str(state), {"passed": {s: "x" for s in tw.ALL_STEPS}})
+    tw.save_state(str(state), {"passed": {s: str(run)
+                                          for s in tw.ALL_STEPS}})
     rc = tw.main(["--state", str(state), "--interval", "1",
                   "--budget-hours", "0.001"])
     assert rc == 0
@@ -443,6 +459,94 @@ def test_chip_agenda_term_handler_kills_step_group():
         signal.signal(signal.SIGTERM, old)
         if sleeper.poll() is None:
             sleeper.kill()
+
+
+def test_tunnel_watch_step_captured_semantics(tmp_path):
+    """rc!=0 never counts; non-bench steps count on rc==0 alone; bench
+    steps additionally need a real, non-stale JSON record in their own
+    log — a null artifact or a stale republish must leave the step
+    pending so a later window retries it (the 20260731T0316 bench exited
+    rc=0 with a null artifact)."""
+    import json
+
+    from picotron_tpu.tools import tunnel_watch as tw
+
+    log = tmp_path / "bench.log"
+    p = str(log)
+    assert not tw.step_captured("kernel_parity", 1, p)
+    assert tw.step_captured("kernel_parity", 0, p)  # non-bench: rc alone
+    # bench: no log yet -> not captured
+    assert not tw.step_captured("bench", 0, p)
+    log.write_text(json.dumps(
+        {"metric": "smollm_1.7b_mfu_1chip", "value": None,
+         "unit": "%", "error": "x"}) + "\n")
+    assert not tw.step_captured("bench", 0, p)  # null artifact
+    log.write_text(json.dumps(
+        {"metric": "smollm_1.7b_mfu_1chip", "value": 55.3,
+         "stale_from": "/old"}) + "\n")
+    assert not tw.step_captured("bench", 0, p)  # stale republish
+    log.write_text(json.dumps(
+        {"metric": "tokens_per_sec_cpu_smoke", "value": 990.0}) + "\n")
+    assert not tw.step_captured("bench", 0, p)  # CPU smoke, wrong metric
+    log.write_text("# noise\n" + json.dumps(
+        {"metric": "smollm_1.7b_mfu_1chip", "value": 55.3,
+         "unit": "%"}) + "\n")
+    assert tw.step_captured("bench", 0, p)
+    assert not tw.step_captured("bench_7b", 0, p)  # needs ITS metric
+
+
+def test_tunnel_watch_state_revalidates_bench_entries(tmp_path, capsys):
+    """A resumed state file claiming a bench passed is only honored when
+    the recorded out_dir's summary + log actually show a real capture
+    (an old watcher marked null-artifact benches passed on rc==0)."""
+    import json
+
+    from picotron_tpu.tools import tunnel_watch as tw
+
+    run = tmp_path / "run"
+    run.mkdir()
+    (run / "bench.log").write_text(json.dumps(
+        {"metric": "smollm_1.7b_mfu_1chip", "value": None,
+         "error": "x"}) + "\n")
+    (run / "summary.json").write_text(json.dumps(
+        [{"step": "bench", "rc": 0, "log": str(run / "bench.log")}]))
+    state_file = tmp_path / "s.json"
+    state_file.write_text(json.dumps(
+        {"passed": {"bench": str(run), "kernel_parity": str(run)}}))
+    state = tw.load_state(str(state_file))
+    # null bench capture dropped; non-bench steps are trusted as-is
+    assert "bench" not in state["passed"]
+    assert "kernel_parity" in state["passed"]
+
+    (run / "bench.log").write_text(json.dumps(
+        {"metric": "smollm_1.7b_mfu_1chip", "value": 55.3,
+         "unit": "%"}) + "\n")
+    state = tw.load_state(str(state_file))
+    assert state["passed"]["bench"] == str(run)  # real capture honored
+
+
+def test_tunnel_watch_null_artifact_code_blame(tmp_path):
+    """A null artifact stamped code_failure by the orchestrator earns a
+    strike; infra nulls (hangs, probes, EX_INFRA bail-outs, tunnel-death
+    crash tails — never stamped) do not."""
+    import json
+
+    from picotron_tpu.tools import tunnel_watch as tw
+
+    log = tmp_path / "bench.log"
+    p = str(log)
+    assert not tw.null_artifact_blames_code(p)  # no log: no blame
+    log.write_text(json.dumps(
+        {"metric": "m", "value": None,
+         "error": "attempt 1: tunnel probe hung/failed"}) + "\n")
+    assert not tw.null_artifact_blames_code(p)
+    log.write_text(json.dumps(
+        {"metric": "m", "value": None, "code_failure": True,
+         "error": "attempt 1: inner bench rc=1; tail: 'ImportError'"}) + "\n")
+    assert tw.null_artifact_blames_code(p)
+    log.write_text(json.dumps(  # real capture: nothing to blame
+        {"metric": "m", "value": 55.3, "unit": "%"}) + "\n")
+    assert not tw.null_artifact_blames_code(p)
 
 
 def test_tunnel_watch_gives_up_on_failed_steps(tmp_path, capsys):
