@@ -323,8 +323,9 @@ class Config:
                 raise ValueError(
                     f"{which} ({horizon}) must exceed lr_warmup_steps "
                     f"({t.lr_warmup_steps}) for a decaying schedule")
-        if t.remat not in ("none", "full", "save_attn"):
-            raise ValueError(f"unknown remat {t.remat!r} (none|full|save_attn)")
+        if t.remat not in ("none", "full", "save_attn", "offload"):
+            raise ValueError(
+                f"unknown remat {t.remat!r} (none|full|save_attn|offload)")
         if t.grad_accum_dtype not in ("float32", "param"):
             raise ValueError(
                 f"unknown grad_accum_dtype {t.grad_accum_dtype!r} (float32|param)")

@@ -34,6 +34,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name as _ckpt_name
 from jax.sharding import PartitionSpec as P
 
 from picotron_tpu.config import Config, ModelConfig
@@ -258,13 +259,16 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
     leave = sp_scatter if sp else tp_reduce
 
     # attention sub-block: column(q,k,v) -> rope -> attn -> row(out)
-    x = enter(_norm(h, lp["attn_norm"], cfg))
+    # (checkpoint_name tags are inert outside jax.checkpoint policies;
+    # remat="save_attn" keeps flash_out/lse, remat="offload" parks every
+    # tagged residual in pinned host memory — layers_forward docstring)
+    x = _ckpt_name(enter(_norm(h, lp["attn_norm"], cfg)), "attn_in")
     B, S, _ = x.shape
     q = (x @ lp["wq"]).reshape(B, S, nh, D)
     k = (x @ lp["wk"]).reshape(B, S, nkv, D)
-    v = (x @ lp["wv"]).reshape(B, S, nkv, D)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    v = _ckpt_name((x @ lp["wv"]).reshape(B, S, nkv, D), "v_proj")
+    q = _ckpt_name(apply_rope(q, cos, sin), "q_rope")
+    k = _ckpt_name(apply_rope(k, cos, sin), "k_rope")
     cp, cp_impl = cfg.distributed.cp_size, cfg.distributed.cp_impl
     # GQA + context parallelism: the compact Hkv-head K/V ride the wire
     # (Hq/Hkv x less ICI traffic than the reference's pre-repeat,
@@ -279,8 +283,10 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
     h = h + leave(o @ lp["wo"])
 
     # MLP sub-block: column(gate,up) -> SwiGLU -> row(down)  (model.py:163-185)
-    x = enter(_norm(h, lp["mlp_norm"], cfg))
-    y = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
+    x = _ckpt_name(enter(_norm(h, lp["mlp_norm"], cfg)), "mlp_in")
+    g = _ckpt_name(x @ lp["w_gate"], "mlp_gate")
+    u = _ckpt_name(x @ lp["w_up"], "mlp_up")
+    y = _ckpt_name(jax.nn.silu(g) * u, "mlp_act")
     return h + leave(y @ lp["w_down"])
 
 
@@ -304,6 +310,12 @@ def layer_valid_mask(stacked, cfg: Config):
     return jnp.arange(K) < n_s
 
 
+# every residual decoder_layer tags with checkpoint_name, in forward
+# order — the remat="offload" policy parks these in pinned host memory
+OFFLOAD_NAMES = ("attn_in", "q_rope", "k_rope", "v_proj", "flash_out",
+                 "flash_lse", "mlp_in", "mlp_gate", "mlp_up", "mlp_act")
+
+
 def layers_forward(stacked, h, cos, sin, cfg: Config):
     """Scan over the locally-held layer stack (this stage's contiguous slice).
     Pad rows of an uneven pipeline split are skipped via the validity mask
@@ -317,7 +329,18 @@ def layers_forward(stacked, h, cos, sin, cfg: Config):
       attention output + LSE (named inside the kernel's VJP,
       ops/pallas/flash_attention.py) — the backward recomputes the cheap
       norm/matmul chain but never re-runs the flash forward kernel, for
-      ~(S*H + S) extra bf16/fp32 floats per layer."""
+      ~(S*H + S) extra bf16/fp32 floats per layer;
+    - "offload": every tagged residual (attn_in/q_rope/k_rope/v_proj/
+      flash_out/flash_lse/mlp_in/mlp_gate/mlp_up/mlp_act — decoder_layer)
+      is parked in pinned HOST memory during forward and streamed back for
+      backward: near-zero recompute at near-zero HBM, paid for in
+      host-link bandwidth. Pays only when the host link sustains
+      ~bytes/FLOP of the model: ≈ (12H + 6I) bytes per token-layer
+      against 2(4H^2 + 3HI) FLOPs — a crossover around H ~ 14k at an
+      assumed 16 GB/s link, inversely proportional to the measured
+      bandwidth (tools/measure_offload_bw) — docs/BENCH_7B.md has the
+      arithmetic. The mode exists for the big-model pod regime; the
+      single-chip bench ladder does not use it."""
     valid = layer_valid_mask(stacked, cfg)
 
     if valid is None:
@@ -337,6 +360,13 @@ def layers_forward(stacked, h, cos, sin, cfg: Config):
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.save_only_these_names(
                 "flash_out", "flash_lse"))
+    elif remat == "offload":
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=list(OFFLOAD_NAMES),
+                offload_src="device", offload_dst="pinned_host"))
     h, _ = lax.scan(body, h, xs)
     return h
 

@@ -42,6 +42,7 @@ STEP_TIMEOUTS = {
     "bench_7b": 5700,
     "profile": 1800,
     "cond_gating": 1500,
+    "offload_bw": 1500,
 }
 
 
@@ -179,6 +180,9 @@ def main():
             None),
         "cond_gating": lambda: (
             [sys.executable, "-m", "picotron_tpu.tools.measure_cond_gating"],
+            None),
+        "offload_bw": lambda: (
+            [sys.executable, "-m", "picotron_tpu.tools.measure_offload_bw"],
             None),
     }
     assert set(step_cmds) == set(STEP_TIMEOUTS)

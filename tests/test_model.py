@@ -115,13 +115,16 @@ def test_forward_logits_zigzag_layout_roundtrip(cfg_factory):
 
 @pytest.mark.slow
 def test_remat_modes_do_not_change_math(cfg_factory):
-    """remat trades memory for recompute; all three modes must produce the
-    identical loss trajectory (fp32, sdpa path: save_attn's checkpoint names
-    simply match nothing and degrade to full)."""
+    """remat trades memory for recompute (or, for "offload", host-link
+    bandwidth); all four modes must produce the identical loss trajectory
+    (fp32, sdpa path: save_attn's checkpoint names simply match nothing
+    and degrade to full; offload parks the decoder_layer-tagged residuals
+    in pinned host memory — a real memory-space move even on the CPU
+    backend)."""
     from test_parallel import run_losses
 
     ref = None
-    for remat in ("none", "full", "save_attn"):
+    for remat in ("none", "full", "save_attn", "offload"):
         cfg = cfg_factory(seq=32, mbs=4)
         cfg.training.remat = remat
         got = run_losses(cfg, steps=4)

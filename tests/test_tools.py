@@ -383,6 +383,23 @@ def test_measure_cond_gating_small(capsys):
         assert rec[k] > 0
 
 
+@pytest.mark.slow
+def test_measure_offload_bw_small(capsys):
+    """The offload-economics probe (remat='offload' bandwidth math,
+    docs/BENCH_7B.md) runs end-to-end on CPU and reports link bandwidth +
+    both step timings; the decisive PCIe numbers need the chip —
+    chip_agenda runs the full-size version there."""
+    from picotron_tpu.tools import measure_offload_bw as mob
+
+    rc = mob.main(["--small"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["d2h_gbps"] > 0 and rec["h2d_gbps"] > 0
+    assert rec["save_attn_ms"] > 0 and rec["offload_ms"] > 0
+    assert rec["value"] > 0
+
+
 def test_chip_agenda_rejects_unknown_step(tmp_path):
     r = subprocess.run(
         [sys.executable, "-m", "picotron_tpu.tools.chip_agenda",
