@@ -55,9 +55,10 @@ def make_config(tiny_model_kwargs, dp=1, pp=1, cp=1, tp=1, seq=32, mbs=2, acc=1,
                         "zero1": zero1, "cp_impl": cp_impl,
                         "pp_interleave": interleave},
         "model": dict(tiny_model_kwargs, **({"dtype": dtype} if dtype else {})),
-        "training": dict(seq_length=seq, micro_batch_size=mbs,
-                         gradient_accumulation_steps=acc, learning_rate=1e-3,
-                         remat="none", **overrides),
+        "training": {**dict(seq_length=seq, micro_batch_size=mbs,
+                            gradient_accumulation_steps=acc,
+                            learning_rate=1e-3, remat="none"),
+                     **overrides},
         "dataset": {"name": "synthetic"},
     }
     return Config.from_dict(raw)

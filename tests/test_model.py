@@ -133,3 +133,17 @@ def test_remat_modes_do_not_change_math(cfg_factory):
         else:
             np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6,
                                        err_msg=f"remat={remat}")
+
+
+@pytest.mark.slow
+def test_offload_remat_on_sharded_topology(cfg_factory):
+    """remat='offload' (pinned-host residuals) composes with a 3D mesh +
+    sequence parallelism: same loss trajectory as single-device remat=none
+    (the offload is a memory-space move, not a math change)."""
+    from test_parallel import run_losses
+
+    ref = run_losses(cfg_factory(seq=32, mbs=4), steps=4)
+    cfg = cfg_factory(dp=2, cp=2, tp=2, sp=True, seq=32, mbs=2,
+                      remat="offload")
+    got = run_losses(cfg, steps=4)
+    np.testing.assert_allclose(got, ref, rtol=3e-5, atol=3e-5)
