@@ -328,6 +328,25 @@ def test_chip_agenda_run_step(tmp_path):
     assert to["rc"] == -9 and "timed out" in open(to["log"]).read()
 
 
+def test_chip_agenda_profile_triggers_analysis(tmp_path, monkeypatch):
+    """A successful profile step is followed by the derived (chip-free)
+    profile_analysis step; a failed one is not."""
+    from picotron_tpu.tools import chip_agenda as ca
+
+    for profile_rc, expect_analysis in ((0, True), (1, False)):
+        calls = []
+
+        def fake_run_step(name, cmd, out_dir, timeout, env=None):
+            calls.append(name)
+            return {"step": name, "rc": profile_rc if name == "profile"
+                    else 0, "log": os.path.join(out_dir, f"{name}.log")}
+
+        monkeypatch.setattr(ca, "run_step", fake_run_step)
+        out = tmp_path / f"run{profile_rc}"
+        ca.main([str(out), "--only", "profile"])
+        assert ("profile_analysis" in calls) == expect_analysis, calls
+
+
 # ------------------------------------------------------------- analyze_trace
 
 
