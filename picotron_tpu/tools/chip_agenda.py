@@ -40,11 +40,11 @@ STEP_TIMEOUTS = {
     "kernel_parity": 1500,
     "bench": 5700,
     "bench_7b": 5700,
-    # 1500 for the chip run + 300 for the derived, chip-free
-    # profile_analysis step that follows a successful profile — the pair
-    # shares this slot so tunnel_watch's global cap (sum of pending step
-    # timeouts) stays in lockstep without knowing about derived steps
-    "profile": 1500,
+    # the whole pair's budget: the chip run gets this MINUS the derived,
+    # chip-free profile_analysis step's 300 (carved off in the step loop)
+    # — so tunnel_watch's global cap (sum of pending step timeouts) stays
+    # correct without knowing about derived steps
+    "profile": 1800,
     "cond_gating": 1500,
     "offload_bw": 1500,
 }
@@ -209,6 +209,8 @@ def main(argv=None):
     for name, timeout in STEP_TIMEOUTS.items():
         if name not in only:
             continue
+        if name == "profile":  # leave room for the derived analysis step
+            timeout -= PROFILE_ANALYSIS_TIMEOUT
         cmd, env = step_cmds[name]()
         results.append(run_step(name, cmd, out_dir, timeout, env=env))
         flush_summary()
