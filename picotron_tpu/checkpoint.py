@@ -343,6 +343,7 @@ def load_hf_safetensors(
     topo: Optional[Topology] = None,
     dtype: Optional[str] = None,
     interleave: int = 1,
+    fsdp: bool = False,
 ) -> llama.Params:
     """Build our parameter pytree from an HF-format Llama checkpoint.
 
@@ -400,7 +401,8 @@ def load_hf_safetensors(
     params = jax.tree.map(lambda x: jnp.asarray(x, dt), params)
     if topo is not None:
         params = jax.tree.map(
-            jax.device_put, params, named_shardings(topo, llama.param_pspecs(m)))
+            jax.device_put, params,
+            named_shardings(topo, llama.param_pspecs(m, fsdp=fsdp)))
     return params
 
 

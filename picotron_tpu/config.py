@@ -61,6 +61,16 @@ class DistributedConfig:
     # all-gather back. Cuts AdamW state memory by dp at identical numerics.
     # Out of the reference's scope (SURVEY.md §2.3 ZeRO row); beyond-parity.
     zero1: bool = False
+    # FSDP / ZeRO stage 3 for the decoder-layer stack: layer params rest
+    # dp-sharded on their hidden-size axis (models/llama.py:param_pspecs),
+    # are all-gathered just in time inside each layer's forward
+    # (decoder_layer), and the gather's AD transpose reduce-scatters the
+    # grads back — params, grads, and optimizer state for the stack all
+    # shrink by dp. Embedding/LM-head/final-norm stay replicated (they are
+    # pp-owned and small relative to the stack at depth). Requires
+    # hidden_size % dp == 0; mutually exclusive with zero1 (redundant —
+    # FSDP already shards the stack's state). Beyond-parity feature.
+    fsdp: bool = False
 
 
 @dataclass
@@ -293,6 +303,16 @@ class Config:
                     f"pp_interleave needs gradient_accumulation_steps "
                     f"({t.gradient_accumulation_steps}) divisible by pp_size "
                     f"({d.pp_size}) (microbatch groups cycle the chunks)")
+        if d.fsdp:
+            if d.zero1:
+                raise ValueError(
+                    "fsdp and zero1 are mutually exclusive (FSDP already "
+                    "shards the layer stack's params, grads, and state)")
+            if m.hidden_size % d.dp_size != 0:
+                raise ValueError(
+                    f"fsdp needs hidden_size ({m.hidden_size}) divisible by "
+                    f"dp_size ({d.dp_size}) — every layer param shards on an "
+                    f"H-sized axis")
         if m.attention_impl not in ("auto", "sdpa", "flash"):
             raise ValueError(
                 f"unknown attention_impl {m.attention_impl!r} (auto|sdpa|flash)")

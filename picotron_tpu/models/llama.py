@@ -153,26 +153,45 @@ def init_params(key, m: ModelConfig, pp_size: int = 1,
     }
 
 
-def param_pspecs(_: ModelConfig) -> Params:
+# FSDP: the axis (AFTER the scan slices off the leading layer-stack axis)
+# each layer param rests dp-sharded on and is all-gathered over just in
+# time inside decoder_layer. Every entry is an H-sized axis, so the single
+# divisibility constraint is hidden_size % dp == 0 (config validation).
+FSDP_GATHER_AXIS = {
+    "attn_norm": 0, "wq": 0, "wk": 0, "wv": 0, "wo": 1,
+    "mlp_norm": 0, "w_gate": 0, "w_up": 0, "w_down": 1,
+}
+
+
+def param_pspecs(_: ModelConfig, fsdp: bool = False) -> Params:
     """PartitionSpecs: layer stack sharded over 'pp' (contiguous stage slices,
     the rule at reference pipeline_parallel.py:33-36), column-parallel weights
     shard out-features over 'tp', row-parallel shard in-features, embedding is
     vocab-parallel (reference tensor_parallel.py:35-50); embed/final_norm/
     lm_head are replicated across 'pp' stages. Everything replicated over
-    'dp' and 'cp'."""
+    'dp' and 'cp' — except with ``fsdp``, where each LAYER param additionally
+    rests dp-sharded on its H-sized axis (FSDP_GATHER_AXIS) and is gathered
+    just in time in decoder_layer."""
+    layers = {
+        "attn_norm": P("pp", None),
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+        "mlp_norm": P("pp", None),
+        "w_gate": P("pp", None, "tp"),
+        "w_up": P("pp", None, "tp"),
+        "w_down": P("pp", "tp", None),
+    }
+    if fsdp:
+        for name, ax in FSDP_GATHER_AXIS.items():
+            spec = list(layers[name])
+            assert spec[ax + 1] is None, (name, spec)  # +1: stack axis
+            spec[ax + 1] = "dp"
+            layers[name] = P(*spec)
     return {
         "embed": P("tp", None),
-        "layers": {
-            "attn_norm": P("pp", None),
-            "wq": P("pp", None, "tp"),
-            "wk": P("pp", None, "tp"),
-            "wv": P("pp", None, "tp"),
-            "wo": P("pp", "tp", None),
-            "mlp_norm": P("pp", None),
-            "w_gate": P("pp", None, "tp"),
-            "w_up": P("pp", None, "tp"),
-            "w_down": P("pp", "tp", None),
-        },
+        "layers": layers,
         "final_norm": P(),
         "lm_head": P(None, "tp"),
     }
@@ -257,6 +276,19 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
     sp = use_sp(cfg)
     enter = sp_gather if sp else tp_copy
     leave = sp_scatter if sp else tp_reduce
+
+    if cfg.distributed.fsdp:
+        # FSDP just-in-time materialization: gather each dp-sharded layer
+        # param for this layer only; the gather's AD transpose
+        # reduce-scatters (dp-sums) the grads back onto the shards. Free
+        # at dp == 1. The "peak = one layer's full params" property needs
+        # a remat mode that RECOMPUTES the gather in backward (any mode
+        # but "none"); under remat="none" the gathered params are saved
+        # as AD residuals across the whole stack, keeping only the
+        # grad/optimizer-state 1/dp savings.
+        lp = {k: lax.all_gather(v, "dp", axis=FSDP_GATHER_AXIS[k],
+                                tiled=True)
+              for k, v in lp.items()}
 
     # attention sub-block: column(q,k,v) -> rope -> attn -> row(out)
     # (checkpoint_name tags are inert outside jax.checkpoint policies;

@@ -104,6 +104,7 @@ def create_single_config(
     cp_impl: Optional[str] = None,
     tp_sequence_parallel: Optional[bool] = None,
     zero1: Optional[bool] = None,
+    fsdp: Optional[bool] = None,
     model_name: str = "HuggingFaceTB/SmolLM-360M-Instruct",
     num_hidden_layers: Optional[int] = None,
     num_attention_heads: Optional[int] = None,
@@ -148,6 +149,8 @@ def create_single_config(
         d["tp_sequence_parallel"] = tp_sequence_parallel
     if zero1 is not None:
         d["zero1"] = zero1
+    if fsdp is not None:
+        d["fsdp"] = fsdp
 
     m = content["model"]
     m["name"] = model_name
@@ -239,6 +242,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero1", action="store_true", default=None,
                    help="ZeRO-1: shard optimizer state over dp "
                         "(reduce-scatter grads, chunked update, all-gather)")
+    p.add_argument("--fsdp", action="store_true", default=None,
+                   help="FSDP/ZeRO-3 for the layer stack: params rest "
+                        "dp-sharded, gathered just in time per layer")
     p.add_argument("--model_name", type=str,
                    default="HuggingFaceTB/SmolLM-360M-Instruct")
     p.add_argument("--num_hidden_layers", type=int, default=None)
@@ -291,6 +297,7 @@ def main(argv=None) -> int:
         cp_zigzag=args.cp_zigzag,
         cp_impl=args.cp_impl,
         tp_sequence_parallel=args.tp_sequence_parallel, zero1=args.zero1,
+        fsdp=args.fsdp,
         model_name=args.model_name,
         num_hidden_layers=args.num_hidden_layers,
         num_attention_heads=args.num_attention_heads,

@@ -102,7 +102,8 @@ def train(cfg, max_steps_override: Optional[int] = None):
         else:
             params = ckpt_mod.load_hf_safetensors(
                 c.hf_bootstrap_path, m, topo,
-                interleave=cfg.distributed.pp_interleave)
+                interleave=cfg.distributed.pp_interleave,
+                fsdp=cfg.distributed.fsdp)
     spc = t.steps_per_call
     step_fn = ts.build_train_step(cfg, topo, multi_step=spc)
     step_fn_single = step_fn if spc == 1 else None  # lazily built for the tail

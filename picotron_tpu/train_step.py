@@ -237,7 +237,7 @@ def init_state(cfg: Config, topo: Topology, seed: int | None = None):
     building the global array — replacing the reference's meta-device init +
     per-rank materialization (checkpoint.py:15-48, 50-102)."""
     seed = cfg.training.seed if seed is None else seed
-    pspecs = llama.param_pspecs(cfg.model)
+    pspecs = llama.param_pspecs(cfg.model, fsdp=cfg.distributed.fsdp)
     shardings = named_shardings(topo, pspecs)
     key = jax.random.PRNGKey(seed)
     params = jax.jit(
@@ -274,7 +274,7 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
     pp = cfg.distributed.pp_size
     engine = cfg.distributed.pp_engine
     zero1 = cfg.distributed.zero1
-    pspecs = llama.param_pspecs(cfg.model)
+    pspecs = llama.param_pspecs(cfg.model, fsdp=cfg.distributed.fsdp)
     optimizer = build_optimizer(cfg)
     if zero1:
         cspecs = zero1_chunk_specs(pspecs)
@@ -355,16 +355,39 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
             p_chunks = optax.apply_updates(p_chunks, updates)
             params = jax.tree.map(_zero1_unsplit, p_chunks, params)
         else:
-            _trace("grad all_reduce(mean)", ("dp", "cp"),
-                   jax.tree.leaves(grads)[0],
-                   extra=f"leaves={len(jax.tree.leaves(grads))}")
-            grads = jax.tree.map(lambda g: lax.pmean(g, ("dp", "cp")), grads)
+            if cfg.distributed.fsdp:
+                # layer grads arrive dp-SUMMED and dp-sharded (the
+                # transpose of decoder_layer's just-in-time all_gather is
+                # a reduce-scatter): finish the mean with /dp + a cp
+                # pmean. Replicated leaves (embed/final_norm/lm_head)
+                # sync as usual.
+                dp = cfg.distributed.dp_size
+                _trace("fsdp grad reduce_scatter(sum)/dp + cp mean",
+                       ("cp",), jax.tree.leaves(grads["layers"])[0],
+                       extra=f"leaves={len(jax.tree.leaves(grads))}")
+                grads = {
+                    **{k: jax.tree.map(
+                           lambda g: lax.pmean(g, ("dp", "cp")), v)
+                       for k, v in grads.items() if k != "layers"},
+                    "layers": jax.tree.map(
+                        lambda g: lax.pmean(g, "cp") / dp,
+                        grads["layers"]),
+                }
+            else:
+                _trace("grad all_reduce(mean)", ("dp", "cp"),
+                       jax.tree.leaves(grads)[0],
+                       extra=f"leaves={len(jax.tree.leaves(grads))}")
+                grads = jax.tree.map(
+                    lambda g: lax.pmean(g, ("dp", "cp")), grads)
             grads = sync_pp_replicated_grads(grads, pspecs)
             if sp_div > 1:
                 grads = sync_sp_norm_grads(grads)
             if cfg.training.grad_clip > 0:
                 # clip the fp32 grads, then downcast — matches the reference's
-                # fp32-master-grad clipping order
+                # fp32-master-grad clipping order; the pspec-aware clip psums
+                # each leaf's sumsq over exactly its sharding axes, so
+                # fsdp's dp-sharded layer grads contribute their true
+                # global norm
                 grads = clip_by_global_norm_sharded(
                     grads, pspecs, cfg.training.grad_clip)
             grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
