@@ -83,6 +83,21 @@ def test_fsdp_matches_single_device(cfg_factory, top):
 
 
 @pytest.mark.slow
+def test_fsdp_uneven_pp_matches_single_device(tiny_model_kwargs):
+    """FSDP composes with an UNEVEN pipeline split (5 layers over pp=2 ->
+    3+2 with a masked pad row): the pad row's gathered params see zero
+    cotangents, so the reduce-scattered grads stay exact."""
+    from conftest import make_config
+    from test_parallel import GLOBAL_BATCH, run_losses
+
+    model = dict(tiny_model_kwargs, num_hidden_layers=5)
+    ref = run_losses(make_config(model, mbs=GLOBAL_BATCH))
+    got = run_losses(make_config(model, dp=2, pp=2, acc=2, mbs=2,
+                                 engine="1f1b", fsdp=True))
+    np.testing.assert_allclose(got, ref, rtol=3e-5, atol=3e-5)
+
+
+@pytest.mark.slow
 def test_fsdp_grad_clip_matches_single_device(cfg_factory):
     """The pspec-aware global-norm clip psums the dp-sharded layer grads'
     sumsq over dp, reproducing single-device clipping exactly."""
