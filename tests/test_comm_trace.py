@@ -24,7 +24,8 @@ def _build_step(tiny_model_kwargs, **kw):
     jax.block_until_ready(step(params, opt_state, tokens, targets)[2])
 
 
-@pytest.mark.parametrize("sp", [False, True])
+@pytest.mark.parametrize(
+    "sp", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_verbose_level1_traces_collectives(tiny_model_kwargs, monkeypatch,
                                            capsys, sp):
     monkeypatch.setenv("PICOTRON_VERBOSE", "1")
