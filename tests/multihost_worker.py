@@ -7,7 +7,9 @@ trajectory (and which processes printed) to a JSON file.
 Usage: python multihost_worker.py <process_id> <port> <out_json> [features]
 ``features`` is a comma-separated flag list; "zero1" turns on dp-sharded
 optimizer state, whose reduce-scatter/all-gather then cross the process
-boundary (dp is the outermost axis).
+boundary (dp is the outermost axis); "fsdp" rests the layer params
+dp-sharded, so every layer's just-in-time param all-gather (and its
+grad reduce-scatter transpose) crosses the boundary instead.
 """
 
 import json
@@ -39,7 +41,8 @@ def main():
         # on process 1 — the grad pmean crosses the process boundary, like dp
         # over DCN on a real pod
         "distributed": {"dp_size": 2, "cp_size": 2, "tp_size": 2,
-                        "use_cpu": True, "zero1": "zero1" in feats},
+                        "use_cpu": True, "zero1": "zero1" in feats,
+                        "fsdp": "fsdp" in feats},
         "model": dict(num_hidden_layers=4, num_attention_heads=8,
                       num_key_value_heads=4, hidden_size=64,
                       intermediate_size=128, vocab_size=256,

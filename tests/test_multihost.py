@@ -58,13 +58,16 @@ def _launch_pod(tmp_path, features: str = ""):
     return [json.load(open(o)) for o in outs]
 
 
-@pytest.mark.parametrize("zero1", [False, True], ids=["plain", "zero1"])
-def test_two_process_matches_single_process(tmp_path, cfg_factory, zero1):
+@pytest.mark.parametrize("features", ["", "zero1", "fsdp"],
+                         ids=["plain", "zero1", "fsdp"])
+def test_two_process_matches_single_process(tmp_path, cfg_factory, features):
     """With ZeRO-1, dp being the outermost mesh axis means each dp replica
     (and each optimizer-state chunk) lives on its own process — the grad
     reduce-scatter and param all-gather cross hosts — and the trajectory
-    must still equal the single-process run."""
-    results = _launch_pod(tmp_path, features="zero1" if zero1 else "")
+    must still equal the single-process run. With FSDP the layer params
+    themselves rest sharded across the two processes and every layer's
+    just-in-time all-gather crosses the boundary."""
+    results = _launch_pod(tmp_path, features=features)
     # both processes observe the same (replicated) loss
     np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
                                rtol=1e-6, atol=1e-6)
@@ -74,7 +77,8 @@ def test_two_process_matches_single_process(tmp_path, cfg_factory, zero1):
     # and the 2-process trajectory equals the single-process one
     from test_parallel import run_losses
 
-    cfg = cfg_factory(dp=2, cp=2, tp=2, seq=32, mbs=4, zero1=zero1)
+    cfg = cfg_factory(dp=2, cp=2, tp=2, seq=32, mbs=4,
+                      zero1=features == "zero1", fsdp=features == "fsdp")
     cfg.model.vocab_size = 256
     ref = run_losses(cfg, steps=4)
     np.testing.assert_allclose(results[0]["losses"], ref, rtol=3e-5, atol=3e-5)
