@@ -39,6 +39,27 @@ def test_create_config_shape_overrides_win(tmp_path):
     assert cfg.model.hidden_size == 2048
 
 
+def test_known_model_shapes_all_validate():
+    """Every offline shape-table entry builds a valid Config (the table is
+    the zero-egress path to each supported model family)."""
+    from picotron_tpu.config import Config
+    from picotron_tpu.models import llama
+    from picotron_tpu.tools.create_config import KNOWN_MODEL_SHAPES
+
+    for name, shape in KNOWN_MODEL_SHAPES.items():
+        cfg = Config.from_dict({
+            "distributed": {"use_cpu": True},
+            "model": dict(shape, name=name, dtype="float32",
+                          attention_impl="sdpa"),
+            "training": {"seq_length": 32, "micro_batch_size": 1},
+            "dataset": {"name": "synthetic"},
+        })
+        assert llama.num_params(cfg.model) > 1e8, name
+        # GQA geometries must divide cleanly
+        assert (cfg.model.num_attention_heads
+                % cfg.model.num_key_value_heads == 0), name
+
+
 def test_create_config_rejects_bad_topology(tmp_path):
     with pytest.raises(ValueError):
         cc.create_single_config(
