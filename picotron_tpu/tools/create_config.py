@@ -45,11 +45,14 @@ KNOWN_MODEL_SHAPES = {
         num_hidden_layers=40, num_attention_heads=40, num_key_value_heads=40,
         hidden_size=5120, intermediate_size=13824, vocab_size=32000,
         rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=4096),
-    "meta-llama/Llama-3.2-1B": dict(
-        num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
-        hidden_size=2048, intermediate_size=8192, vocab_size=128256,
-        rms_norm_eps=1e-5, rope_theta=500000.0,
-        max_position_embeddings=8192),
+    # (Llama-3.1/3.2 and Mistral are deliberately absent: they need
+    # rope_scaling / sliding-window attention, which this architecture
+    # does not implement — listing them would be a silent divergence.)
+    "TinyLlama/TinyLlama_v1.1": dict(
+        num_hidden_layers=22, num_attention_heads=32, num_key_value_heads=4,
+        hidden_size=2048, intermediate_size=5632, vocab_size=32000,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        max_position_embeddings=2048),
 }
 # Instruct variants share the base shapes.
 for _base in list(KNOWN_MODEL_SHAPES):
