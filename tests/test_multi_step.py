@@ -15,8 +15,9 @@ from picotron_tpu.topology import topology_from_config
 pytestmark = pytest.mark.slow
 
 
-def test_multi_step_matches_single(cfg_factory):
-    cfg = cfg_factory(dp=2, seq=32, mbs=2)
+@pytest.mark.parametrize("fsdp", [False, True], ids=["plain", "fsdp"])
+def test_multi_step_matches_single(cfg_factory, fsdp):
+    cfg = cfg_factory(dp=2, seq=32, mbs=2, fsdp=fsdp)
     topo = topology_from_config(cfg)
     K, rounds = 3, 2
 
