@@ -11,7 +11,7 @@ $(NATIVE_SO): $(NATIVE_SRC)
 	g++ -O3 -shared -fPIC -std=c++17 $< -o $@
 
 # Fast gate: skips the multi-minute equivalence/e2e matrices (marked
-# pytest.mark.slow) — <5 min on one core. `make test-all` runs everything.
+# pytest.mark.slow) — ~6 min on one core. `make test-all` runs everything.
 test: native
 	python -m pytest tests/ -x -q -m "not slow"
 
