@@ -179,11 +179,16 @@ _SERVICE_SUBSTRINGS = ("remote_compile", "tpu_compile_helper")
 # what a tunnel/compile-service failure's EXCEPTION TEXT looks like: gRPC
 # transport errors, the preflight's backend-init-hang diagnosis, and the
 # ladder watchdog's own wording (a 'failed at all sizes' exit whose last
-# error was a watchdog trip is an infra wedge, not a code bug)
+# error was a watchdog trip is an infra wedge, not a code bug). Anchored
+# to the gRPC status framing ("unavailable:" / ".unavailable") and the
+# watchdog's exact phrase — a bare "watchdog" or "unavailable" in a
+# genuine code failure's message must not buy it an infra verdict (the
+# watcher would then retry it every live window for the whole budget).
 _INFRA_SUBSTRINGS = _SERVICE_SUBSTRINGS + (
-    "unavailable", "socket closed", "deadline_exceeded",
+    "unavailable:", ".unavailable", "socket closed", "deadline_exceeded",
     "deadline exceeded", "connection failed", "failed to connect",
-    "connection reset", "backend init hung", "watchdog")
+    "connection reset", "backend init hung",
+    "watchdog (wedged remote compile")
 
 
 def _infra_signature(msg: str) -> bool:
@@ -484,6 +489,10 @@ def orchestrate(script: str, metric: str, unit: str,
     diagnosis: list[str] = []
     attempt = 0
     probe_ok_ever = False
+    inner_attempts = 0
+    hangs = 0
+    full_cap_hangs = 0   # hangs whose attempt had the full per-attempt cap
+    last_probe = None    # tunnel status at the most recent probe
     # the most recent inner attempt's failure mode — "hang" (timed out),
     # "infra" (exited EX_INFRA: watchdog bail-out or infra-signature
     # crash), or "code" (exited without a valid artifact and without an
@@ -502,6 +511,7 @@ def orchestrate(script: str, metric: str, unit: str,
         # (round-3 measurements); a dead one hangs forever, so waiting
         # longer only delays the verdict
         backend = probe_tunnel(timeout=min(90.0, remaining))
+        last_probe = backend
         if backend == "dead":
             diagnosis.append(f"attempt {attempt}: tunnel probe hung/failed")
             if not probe_ok_ever and attempt >= 6:
@@ -529,12 +539,28 @@ def orchestrate(script: str, metric: str, unit: str,
         if remaining < 180:
             diagnosis.append("wall-clock budget exhausted after probe")
             break
-        r = _run_inner(script, timeout=remaining - 30)
+        # per-attempt cap below the whole remaining budget: a healthy
+        # worst-case inner run fits in ~45 min (preflight cap + compile
+        # sweep + A/B), so 3000 s never kills a good run — while a wedged
+        # one costs a single attempt, leaving room for a second attempt
+        # whose outcome disambiguates "wedged service" from "code
+        # deadlock" (two full-cap hangs with a live tunnel = ambiguous,
+        # see below)
+        inner_timeout = min(remaining - 30, 3000.0)
+        r = _run_inner(script, timeout=inner_timeout)
+        inner_attempts += 1
         if isinstance(r, str):  # timed out; r = partial stderr
             last_verdict = "hang"
+            hangs += 1
+            if inner_timeout >= 3000.0:
+                # only a FULL-cap hang votes for "deterministic deadlock":
+                # a budget-truncated attempt can kill a healthy-but-slow
+                # run, and that must not suppress the stale fallback
+                full_cap_hangs += 1
             diagnosis.append(
                 f"attempt {attempt}: inner bench timed out after "
-                f"{remaining - 30:.0f}s; stderr tail: {(r or '')[-300:]!r}")
+                f"{inner_timeout:.0f}s; "
+                f"stderr tail: {(r or '')[-300:]!r}")
             print(f"# {diagnosis[-1]}", file=sys.stderr)
             continue
         sys.stderr.write(r.stderr)  # A/B + config notes: keep in the record
@@ -561,13 +587,28 @@ def orchestrate(script: str, metric: str, unit: str,
     # problem a stale number would mask. Hangs and infra verdicts (dead
     # probes, a half-alive tunnel whose remote compiles wedge —
     # 20260731T0103's failure mode — or the inner's own EX_INFRA): there
-    # a validated in-round capture beats a null artifact.
-    stale = (None if last_verdict == "code"
+    # a validated in-round capture beats a null artifact. EXCEPT when
+    # EVERY inner attempt hung at the full per-attempt cap and the tunnel
+    # was still alive at the last look: a deterministic deadlock in the
+    # bench code looks exactly like that, and a timeout carries no
+    # signature to tell it from a wedged compile service — ambiguous, so
+    # publish null rather than mask a possible regression behind a stale
+    # number. Budget-truncated hangs don't vote (they can kill a healthy
+    # run), and a tunnel that died after the hangs falls back to the
+    # dead-tunnel reasoning where stale is legitimate.
+    all_hung = (inner_attempts >= 2 and hangs == inner_attempts
+                and full_cap_hangs >= 2 and last_probe == "tpu")
+    if all_hung:
+        diagnosis.append(
+            "every inner attempt hung at the full per-attempt cap with a "
+            "live tunnel — ambiguous (code deadlock vs wedged compile "
+            "service); not serving a stale capture")
+    stale = (None if last_verdict == "code" or all_hung
              else latest_captured_record(metric))
     if stale is not None:
         rec, run_dir = stale
         rec["stale_from"] = run_dir
-        if not probe_ok_ever:
+        if not probe_ok_ever or last_probe == "dead":
             why = "tunnel dead at publish time"
         elif last_verdict == "hang":
             why = ("tunnel half-alive at publish time (probes ok, inner "

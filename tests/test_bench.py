@@ -578,3 +578,120 @@ def test_orchestrate_half_alive_tunnel_publishes_stale_capture(
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["value"] == 55.3 and rec["stale_from"].endswith("X")
     assert "half-alive" in rec["note"] and "timed out" in rec["error"]
+
+
+def test_orchestrate_repeated_hangs_publish_null_not_stale(
+        monkeypatch, capsys):
+    """EVERY inner attempt hanging while probes stay alive is ambiguous —
+    a deterministic deadlock in the bench code looks exactly like a wedged
+    compile service — so the stale fallback must NOT fire (it would mask a
+    code regression behind an old number). The per-attempt cap is what
+    makes a second attempt possible inside the budget."""
+    import json
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
+    hangs = []
+
+    def hanging_inner(script, timeout):
+        hangs.append(timeout)
+        t[0] += timeout
+        return "partial stderr"
+
+    monkeypatch.setattr(bench, "_run_inner", hanging_inner)
+    monkeypatch.setattr(
+        bench, "latest_captured_record",
+        lambda metric: ({"metric": metric, "value": 55.3}, "/x"))
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=7000)
+    assert len(hangs) >= 2  # the cap left room for a second attempt
+    assert all(tmo <= 3000.0 for tmo in hangs)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert "ambiguous" in rec["error"]
+    # ambiguous, not a code verdict: the watcher must keep it pending
+    # (retryable next window) rather than strike it
+    assert "code_failure" not in rec
+
+
+def test_infra_signature_anchoring():
+    """The infra substrings are anchored: gRPC status framing and the
+    watchdog's exact phrase count; the bare words appearing in a genuine
+    code failure's message must not buy it an infra verdict."""
+    import bench
+
+    assert bench._infra_signature("UNAVAILABLE: socket closed")
+    assert bench._infra_signature("status = StatusCode.UNAVAILABLE")
+    assert bench._infra_signature(
+        "ladder entry exceeded its 900s watchdog (wedged remote compile?)")
+    assert bench._infra_signature("backend init hung somewhere")
+    assert not bench._infra_signature(
+        "ValueError: dataset 'unavailable' is not a valid split name")
+    assert not bench._infra_signature(
+        "AssertionError: watchdog thread failed to start")
+
+
+def test_orchestrate_truncated_second_hang_still_serves_stale(
+        monkeypatch, capsys):
+    """A second attempt whose budget was truncated below the full
+    per-attempt cap can kill a healthy-but-slow run — its hang must NOT
+    vote for the ambiguous-deadlock verdict, so the stale fallback still
+    fires (pre-cap behavior preserved)."""
+    import json
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+    monkeypatch.setattr(bench, "probe_tunnel", lambda timeout: "tpu")
+
+    def hanging_inner(script, timeout):
+        t[0] += timeout
+        return "partial stderr"
+
+    monkeypatch.setattr(bench, "_run_inner", hanging_inner)
+    monkeypatch.setattr(
+        bench, "latest_captured_record",
+        lambda metric: ({"metric": metric, "value": 55.3, "unit": "%",
+                         "vs_baseline": 2.5}, "/r/docs/chip_runs/X"))
+    # 5400 budget: attempt 1 hangs at the 3000 cap, attempt 2 gets only
+    # ~2370 (truncated) — one full-cap vote, not two
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=5400)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 55.3 and rec["stale_from"].endswith("X")
+
+
+def test_orchestrate_tunnel_dies_after_hangs_serves_stale(
+        monkeypatch, capsys):
+    """Two full-cap hangs followed by the tunnel fully dying: the tunnel
+    is NOT alive at the last look, so this is the dead-tunnel case where
+    a validated in-round capture beats a null artifact."""
+    import json
+
+    import bench
+
+    t = _fake_clock(monkeypatch)
+    probes = []
+
+    def degrading_probe(timeout):
+        probes.append(1)
+        if len(probes) <= 2:
+            return "tpu"
+        t[0] += timeout
+        return "dead"
+
+    monkeypatch.setattr(bench, "probe_tunnel", degrading_probe)
+
+    def hanging_inner(script, timeout):
+        t[0] += timeout
+        return "partial stderr"
+
+    monkeypatch.setattr(bench, "_run_inner", hanging_inner)
+    monkeypatch.setattr(
+        bench, "latest_captured_record",
+        lambda metric: ({"metric": metric, "value": 55.3, "unit": "%",
+                         "vs_baseline": 2.5}, "/r/docs/chip_runs/X"))
+    bench.orchestrate("/x/bench.py", metric="m", unit="%", max_total=9000)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 55.3 and rec["stale_from"].endswith("X")
+    assert "dead at publish time" in rec["note"]

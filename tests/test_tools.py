@@ -617,3 +617,78 @@ def test_tunnel_watch_gives_up_on_failed_steps(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "given_up=" in out and "bench" in out
+
+
+def test_tunnel_watch_state_paths_are_repo_relative(tmp_path, monkeypatch,
+                                                    capsys):
+    """State persists evidence paths REPO-relative (a checkout on another
+    machine must not inherit absolute /root/... pointers), joins them back
+    on load, and drops non-bench entries whose evidence dir is gone."""
+    import json
+
+    from picotron_tpu.tools import tunnel_watch as tw
+
+    monkeypatch.setattr(tw, "REPO", str(tmp_path))
+    run = tmp_path / "docs" / "chip_runs" / "X"
+    run.mkdir(parents=True)
+    state_file = tmp_path / "s.json"
+    tw.save_state(str(state_file), {"passed": {
+        "kernel_parity": str(run),            # under REPO -> relative
+        "cond_gating": "/elsewhere/run"}})    # outside REPO -> untouched
+    on_disk = json.loads(state_file.read_text())
+    assert on_disk["passed"]["kernel_parity"] == os.path.join(
+        "docs", "chip_runs", "X")
+    assert on_disk["passed"]["cond_gating"] == "/elsewhere/run"
+
+    state = tw.load_state(str(state_file))
+    # relative joined back to absolute; missing-dir entry dropped
+    assert state["passed"]["kernel_parity"] == str(run)
+    assert "cond_gating" not in state["passed"]
+    assert "does not exist" in capsys.readouterr().out
+
+
+def test_tunnel_watch_ignores_out_of_set_summary_records(
+        tmp_path, monkeypatch, capsys):
+    """Summary records for steps outside ALL_STEPS (the derived
+    profile_analysis) must neither be marked passed (a name that can never
+    be pending) nor strike; a failed analysis is retried chip-free since
+    the trace is already on disk."""
+    import json
+    import types
+
+    from picotron_tpu.tools import tunnel_watch as tw
+
+    monkeypatch.setattr(tw, "REPO", str(tmp_path))
+    monkeypatch.setattr(tw, "probe_tunnel", lambda timeout=90.0: "tpu")
+    retried = []
+    monkeypatch.setattr(
+        tw.subprocess, "run",
+        lambda cmd, **kw: (retried.append(cmd),
+                           types.SimpleNamespace(returncode=1))[1])
+
+    class FakeAgenda:
+        def __init__(self, cmd, **kw):
+            out_dir = cmd[3]
+            os.makedirs(out_dir, exist_ok=True)
+            log = os.path.join(out_dir, "profile.log")
+            with open(log, "w") as f:
+                f.write("ok\n")
+            with open(os.path.join(out_dir, "summary.json"), "w") as f:
+                json.dump([
+                    {"step": "profile", "rc": 0, "log": log},
+                    {"step": "profile_analysis", "rc": 1, "log": log},
+                ], f)
+
+        def wait(self, timeout=None):
+            return 0
+
+    monkeypatch.setattr(tw.subprocess, "Popen", FakeAgenda)
+    state_file = tmp_path / "s.json"
+    rc = tw.main(["--state", str(state_file), "--steps", "profile",
+                  "--interval", "1", "--budget-hours", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "retrying chip-free" in out
+    assert retried and "picotron_tpu.tools.analyze_trace" in retried[0]
+    state = json.loads(state_file.read_text())
+    assert set(state["passed"]) == {"profile"}  # analysis never marked
