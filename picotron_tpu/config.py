@@ -71,6 +71,16 @@ class DistributedConfig:
     # hidden_size % dp == 0; mutually exclusive with zero1 (redundant —
     # FSDP already shards the stack's state). Beyond-parity feature.
     fsdp: bool = False
+    # How per-stage embed/loss work is gated to its owning pipeline stage
+    # (models/llama.py::_stage_gating): "cond" = lax.cond, the branch only
+    # runs on the owning stage (what production TPU pipelines execute);
+    # "where" = compute-both masking (collective-rendezvous-safe on the XLA
+    # CPU runtime, pre-gating FLOP cost); "auto" = cond on TPU, where on
+    # CPU. "cond" on a CPU mesh is supported for configs whose gated
+    # branches carry no collectives (tp=1 pipelines) — the equivalence
+    # suite uses it so the exact program a TPU pod runs is validated
+    # off-chip.
+    stage_gating: str = "auto"
 
 
 @dataclass
@@ -140,6 +150,11 @@ class TrainingConfig:
     micro_batch_size: int = 1
     gradient_accumulation_steps: int = 1
     max_tokens: Optional[int] = None
+    # Train on only the first N raw dataset examples (reference
+    # data.py:34-35, template/base_config.json:27: select(range(min(N,
+    # len)))) — applied before tokenization on the HF path; the synthetic
+    # stream has no documents, so there the cap applies to packed samples.
+    num_samples: Optional[int] = None
     # Optimizer steps fused into one device dispatch (lax.scan over stacked
     # batches). >1 removes per-step host latency; losses are still reported
     # per step. Checkpoint/log boundaries snap to multiples of this.
@@ -167,6 +182,12 @@ class DatasetConfig:
     num_workers: int = 0
     num_proc: int = 1
     subset_name: Optional[str] = None
+    # Packed corpora at or under this many tokens materialize as one host
+    # numpy array (fastest gathers); anything larger stays in the datasets
+    # arrow cache (disk-mapped, RAM stays bounded by the batch) — the
+    # reference keeps its grouped dataset arrow-backed the same way
+    # (picotron/data.py:57-100). Default 50M tokens = 200 MB of int32.
+    max_in_memory_tokens: int = 50_000_000
 
 
 @dataclass
@@ -284,6 +305,18 @@ class Config:
             raise ValueError("pipeline parallelism needs >= 1 microbatch")
         if d.pp_engine not in ("afab", "1f1b"):
             raise ValueError(f"unknown pp_engine {d.pp_engine!r} (afab|1f1b)")
+        if d.stage_gating not in ("auto", "cond", "where"):
+            raise ValueError(
+                f"unknown stage_gating {d.stage_gating!r} (auto|cond|where)")
+        if d.stage_gating == "cond" and d.use_cpu and d.tp_size > 1:
+            # the gated branches carry tp collectives, and the XLA CPU
+            # runtime's rendezvous intermittently aborts when a collective
+            # is reached by a subset of devices (models/llama.py::
+            # _stage_gating) — surface it at load, not mid-run
+            raise ValueError(
+                "stage_gating='cond' on a CPU mesh requires tp_size == 1 "
+                "(gated tp collectives can abort the XLA CPU rendezvous); "
+                "use 'auto' or 'where'")
         if d.pp_interleave < 1:
             raise ValueError("pp_interleave must be >= 1")
         if d.pp_interleave > 1:
@@ -322,6 +355,10 @@ class Config:
                 "(auto|fused|gathered|vocab_parallel)")
         if t.steps_per_call < 1:
             raise ValueError("steps_per_call must be >= 1")
+        if t.num_samples is not None and t.num_samples < 1:
+            raise ValueError("num_samples must be >= 1 when set")
+        if self.dataset.max_in_memory_tokens < 1:
+            raise ValueError("max_in_memory_tokens must be >= 1")
         if t.lr_schedule not in ("constant", "cosine", "linear"):
             raise ValueError(
                 f"unknown lr_schedule {t.lr_schedule!r} (constant|cosine|linear)")

@@ -60,6 +60,24 @@ def _pack(stream: np.ndarray, chunk: int) -> np.ndarray:
     return stream[: n * chunk].reshape(n, chunk)
 
 
+class _ArrowSamples:
+    """Packed rows backed by the datasets arrow cache (disk-mapped): a
+    corpus above dataset.max_in_memory_tokens never materializes in host
+    RAM — __next__ gathers only the current batch's rows. The reference
+    serves its grouped dataset the same way, arrow-backed through the
+    torch DataLoader (picotron/data.py:57-100)."""
+
+    def __init__(self, ds):
+        self._ds = ds.with_format("numpy", columns=["ids"])
+
+    def __len__(self) -> int:
+        return len(self._ds)
+
+    def gather(self, idx: np.ndarray) -> np.ndarray:
+        rows = self._ds[[int(i) for i in idx]]["ids"]
+        return np.asarray(rows, dtype=np.int32)
+
+
 class MicroBatchDataLoader:
     """Yields {'input_ids','target_ids'}: int32 [grad_acc, mbs*dp, seq_length]."""
 
@@ -73,17 +91,24 @@ class MicroBatchDataLoader:
         self.rows_per_step = t.micro_batch_size * d.dp_size
         self.tokenizer = tokenizer
 
+        # samples: [n, seq_length+1] rows so input/target are shifted views
+        # (reference data.py:88-96) — a host numpy array, or an arrow-backed
+        # _ArrowSamples for corpora above dataset.max_in_memory_tokens
         if cfg.dataset.name == "synthetic":
             stream = synthetic_corpus(
                 cfg.model.vocab_size,
                 max(2_000_000, 64 * self.rows_per_step * (t.seq_length + 1)),
                 cfg.training.seed,
             )
+            self.samples = _pack(stream, self.seq_length + 1)
+            if t.num_samples:
+                # the reference subsets raw documents pre-tokenization
+                # (data.py:34-35); the synthetic stream has no documents, so
+                # the "first N examples" contract applies to packed samples
+                self.samples = self.samples[: t.num_samples]
         else:
-            stream = self._load_hf_stream(cfg, tokenizer)
-        # pack into seq_length+1 so input/target are shifted views
-        # (reference data.py:88-96)
-        self.samples = _pack(stream, self.seq_length + 1)
+            self.samples = self._load_hf_samples(
+                cfg, tokenizer, self.seq_length + 1)
         if len(self.samples) < self.rows_per_step:
             raise ValueError("dataset too small for one global batch")
         self._epoch = 0
@@ -107,7 +132,13 @@ class MicroBatchDataLoader:
             self._seq_perm = zigzag_perm(t.seq_length, d.cp_size)
 
     @staticmethod
-    def _load_hf_stream(cfg: Config, tokenizer) -> np.ndarray:
+    def _load_hf_samples(cfg: Config, tokenizer, chunk: int):
+        """Tokenize and pack an HF dataset into [n, chunk] rows WITHOUT ever
+        holding the whole corpus in host RAM: both the tokenize and the
+        group step run as batched ``datasets.map`` passes, which stream
+        batch-by-batch through the arrow cache on disk. Small corpora
+        (<= dataset.max_in_memory_tokens) materialize to one numpy array at
+        the end (fastest gathers); larger ones stay arrow-backed."""
         import datasets  # deferred: offline environments use "synthetic"
 
         if tokenizer is None:
@@ -124,6 +155,10 @@ class MicroBatchDataLoader:
         else:
             ds = datasets.load_dataset(
                 name, cfg.dataset.subset_name, split=cfg.dataset.split)
+        if cfg.training.num_samples:
+            # first-N raw documents, pre-tokenization (reference
+            # data.py:34-35: select(range(min(N, len))))
+            ds = ds.select(range(min(cfg.training.num_samples, len(ds))))
         col = cfg.dataset.text_column
 
         def tok(batch):
@@ -131,7 +166,24 @@ class MicroBatchDataLoader:
 
         ds = ds.map(tok, batched=True, num_proc=max(cfg.dataset.num_proc, 1),
                     remove_columns=ds.column_names)
-        return np.concatenate([np.asarray(x, np.int32) for x in ds["ids"]])
+
+        # Group into fixed-length rows INSIDE the arrow cache: each map
+        # batch concatenates its documents and emits len//chunk rows,
+        # dropping the per-batch remainder — the reference's
+        # tokenizer_group_text contract (data.py:57-75).
+        def group(batch):
+            parts = [np.asarray(x, np.int32) for x in batch["ids"]]
+            ids = (np.concatenate(parts) if parts
+                   else np.zeros(0, np.int32))
+            n = len(ids) // chunk
+            return {"ids": ids[: n * chunk].reshape(n, chunk)}
+
+        ds = ds.map(group, batched=True, batch_size=1000,
+                    num_proc=max(cfg.dataset.num_proc, 1),
+                    remove_columns=ds.column_names)
+        if len(ds) * chunk <= cfg.dataset.max_in_memory_tokens:
+            return np.asarray(ds.with_format("numpy")["ids"], np.int32)
+        return _ArrowSamples(ds)
 
     def skip_steps(self, n_steps: int) -> None:
         """Advance the cursor past n_steps global batches (resume support: the
@@ -154,10 +206,12 @@ class MicroBatchDataLoader:
         abs_idx = (self._cursor + self._batch_offsets) % n
         wraps, self._cursor = divmod(self._cursor + M * R, n)
         self._epoch += wraps
-        if native.available():
+        if isinstance(self.samples, np.ndarray) and native.available():
             inp, tgt = native.gather_batch(self.samples, abs_idx)
         else:
-            rows = self.samples[abs_idx]
+            rows = (self.samples[abs_idx]
+                    if isinstance(self.samples, np.ndarray)
+                    else self.samples.gather(abs_idx))
             inp = np.ascontiguousarray(rows[:, :-1])
             tgt = np.ascontiguousarray(rows[:, 1:])
         shape = (M, R, self.seq_length)

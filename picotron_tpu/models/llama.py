@@ -109,6 +109,34 @@ def pp_layer_layout(L: int, pp: int, interleave: int = 1):
     return K, counts, positions
 
 
+def remap_layout(params: Params, L: int, src: tuple,
+                 dst: tuple = (1, 1)) -> Params:
+    """Re-arrange the stacked layer rows of ``params`` from one pipeline
+    layout to another: ``src``/``dst`` are ``(pp_size, interleave)`` pairs
+    as taken by ``pp_layer_layout``. Global layer g moves from row
+    ``src_positions[g]`` to row ``dst_positions[g]``; rows neither layout
+    uses (padding of uneven splits) are zero. The main consumer is eval on
+    interleaved-trained params: ``dst=(1, 1)`` restores the contiguous
+    global order ``forward_logits`` scans, without the checkpoint
+    save/load round-trip previously required."""
+    if tuple(src) == tuple(dst):
+        return params
+    _, _, pos_s = pp_layer_layout(L, *src)
+    K_d, _, pos_d = pp_layer_layout(L, *dst)
+    pp_d = dst[0]
+    src_idx = jnp.asarray(pos_s)
+    dst_idx = jnp.asarray(pos_d)
+
+    def re(v):
+        rows = v[src_idx]  # [L, ...]: real layers in global order
+        if K_d * pp_d == L and pos_d == list(range(L)):
+            return rows  # contiguous unpadded target: pure permutation
+        out = jnp.zeros((K_d * pp_d,) + v.shape[1:], v.dtype)
+        return out.at[dst_idx].set(rows)
+
+    return {**params, "layers": jax.tree.map(re, params["layers"])}
+
+
 def init_params(key, m: ModelConfig, pp_size: int = 1,
                 interleave: int = 1) -> Params:
     """Global (unsharded-shape) parameter pytree. Jit with out_shardings to
@@ -465,7 +493,7 @@ def slice_rope_for_cp(cos, sin, s_local, cfg: Config):
             lax.dynamic_slice_in_dim(sin, start, s_local, 0))
 
 
-def _stage_gating() -> bool:
+def _stage_gating(cfg: Config) -> bool:
     """Whether per-stage embed/loss gating uses ``lax.cond`` (true branch
     executed only on the owning stage) or a compute-both ``jnp.where`` mask.
 
@@ -474,9 +502,19 @@ def _stage_gating() -> bool:
     true here, since the predicate depends only on the 'pp' index and the
     gated collectives reduce over 'tp'. The XLA *CPU* runtime's in-process
     rendezvous, however, intermittently aborts when a collective op is
-    reached by a subset of devices, so the CPU test/dryrun path masks with
-    ``where`` instead (the pre-gating semantics; the FLOP waste only matters
-    on real chips)."""
+    reached by a subset of devices, so the CPU test/dryrun path defaults to
+    masking with ``where`` instead (the pre-gating semantics; the FLOP waste
+    only matters on real chips).
+
+    ``distributed.stage_gating`` overrides the default ("cond"/"where"):
+    forcing "cond" on a CPU mesh lets the equivalence suite run the exact
+    gated program a TPU pod executes — safe when the gated branches carry
+    no collectives (tp=1 pipelines)."""
+    mode = cfg.distributed.stage_gating
+    if mode == "cond":
+        return True
+    if mode == "where":
+        return False
     return on_tpu()
 
 
@@ -492,7 +530,7 @@ def _stage_input(params, h_recv, tokens, cfg: Config, is_first=None):
     if cfg.distributed.pp_size == 1:
         return embed_lookup(params["embed"], tokens, sp).astype(dt)
     pred = (lax.axis_index("pp") == 0) if is_first is None else is_first
-    if _stage_gating():
+    if _stage_gating(cfg):
         return lax.cond(
             pred,
             lambda: embed_lookup(params["embed"], tokens, sp).astype(dt),
@@ -512,7 +550,7 @@ def _stage_loss(params, h, targets, cfg: Config, is_last=None):
     if pp == 1:
         return loss_from_hidden(params, h, targets, cfg)
     pred = (lax.axis_index("pp") == pp - 1) if is_last is None else is_last
-    if _stage_gating():
+    if _stage_gating(cfg):
         return lax.cond(
             pred,
             lambda: loss_from_hidden(params, h, targets, cfg),
@@ -602,7 +640,7 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
                          h_final)
         return vjp(dloss)
 
-    if _stage_gating():
+    if _stage_gating(cfg):
         d_fnorm, d_lmhead, dh_loss = lax.cond(
             pred_last,
             loss_vjp,
@@ -645,7 +683,7 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
             params["embed"])
         return vjp(dh)[0]
 
-    if _stage_gating():
+    if _stage_gating(cfg):
         d_embed = lax.cond(pred_first, embed_vjp,
                            lambda: jnp.zeros_like(params["embed"]))
     else:
@@ -656,26 +694,43 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
     return dparams, dh_prev
 
 
-def forward_logits(params, tokens, cfg: Config, gather: bool = True):
+def forward_logits(params, tokens, cfg: Config, gather: bool = True,
+                   seq_layout: str | None = None):
     """Whole-model forward to logits (no pipeline), for eval/tests. Runs inside
     shard_map; with a 1-device mesh this is the plain single-chip model.
 
     Zigzag layout contract: when ``cfg.distributed.cp_zigzag`` is set, the
     RoPE tables and causal masks follow the zigzag *data* layout, so
     ``tokens`` must already be permuted the way the training loader permutes
-    them (``parallel.cp.zigzag_perm`` applied to the sequence axis), and the
-    returned logits are in that same permuted order — apply
-    ``parallel.cp.zigzag_inverse_perm`` to the sequence axis to get
-    original-order logits. Feeding original-order tokens with cp_zigzag set
-    silently computes with wrong positions/masks."""
-    if cfg.distributed.pp_interleave > 1 and cfg.distributed.pp_size > 1:
-        # the interleaved layout stores layer rows chunk-permuted; this eval
-        # path scans rows in stacked order, which would silently run the
-        # layers out of order — restore the checkpoint under a contiguous
-        # layout (CheckpointManager.load with layout=(L, 1)) to eval
+    them (``parallel.cp.zigzag_perm`` applied to the GLOBAL sequence axis,
+    before any cp sharding), and the returned logits are in that same
+    permuted order — apply ``parallel.cp.zigzag_inverse_perm`` to get
+    original-order logits. The caller acknowledges this by passing
+    ``seq_layout="zigzag"``; a zigzag config without it raises rather than
+    silently computing with wrong positions/masks. (The permutation cannot
+    be applied here: under cp>1 this function sees only a local sequence
+    shard, while the permutation is global.)
+
+    Interleaved layer layouts (pp_interleave > 1) are remapped to the
+    contiguous global order on the fly (``remap_layout`` — a pure row
+    permutation, since interleave requires L % (pp*v) == 0), so
+    interleaved-trained params eval directly."""
+    d = cfg.distributed
+    zig = d.cp_zigzag and d.cp_size > 1
+    if zig and seq_layout != "zigzag":
         raise ValueError(
-            "forward_logits does not support the interleaved layer layout "
-            "(pp_interleave > 1); remap to a contiguous layout first")
+            "this config trains with the zigzag sequence layout "
+            "(cp_zigzag): pass seq_layout='zigzag' after permuting the "
+            "global sequence axis with parallel.cp.zigzag_perm (invert "
+            "logits with zigzag_inverse_perm) — original-order tokens "
+            "would silently get wrong positions/masks")
+    if not zig and seq_layout == "zigzag":
+        raise ValueError(
+            "seq_layout='zigzag' passed but the config does not use the "
+            "zigzag layout (cp_zigzag with cp_size > 1)")
+    if d.pp_interleave > 1 and d.pp_size > 1:
+        params = remap_layout(params, cfg.model.num_hidden_layers,
+                              (d.pp_size, d.pp_interleave))
     cos, sin = rope_tables(cfg)
     dt = jnp.dtype(cfg.model.dtype)
     h = embed_lookup(params["embed"], tokens, use_sp(cfg)).astype(dt)

@@ -47,3 +47,14 @@ def test_decay_steps_ok_for_constant_schedule(tiny_model_kwargs):
     # constant schedule never decays; a small lr_decay_steps is inert
     make_config(tiny_model_kwargs, lr_schedule="constant",
                 lr_warmup_steps=100, lr_decay_steps=50)
+
+
+def test_cond_gating_on_cpu_requires_tp1(tiny_model_kwargs):
+    # gated tp collectives can abort the XLA CPU rendezvous: reject at
+    # load instead of failing intermittently mid-run
+    with pytest.raises(ValueError, match="stage_gating"):
+        make_config(tiny_model_kwargs, pp=2, acc=2, tp=2,
+                    stage_gating="cond")
+    make_config(tiny_model_kwargs, pp=2, acc=2, stage_gating="cond")
+    with pytest.raises(ValueError, match="stage_gating"):
+        make_config(tiny_model_kwargs, stage_gating="bogus")

@@ -66,3 +66,65 @@ def test_local_json_dataset_trains(tiny_model_kwargs, json_corpus):
         tok_b, tgt = ts.shard_batch(next(loader), topo)
         params, opt_state, loss = step(params, opt_state, tok_b, tgt)
     assert np.isfinite(float(loss))
+
+
+def test_num_samples_subsets_raw_documents(tiny_model_kwargs, json_corpus):
+    """training.num_samples selects the first N raw documents before
+    tokenization (reference data.py:34-35) — fewer packed rows result, and
+    a cap above the dataset size is a no-op."""
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg.dataset.name = json_corpus
+    tok = ToyTokenizer(cfg.model.vocab_size)
+    full = MicroBatchDataLoader(cfg, tokenizer=tok)
+    cfg.training.num_samples = 10
+    sub = MicroBatchDataLoader(cfg, tokenizer=tok)
+    # 10 docs x 64 tokens = 640 -> 640 // 33 = 19 packed rows
+    assert len(sub.samples) == (10 * 64) // 33
+    assert len(sub.samples) < len(full.samples)
+    cfg.training.num_samples = 10_000  # above len(dataset): min() applies
+    assert len(MicroBatchDataLoader(cfg, tokenizer=tok).samples) \
+        == len(full.samples)
+
+
+def test_num_samples_caps_synthetic_samples(tiny_model_kwargs):
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg.training.num_samples = 7
+    loader = MicroBatchDataLoader(cfg)
+    assert len(loader.samples) == 7
+
+
+def test_num_samples_validation():
+    from tests.conftest import make_config as mk
+    import pytest as _pytest
+
+    cfg = mk({"num_hidden_layers": 1, "num_attention_heads": 2,
+              "num_key_value_heads": 2, "hidden_size": 16,
+              "intermediate_size": 32, "vocab_size": 64,
+              "max_position_embeddings": 64}, seq=32, mbs=1)
+    cfg.training.num_samples = 0
+    with _pytest.raises(ValueError, match="num_samples"):
+        cfg.validate()
+
+
+def test_corpus_above_memory_cap_stays_arrow_backed(
+        tiny_model_kwargs, json_corpus):
+    """A corpus above dataset.max_in_memory_tokens is served from the
+    arrow cache (disk-mapped), not one host array — and the batches it
+    yields are bitwise identical to the in-memory path's."""
+    from picotron_tpu.data import _ArrowSamples
+
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg.dataset.name = json_corpus
+    tok = ToyTokenizer(cfg.model.vocab_size)
+    mem = MicroBatchDataLoader(cfg, tokenizer=tok)
+    assert isinstance(mem.samples, np.ndarray)
+
+    cfg.dataset.max_in_memory_tokens = 100  # force the arrow path
+    arrow = MicroBatchDataLoader(cfg, tokenizer=tok)
+    assert isinstance(arrow.samples, _ArrowSamples)
+    assert len(arrow.samples) == len(mem.samples)
+    for _ in range(3):  # spans a wrap if the corpus is small enough
+        a, b = next(mem), next(arrow)
+        np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+        np.testing.assert_array_equal(a["target_ids"], b["target_ids"])
+    assert arrow._epoch == mem._epoch

@@ -75,21 +75,63 @@ def test_interleaved_hf_roundtrip(tiny_model_kwargs, tmp_path):
             np.testing.assert_array_equal(got[pos], want[g], err_msg=f"{name}[{g}]")
 
 
-def test_forward_logits_rejects_interleaved_layout(tiny_model_kwargs):
-    """The eval path scans stacked rows in order, so it must refuse the
-    chunk-permuted interleaved layout instead of silently running layers out
-    of order."""
+def test_forward_logits_remaps_interleaved_layout(tiny_model_kwargs):
+    """The eval path scans stacked rows in order; interleaved-trained params
+    are remapped to contiguous global order on the fly (remap_layout), so
+    their logits match the plain-layout model's exactly — no checkpoint
+    save/load round-trip."""
     import jax
-    import pytest
+
+    from picotron_tpu.models import llama
+
+    from jax.sharding import PartitionSpec as P
+
+    from picotron_tpu.topology import topology_from_config
+
+    cfg = make_config(tiny_model_kwargs, pp=2, acc=2, engine="1f1b",
+                      interleave=2)
+    plain = llama.init_params(jax.random.PRNGKey(0), cfg.model)
+    inter = llama.init_params(jax.random.PRNGKey(0), cfg.model, pp_size=2,
+                              interleave=2)
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.model.vocab_size, (1, 32), dtype=np.int32)
+
+    def eval_logits(cfg_x, params):
+        # eval contract: full (replicated) param stack, every device runs
+        # the whole model — forward_logits un-permutes the rows itself
+        topo = topology_from_config(cfg_x)
+        fwd = jax.jit(jax.shard_map(
+            lambda p, t: llama.forward_logits(p, t, cfg_x),
+            mesh=topo.mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))
+        return np.asarray(fwd(params, tokens))
+
+    want = eval_logits(make_config(tiny_model_kwargs), plain)
+    got = eval_logits(cfg, inter)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_remap_layout_roundtrip(tiny_model_kwargs):
+    """remap_layout moves global layer g between any two layouts; an
+    interleaved -> contiguous -> interleaved round trip is the identity."""
+    import jax
 
     from picotron_tpu.models import llama
 
     cfg = make_config(tiny_model_kwargs, pp=2, acc=2, engine="1f1b",
                       interleave=2)
-    params = llama.init_params(jax.random.PRNGKey(0), cfg.model, pp_size=2,
-                               interleave=2)
-    with pytest.raises(ValueError, match="interleaved"):
-        llama.forward_logits(params, np.zeros((1, 32), np.int32), cfg)
+    L = cfg.model.num_hidden_layers
+    inter = llama.init_params(jax.random.PRNGKey(1), cfg.model, pp_size=2,
+                              interleave=2)
+    plain = llama.remap_layout(inter, L, (2, 2), (1, 1))
+    want = llama.init_params(jax.random.PRNGKey(1), cfg.model)
+    for k in plain["layers"]:
+        np.testing.assert_array_equal(np.asarray(plain["layers"][k]),
+                                      np.asarray(want["layers"][k]), k)
+    back = llama.remap_layout(plain, L, (1, 1), (2, 2))
+    for k in back["layers"]:
+        np.testing.assert_array_equal(np.asarray(back["layers"][k]),
+                                      np.asarray(inter["layers"][k]), k)
 
 
 def test_interleaved_checkpoint_cross_layout(tiny_model_kwargs, tmp_path):

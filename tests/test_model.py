@@ -93,11 +93,11 @@ def test_forward_logits_zigzag_layout_roundtrip(cfg_factory):
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, 256, (2, seq)), jnp.int32)
 
-    def logits_for(cfg, toks):
+    def logits_for(cfg, toks, **fwd_kw):
         topo = topology_from_config(cfg)
         params, _ = ts.init_state(cfg, topo)
         fwd = jax.jit(jax.shard_map(
-            lambda p, t: llama.forward_logits(p, t, cfg),
+            lambda p, t: llama.forward_logits(p, t, cfg, **fwd_kw),
             mesh=topo.mesh,
             in_specs=(llama.param_pspecs(cfg.model), P(None, "cp")),
             out_specs=P(None, "cp"),
@@ -109,8 +109,17 @@ def test_forward_logits_zigzag_layout_roundtrip(cfg_factory):
     cfg_z = cfg_factory(cp=2, zigzag=True, seq=seq, mbs=2)
     perm = zigzag_perm(seq, 2)
     inv = zigzag_inverse_perm(seq, 2)
-    zig = logits_for(cfg_z, tokens[:, perm])
+    zig = logits_for(cfg_z, tokens[:, perm], seq_layout="zigzag")
     np.testing.assert_allclose(zig[:, inv], ref, rtol=2e-5, atol=2e-5)
+
+    # the contract is LOUD: a zigzag config without the acknowledgement
+    # raises instead of silently computing with wrong positions/masks,
+    # and claiming zigzag on a non-zigzag config is equally an error
+    with pytest.raises(ValueError, match="zigzag"):
+        logits_for(cfg_z, tokens[:, perm])
+    with pytest.raises(ValueError, match="zigzag"):
+        logits_for(cfg_factory(seq=seq, mbs=2), tokens,
+                   seq_layout="zigzag")
 
 
 @pytest.mark.slow

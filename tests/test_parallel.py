@@ -80,6 +80,14 @@ TOPOLOGIES = [
     dict(pp=2, acc=2, engine="1f1b", interleave=2),
     dict(pp=2, acc=4, engine="1f1b", interleave=2),
     dict(dp=2, pp=2, tp=2, acc=2, engine="1f1b", interleave=2),
+    # lax.cond stage gating — the program a real TPU pod runs (the default
+    # only cond-gates on TPU; forcing it here runs that exact structure on
+    # the CPU mesh, safe because tp=1 gated branches carry no collectives).
+    # Both engines: 1f1b exercises the manual stage_bwd conds, afab the AD
+    # engine's stage_apply conds.
+    dict(pp=2, acc=2, engine="1f1b", stage_gating="cond"),
+    dict(pp=4, acc=4, engine="afab", stage_gating="cond"),
+    dict(pp=2, acc=4, engine="1f1b", interleave=2, stage_gating="cond"),
 ]
 
 
