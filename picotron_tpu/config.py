@@ -71,6 +71,17 @@ class DistributedConfig:
     # hidden_size % dp == 0; mutually exclusive with zero1 (redundant —
     # FSDP already shards the stack's state). Beyond-parity feature.
     fsdp: bool = False
+    # Build the training step under shard_map's varying-manual-axes checker
+    # (jax check_vma): every replicated-vs-varying typing error — the class
+    # of bug the equivalence suite can only catch dynamically — becomes a
+    # static trace-time error. DIAGNOSTIC mode, not the production default:
+    # the checker auto-inserts pvary casts whose AD transposes are real
+    # psums, which resequences reductions (loss trajectories drift at the
+    # 1e-4..1e-2 level on zero1/fsdp) and deadlocks inside lax.cond-gated
+    # stage branches. Incompatible with pp_engine='afab' (jax's scan
+    # transpose does not yet type vma — upstream limitation) and with
+    # cond stage gating (collectives inside single-stage branches).
+    check_vma: bool = False
     # How per-stage embed/loss work is gated to its owning pipeline stage
     # (models/llama.py::_stage_gating): "cond" = lax.cond, the branch only
     # runs on the owning stage (what production TPU pipelines execute);
@@ -308,6 +319,21 @@ class Config:
         if d.stage_gating not in ("auto", "cond", "where"):
             raise ValueError(
                 f"unknown stage_gating {d.stage_gating!r} (auto|cond|where)")
+        if d.check_vma:
+            if d.pp_engine == "afab" and d.pp_size > 1:
+                raise ValueError(
+                    "check_vma=True is incompatible with pp_engine='afab': "
+                    "jax's scan transpose does not type varying manual axes "
+                    "yet (differentiating the forward pipeline trips it); "
+                    "use the 1f1b engine or turn the checker off")
+            if d.pp_size > 1 and (
+                    d.stage_gating == "cond"
+                    or (d.stage_gating == "auto" and not d.use_cpu)):
+                raise ValueError(
+                    "check_vma=True is incompatible with lax.cond stage "
+                    "gating (the checker's auto-inserted pvary transposes "
+                    "put real psums inside single-stage branches, which "
+                    "deadlocks); set stage_gating='where'")
         if d.stage_gating == "cond" and d.use_cpu and d.tp_size > 1:
             # the gated branches carry tp collectives, and the XLA CPU
             # runtime's rendezvous intermittently aborts when a collective

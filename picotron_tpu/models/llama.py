@@ -54,7 +54,12 @@ from picotron_tpu.parallel.tp import (
     tp_gather,
     tp_reduce,
 )
-from picotron_tpu.utils import on_tpu
+from picotron_tpu.utils import (
+    on_tpu,
+    pvary_like,
+    scan_carry_fixpoint,
+    vma_checking,
+)
 
 Params = dict[str, Any]
 
@@ -427,6 +432,8 @@ def layers_forward(stacked, h, cos, sin, cfg: Config):
                 names_which_can_be_saved=[],
                 names_which_can_be_offloaded=list(OFFLOAD_NAMES),
                 offload_src="device", offload_dst="pinned_host"))
+    if vma_checking("pp"):
+        h = scan_carry_fixpoint(body, h, jax.tree.map(lambda a: a[0], xs))
     h, _ = lax.scan(body, h, xs)
     return h
 
@@ -531,6 +538,10 @@ def _stage_input(params, h_recv, tokens, cfg: Config, is_first=None):
         return embed_lookup(params["embed"], tokens, sp).astype(dt)
     pred = (lax.axis_index("pp") == 0) if is_first is None else is_first
     if _stage_gating(cfg):
+        # no vma casts here: cond gating + check_vma is rejected at config
+        # validation (the checker's auto-inserted pvary transposes put real
+        # psums inside single-stage branches), so this path never runs
+        # under the checker
         return lax.cond(
             pred,
             lambda: embed_lookup(params["embed"], tokens, sp).astype(dt),
@@ -551,6 +562,7 @@ def _stage_loss(params, h, targets, cfg: Config, is_last=None):
         return loss_from_hidden(params, h, targets, cfg)
     pred = (lax.axis_index("pp") == pp - 1) if is_last is None else is_last
     if _stage_gating(cfg):
+        # cond gating + check_vma is rejected at validation; no casts here
         return lax.cond(
             pred,
             lambda: loss_from_hidden(params, h, targets, cfg),
@@ -595,12 +607,16 @@ def stage_fwd_save(params, h_recv, tokens, targets, cos, sin, cfg: Config,
     if valid is None:
         def body(h, lp):
             return decoder_layer(lp, h, cos_l, sin_l, cfg), h
-        h_final, layer_inputs = lax.scan(body, h, params["layers"])
+        scan_xs = params["layers"]
     else:
         def body(h, xs):
             lp, v = xs
             return jnp.where(v, decoder_layer(lp, h, cos_l, sin_l, cfg), h), h
-        h_final, layer_inputs = lax.scan(body, h, (params["layers"], valid))
+        scan_xs = (params["layers"], valid)
+    if vma_checking("pp"):
+        h = scan_carry_fixpoint(
+            body, h, jax.tree.map(lambda a: a[0], scan_xs))
+    h_final, layer_inputs = lax.scan(body, h, scan_xs)
     loss = _stage_loss(params, h_final, targets, cfg, is_last)
     # h_final IS buffered (not rederived from layer_inputs[-1] inside the
     # last-stage cond in stage_bwd): with cp>1 the rederiving decoder_layer
@@ -636,11 +652,15 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
                                 targets, cfg)
 
     def loss_vjp():
-        _, vjp = jax.vjp(loss_head, params["final_norm"], params["lm_head"],
-                         h_final)
-        return vjp(dloss)
+        out, vjp = jax.vjp(loss_head, params["final_norm"], params["lm_head"],
+                           h_final)
+        # vma cast: the schedule's dloss mask is built from pp-index
+        # predicates only; the cotangent type must match the primal loss
+        # (check_vma)
+        return vjp(pvary_like(dloss, out))
 
     if _stage_gating(cfg):
+        # cond gating + check_vma is rejected at validation; no casts here
         d_fnorm, d_lmhead, dh_loss = lax.cond(
             pred_last,
             loss_vjp,
@@ -678,12 +698,16 @@ def stage_bwd(params, saved, tokens, targets, dh_out, dloss, cos, sin,
 
     # ---- embedding backward (first stage only)
     def embed_vjp():
-        _, vjp = jax.vjp(
+        # vma cast on w: dh carries the schedule's pp-varying type while
+        # the embed output would not, and a vjp cotangent must match its
+        # primal exactly (check_vma); numerically the identity
+        out, vjp = jax.vjp(
             lambda w: embed_lookup(w, tokens, use_sp(cfg)).astype(dt),
-            params["embed"])
-        return vjp(dh)[0]
+            pvary_like(params["embed"], dh))
+        return vjp(pvary_like(dh, out))[0]
 
     if _stage_gating(cfg):
+        # cond gating + check_vma is rejected at validation; no casts here
         d_embed = lax.cond(pred_first, embed_vjp,
                            lambda: jnp.zeros_like(params["embed"]))
     else:

@@ -19,11 +19,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from picotron_tpu.utils import pvary_like
+
 
 def cross_entropy_gathered(logits_local, targets, tp_axis: str = "tp"):
     """logits_local: [B, S, V/tp] shard; targets: [B, S] global token ids.
     Returns mean loss (float32 scalar)."""
-    logits = jax.lax.all_gather(logits_local, tp_axis, axis=-1, tiled=True)
+    # invariant-typed under the vma checker (keeps the loss and its h
+    # cotangent tp-invariant), the plain public gather otherwise — see
+    # parallel.tp.all_gather_dim_invariant
+    from picotron_tpu.parallel.tp import all_gather_dim_invariant
+
+    logits = all_gather_dim_invariant(logits_local, tp_axis, -1)
     logits = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
@@ -125,7 +132,9 @@ def _fused_fwd_impl(x, w, targets, tp_axis, chunk_rows):
         logz, tl = _chunk_logz(x_c, w, t_c, tp_axis)
         return acc + jnp.sum((logz - tl) * m_c), logz
 
-    total, logz_all = lax.scan(body, jnp.zeros((), jnp.float32), (xc, tc, mc))
+    total, logz_all = lax.scan(
+        body, pvary_like(jnp.zeros((), jnp.float32), x, w, targets),
+        (xc, tc, mc))
     return total / T, logz_all.reshape(-1)
 
 
@@ -162,7 +171,9 @@ def _fused_bwd(tp_axis, chunk_rows, res, g):
             preferred_element_type=jnp.float32)
         return dw_acc, dx_c.astype(x.dtype)
 
-    dw, dxc = lax.scan(body, jnp.zeros(w.shape, jnp.float32), (xc, tc, mc, lzc))
+    dw, dxc = lax.scan(
+        body, pvary_like(jnp.zeros(w.shape, jnp.float32), x, w, targets, g),
+        (xc, tc, mc, lzc))
     dx = dxc.reshape(-1, H)[:T].reshape(x.shape)
     dt = np.zeros(targets.shape, jax.dtypes.float0)
     return dx, dw.astype(w.dtype), dt

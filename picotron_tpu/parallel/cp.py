@@ -41,7 +41,7 @@ from jax import lax
 
 from picotron_tpu.ops.attention import NEG_INF, block_attention
 from picotron_tpu.comm_trace import log as _trace
-from picotron_tpu.utils import collective_scan_unroll
+from picotron_tpu.utils import collective_scan_unroll, pvary_like
 
 
 def chunk_positions(idx, s_local: int, n: int, zigzag: bool):
@@ -198,8 +198,10 @@ def _ring_fwd_impl(q, k, v, scale, axis, n, causal, use_flash, zigzag,
             f"ring_attention: q heads ({h}) must be a multiple of kv heads "
             f"({k.shape[2]})")
     g = h // k.shape[2]  # GQA group size; the ring carries Hkv-head chunks
-    out0 = jnp.zeros((b, s, h, d), jnp.float32)
-    lse0 = jnp.full((b, s, h), NEG_INF, jnp.float32)
+    # vma cast: the accumulators absorb q@k terms, so the scan carry must
+    # enter varying over everything q/k/v vary over (check_vma)
+    out0 = pvary_like(jnp.zeros((b, s, h, d), jnp.float32), q, k, v)
+    lse0 = pvary_like(jnp.full((b, s, h), NEG_INF, jnp.float32), q, k, v)
 
     def step(carry, t):
         kv, out, lse = carry
@@ -311,10 +313,11 @@ def _ring_bwd(scale, axis, n, causal, use_flash, zigzag, block_q, block_k,
     # 6-step derivation, context_parallel.py:130-155)
     D = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
-    dq0 = jnp.zeros((b, s, h, d), jnp.float32)
+    dq0 = pvary_like(jnp.zeros((b, s, h, d), jnp.float32), q, k, v, dout)
     hkv = h // g
-    dkv0 = (jnp.zeros((b, s, hkv, d), jnp.float32),
-            jnp.zeros((b, s, hkv, d), jnp.float32))
+    dkv0 = pvary_like((jnp.zeros((b, s, hkv, d), jnp.float32),
+                       jnp.zeros((b, s, hkv, d), jnp.float32)),
+                      q, k, v, dout)
 
     def step(carry, t):
         kv, dkv, dq = carry

@@ -45,11 +45,27 @@ import jax.numpy as jnp
 from jax import lax
 
 from picotron_tpu.comm_trace import log as _trace
-from picotron_tpu.utils import collective_scan_unroll
+from picotron_tpu.utils import (
+    collective_scan_unroll,
+    pvary_like,
+    scan_carry_fixpoint,
+    vma_checking,
+)
 
 
 def _take_mb(arr, i):
     return lax.dynamic_index_in_dim(arr, i, 0, keepdims=False)
+
+
+def _carry_fixpoint(body, carry):
+    """Cast a tick-scan carry to ``body``'s vma fix-point (shard_map
+    ``check_vma``) — see ``utils.scan_carry_fixpoint``. Skipped entirely
+    on the checker-off production build: the extra abstract trace of the
+    full fwd+bwd tick would buy casts that are provable no-ops there."""
+    if not vma_checking("pp"):
+        return carry
+    return scan_carry_fixpoint(lambda c, t: (body(c, t), None), carry,
+                               jnp.int32(0))
 
 
 def _down_perm(pp):  # stage s -> s+1; stage 0 receives zeros
@@ -87,8 +103,10 @@ def no_pipeline(stage_fn, params, tokens, targets, h_shape, h_dtype,
     # unroll on CPU: the stage body can contain ring-attention ppermutes,
     # which race across scan iterations in the XLA CPU runtime
     # (utils.collective_scan_unroll)
-    (gacc, loss_acc), _ = lax.scan(body, (gacc0, jnp.float32(0.0)),
-                                   (tokens, targets),
+    carry0 = _carry_fixpoint(
+        lambda c, _t: body(c, (_take_mb(tokens, 0), _take_mb(targets, 0)))[0],
+        (gacc0, jnp.float32(0.0)))
+    (gacc, loss_acc), _ = lax.scan(body, carry0, (tokens, targets),
                                    unroll=collective_scan_unroll())
     grads = jax.tree.map(lambda g: g / M, gacc)
     return loss_acc / M, grads
@@ -111,7 +129,8 @@ def pipeline_afab_loss(stage_fn, params, tokens, targets, pp_size, h_shape, h_dt
         h_next = lax.ppermute(h_out, "pp", perm) if perm else jnp.zeros_like(h_out)
         return h_next, contrib
 
-    h0 = jnp.zeros(h_shape, h_dtype)
+    h0 = _carry_fixpoint(lambda c, t: tick(c, t)[0],
+                         jnp.zeros(h_shape, h_dtype))
     _, contribs = lax.scan(tick, h0, jnp.arange(T), unroll=collective_scan_unroll())
     return lax.psum(jnp.sum(contribs), "pp") / M
 
@@ -296,6 +315,7 @@ def pipeline_1f1b_interleaved(stage_fwd, stage_bwd, params, tokens, targets,
         return (h_recv, dh_next, sbuf, gacc, loss_acc)
 
     carry = (h0, jnp.zeros(h_shape, h_dtype), sbuf0, gacc0, jnp.float32(0.0))
+    carry = _carry_fixpoint(_full_tick(fwd_half, bwd_half), carry)
     carry = _scan_phase(carry, range(OFF), fwd_half)
     carry = _scan_phase(carry, range(OFF, N + pp_size - 1),
                         _full_tick(fwd_half, bwd_half))
@@ -399,6 +419,7 @@ def pipeline_1f1b(stage_fwd, stage_bwd, params, tokens, targets, pp_size,
     # reference's fused send-fwd/recv-bwd pairs (pp_communications.py:34-46);
     # XLA schedules the two permutes of a steady tick together.
     carry = (h0, jnp.zeros(h_shape, h_dtype), sbuf0, gacc0, jnp.float32(0.0))
+    carry = _carry_fixpoint(_full_tick(fwd_half, bwd_half), carry)
     carry = _scan_phase(carry, range(pp_size - 1), fwd_half)
     carry = _scan_phase(carry, range(pp_size - 1, M + pp_size - 1),
                         _full_tick(fwd_half, bwd_half))

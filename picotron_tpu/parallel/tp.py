@@ -94,6 +94,23 @@ def all_gather_dim(x, axis: str, dim: int):
     return lax.all_gather(x, axis, axis=dim, tiled=True)
 
 
+def all_gather_dim_invariant(x, axis: str, dim: int):
+    """``all_gather_dim`` whose result is TYPED replicated over ``axis``
+    under shard_map's varying-axes checker — every rank contributes its
+    shard and receives the same whole, and there is no legal demotion from
+    a varying-typed plain gather. Falls back to the plain gather when the
+    trace is not vma-typed (the invariant primitive's vjp demands
+    vma-typed operands and fails on a checker-off build). Single home for
+    the jax-internal import: consumers are the ZeRO-1 param unsplit
+    (train_step) and the gathered CE loss (ops/cross_entropy)."""
+    if axis in jax.typeof(x).vma:
+        from jax._src.lax.parallel import all_gather_invariant
+
+        _trace("all_gather", axis, x, extra=f"dim={dim} invariant")
+        return all_gather_invariant(x, axis, axis=dim, tiled=True)
+    return all_gather_dim(x, axis, dim)
+
+
 def reduce_scatter_dim(x, axis: str, dim: int):
     """Tiled reduce-scatter along array dimension ``dim`` over mesh axis
     ``axis``. Public building block shared by the SP collectives and the

@@ -40,7 +40,11 @@ from picotron_tpu.parallel.pp import (
     pipeline_1f1b_interleaved,
     pipeline_afab,
 )
-from picotron_tpu.parallel.tp import all_gather_dim, reduce_scatter_dim
+from picotron_tpu.parallel.tp import (
+    all_gather_dim,
+    all_gather_dim_invariant,
+    reduce_scatter_dim,
+)
 from picotron_tpu.topology import Topology, batch_pspec, named_shardings
 
 
@@ -160,8 +164,11 @@ def _zero1_slice(p, dp):
 
 
 def _zero1_unsplit(chunk, like):
-    """All-gather updated chunks over 'dp' back into the full local block."""
-    full = all_gather_dim(chunk, "dp", 0)
+    """All-gather updated chunks over 'dp' back into the full local block.
+    The invariant-typed gather is what lets the updated params flow back
+    out through dp-less out_specs under ``check_vma``; on the checker-off
+    build it is the plain public all_gather (see all_gather_dim_invariant)."""
+    full = all_gather_dim_invariant(chunk, "dp", 0)
     return full[: like.size].reshape(like.shape)
 
 
@@ -253,7 +260,7 @@ def init_state(cfg: Config, topo: Topology, seed: int | None = None):
             jax.tree.map(partial(_zero1_slice, dp=cfg.distributed.dp_size), p))
         opt_state = jax.jit(jax.shard_map(
             init_fn, mesh=topo.mesh, in_specs=(pspecs,), out_specs=ospecs,
-            check_vma=False))(params)
+            check_vma=cfg.distributed.check_vma))(params)
         return params, opt_state
 
     optimizer = build_optimizer(cfg)
@@ -394,18 +401,29 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
 
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-        loss = lax.pmean(loss, ("dp", "cp"))  # logging mean (utils.py:93-98)
+        # logging mean over the data axes (utils.py:93-98). Any pp/tp axis
+        # the loss is still TYPED varying over joins the mean as a
+        # value-identity replication certificate (the loss is replicated
+        # over them by pipeline-psum / CE semantics; a single pmean cannot
+        # mix varying and invariant axes, hence the vma-driven set). With
+        # the checker off the vma is empty and this is the plain dp x cp
+        # mean.
+        extra = tuple(a for a in ("pp", "tp") if a in jax.typeof(loss).vma)
+        loss = lax.pmean(loss, ("dp", "cp") + extra)
         return params, opt_state, loss
 
-    # check_vma=False: the model mixes replicated inputs with axis_index-derived
-    # values (stage/cp masks), which the varying-axes checker would require
-    # explicit pcasts for at every scan carry; replication correctness is
-    # covered by the parallel-vs-single-device equivalence tests instead.
+    # The varying-axes checker (distributed.check_vma) is off by default:
+    # it is the static-protection DIAGNOSTIC mode (see the config field's
+    # rationale — the checker's auto-inserted collectives resequence
+    # reductions). The scan carries / cond branches / vjp cotangents all
+    # carry explicit vma casts (utils.pvary_like, scan_carry_fixpoint) so
+    # that flipping it on is a pure config change; tests/test_check_vma.py
+    # builds and runs the step under the checker across topologies.
     step = jax.shard_map(
         _step, mesh=mesh,
         in_specs=(pspecs, ospecs, bspec, bspec),
         out_specs=(pspecs, ospecs, P()),
-        check_vma=False,
+        check_vma=cfg.distributed.check_vma,
     )
     if multi_step == 1:
         return jax.jit(step, donate_argnums=(0, 1))

@@ -115,6 +115,60 @@ def device_memory_gb(device=None) -> float | None:
     return best
 
 
+def pvary_like(x, *refs):
+    """Cast every leaf of ``x`` to be VARYING over the union of the
+    ``refs``' varying mesh axes, for shard_map's varying-manual-axes
+    checker (``check_vma=True``). A pure type cast, numerically the
+    identity, and a no-op where the leaf already varies. Needed where a
+    replicated literal (a ``jnp.zeros`` scan carry, a masked fill) meets
+    axis-varying values: the checker would otherwise reject the scan
+    carry as replicated-in/varying-out."""
+    import jax
+    from jax import lax
+
+    target = frozenset().union(
+        *[jax.typeof(r).vma for r in jax.tree.leaves(refs)])
+
+    def cast(v):
+        need = tuple(sorted(target - jax.typeof(v).vma))
+        return lax.pcast(v, need, to="varying") if need else v
+
+    return jax.tree.map(cast, x)
+
+
+def vma_checking(axis: str) -> bool:
+    """Whether shard_map's varying-manual-axes checker is typing the
+    current trace: a fresh ``axis_index`` is vma-typed iff it is. Used to
+    skip the checker-only eval_shape passes (scan-carry fixpoints) on the
+    production (``check_vma=False``) build, where every vma is empty and
+    the casts are provable no-ops."""
+    import jax
+    from jax import lax
+
+    return bool(jax.typeof(lax.axis_index(axis)).vma)
+
+
+def scan_carry_fixpoint(body, carry, x_example):
+    """Cast a ``lax.scan`` carry to the varying-manual-axes fix-point of
+    ``body(carry, x) -> (carry, y)`` under shard_map's ``check_vma``: a
+    replicated init meeting axis-varying values inside the body must enter
+    the scan already typed with the body's output vma. Numerically the
+    identity; converges in a few ``eval_shape`` passes (vma only grows);
+    a no-op when the checker is off (every vma is empty). Casting to the
+    fix-point (rather than some outer upper bound) matters: over-casting
+    leaks spurious varying axes into downstream cotangents."""
+    import jax
+
+    for _ in range(4):
+        out = jax.eval_shape(lambda c: body(c, x_example)[0], carry)
+        new = jax.tree.map(pvary_like, carry, out)
+        if [jax.typeof(a).vma for a in jax.tree.leaves(new)] == \
+           [jax.typeof(a).vma for a in jax.tree.leaves(carry)]:
+            return new
+        carry = new
+    return carry
+
+
 def collective_scan_unroll():
     """Workaround for an XLA CPU runtime race: InProcessCommunicator's
     rendezvous for collective-permutes inside While loops can admit

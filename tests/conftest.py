@@ -48,14 +48,14 @@ def tiny_model_kwargs():
 def make_config(tiny_model_kwargs, dp=1, pp=1, cp=1, tp=1, seq=32, mbs=2, acc=1,
                 engine="1f1b", dtype=None, zigzag=False, sp=False, zero1=False,
                 cp_impl="ring", interleave=1, fsdp=False, stage_gating="auto",
-                **overrides) -> Config:
+                check_vma=False, **overrides) -> Config:
     raw = {
         "distributed": {"dp_size": dp, "pp_size": pp, "cp_size": cp, "tp_size": tp,
                         "pp_engine": engine, "use_cpu": True,
                         "cp_zigzag": zigzag, "tp_sequence_parallel": sp,
                         "zero1": zero1, "cp_impl": cp_impl,
                         "pp_interleave": interleave, "fsdp": fsdp,
-                        "stage_gating": stage_gating},
+                        "stage_gating": stage_gating, "check_vma": check_vma},
         "model": dict(tiny_model_kwargs, **({"dtype": dtype} if dtype else {})),
         "training": {**dict(seq_length=seq, micro_batch_size=mbs,
                             gradient_accumulation_steps=acc,
