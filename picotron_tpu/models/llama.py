@@ -522,6 +522,14 @@ def _stage_gating(cfg: Config) -> bool:
         return True
     if mode == "where":
         return False
+    # "auto": a config that REQUESTS the CPU mesh (use_cpu) resolves to
+    # where-masking regardless of what the default backend happens to be —
+    # on_tpu() sniffs the process-global backend, which on a TPU host would
+    # otherwise cond-gate a run that is actually executing on host devices
+    # (and config.validate's check_vma guard predicts resolution from
+    # use_cpu, so this keeps validation and resolution aligned).
+    if cfg.distributed.use_cpu:
+        return False
     return on_tpu()
 
 

@@ -47,7 +47,6 @@ from jax import lax
 from picotron_tpu.comm_trace import log as _trace
 from picotron_tpu.utils import (
     collective_scan_unroll,
-    pvary_like,
     scan_carry_fixpoint,
     vma_checking,
 )

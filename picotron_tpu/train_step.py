@@ -41,7 +41,6 @@ from picotron_tpu.parallel.pp import (
     pipeline_afab,
 )
 from picotron_tpu.parallel.tp import (
-    all_gather_dim,
     all_gather_dim_invariant,
     reduce_scatter_dim,
 )

@@ -68,6 +68,7 @@ FSDP_TOPOLOGIES = [
     dict(dp=2, pp=2, acc=2, engine="1f1b", fsdp=True),
     dict(dp=2, pp=2, acc=2, engine="afab", fsdp=True),
     dict(dp=2, pp=2, acc=2, engine="1f1b", interleave=2, fsdp=True),
+    dict(dp=2, cp=2, zigzag=True, fsdp=True),
 ]
 
 

@@ -128,3 +128,22 @@ def test_corpus_above_memory_cap_stays_arrow_backed(
         np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
         np.testing.assert_array_equal(a["target_ids"], b["target_ids"])
     assert arrow._epoch == mem._epoch
+
+
+def test_arrow_loader_skip_steps_matches_memory(tiny_model_kwargs,
+                                                json_corpus):
+    """Resume support on the arrow-backed path: skip_steps must land the
+    cursor (and epoch) exactly where the in-memory loader lands it, and
+    the post-skip batches must be bitwise identical."""
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg.dataset.name = json_corpus
+    tok = ToyTokenizer(cfg.model.vocab_size)
+    mem = MicroBatchDataLoader(cfg, tokenizer=tok)
+    cfg.dataset.max_in_memory_tokens = 100
+    arrow = MicroBatchDataLoader(cfg, tokenizer=tok)
+    mem.skip_steps(7)
+    arrow.skip_steps(7)
+    assert arrow._cursor == mem._cursor and arrow._epoch == mem._epoch
+    a, b = next(mem), next(arrow)
+    np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+    np.testing.assert_array_equal(a["target_ids"], b["target_ids"])

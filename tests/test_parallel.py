@@ -88,6 +88,9 @@ TOPOLOGIES = [
     dict(pp=2, acc=2, engine="1f1b", stage_gating="cond"),
     dict(pp=4, acc=4, engine="afab", stage_gating="cond"),
     dict(pp=2, acc=4, engine="1f1b", interleave=2, stage_gating="cond"),
+    # cond gating x ring CP: the ring ppermutes live outside the gated
+    # branches, so tp=1 stays collective-free inside conds even with cp>1
+    dict(pp=2, cp=2, acc=2, engine="1f1b", stage_gating="cond"),
 ]
 
 

@@ -90,3 +90,10 @@ def test_zero1_param_dtype_accum_bf16(cfg_factory):
     got = run_losses(cfg_factory(**kw, zero1=True), steps=6)
     np.testing.assert_allclose(got, base, rtol=0.02, atol=0.02)
     assert min(base[-3:]) < base[0], f"did not trend down: {base}"
+
+
+def test_zero1_with_zigzag_cp(cfg_factory):
+    base = run_losses(cfg_factory(dp=2, cp=2, zigzag=True, seq=32, mbs=4))
+    got = run_losses(cfg_factory(dp=2, cp=2, zigzag=True, seq=32, mbs=4,
+                                 zero1=True))
+    np.testing.assert_allclose(got, base, rtol=2e-5, atol=2e-5)
