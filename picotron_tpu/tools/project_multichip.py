@@ -25,7 +25,8 @@ Conservatism policy (each choice biases MFU_proj DOWN):
 - PP p2p boundary activations are tiny but charged fully exposed.
 
 Anchors (single-chip, measured on the v5e, docs/BENCH_7B.md; re-anchor when
-the driver captures BENCH_r04):
+any round's bench capture lands — still pending as of r05, see
+docs/PROJECTION.md status note):
 - SmolLM-1.7B @ seq 2048: 55.3% MFU
 - Llama-2-7B-geometry proxy @ seq 4096: 66.5% MFU
 """
