@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench clean
+.PHONY: native test test-all test-isolated bench decode-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -29,6 +29,12 @@ test-isolated: native
 
 bench: native
 	python bench.py
+
+# Serving-path smoke: tiny-model CPU generate through the full
+# prefill/KV-cache/batcher/CLI stack (picotron_tpu/inference) — seconds,
+# no checkpoint or network needed.
+decode-smoke:
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke
 
 clean:
 	rm -rf picotron_tpu/native/_build

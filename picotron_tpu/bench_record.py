@@ -21,6 +21,7 @@ import json
 BENCH_METRICS = {
     "bench": "smollm_1.7b_mfu_1chip",
     "bench_7b": "llama2_7b_proxy_mfu_1chip",
+    "bench_decode": "smollm_1.7b_decode_toks_s_chip",
 }
 
 

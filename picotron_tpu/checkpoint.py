@@ -67,6 +67,52 @@ def _layout3(layout):
             int(layout[2]) if len(layout) > 2 else 1)
 
 
+def _is_stacked(path) -> bool:
+    """Whether a tree path lies under the stacked-layer subtree."""
+    return any(
+        getattr(k, "key", getattr(k, "name", None)) == "layers"
+        for k in path)
+
+
+def _as_abstract(tree, remap):
+    """ShapeDtypeStructs for restoring ``tree``: stacked-layer leaves take
+    the SAVED layout's row count when a remap is pending (restored to host,
+    re-laid-out, then placed)."""
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+    flat, treedef = tree_flatten_with_path(tree)
+    out = []
+    for path, x in flat:
+        if remap is not None and _is_stacked(path):
+            out.append(jax.ShapeDtypeStruct(
+                (remap[0],) + tuple(x.shape[1:]), x.dtype))
+        else:
+            out.append(jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)))
+    return tree_unflatten(treedef, out)
+
+
+def _remap_tree(tree, like, remap):
+    """Move each stacked-layer leaf's real rows from the saved layout's
+    positions to the restoring layout's, then place against ``like``'s
+    shardings."""
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+    src_rows, src_pos, tgt_pos = remap
+    flat, treedef = tree_flatten_with_path(tree)
+    like_leaves = jax.tree.leaves(like)
+    out = []
+    for (path, x), ref in zip(flat, like_leaves):
+        if _is_stacked(path):
+            a = np.asarray(jax.device_get(x))
+            dst = np.zeros((ref.shape[0],) + a.shape[1:], a.dtype)
+            dst[np.asarray(tgt_pos)] = a[np.asarray(src_pos)]
+            sh = getattr(ref, "sharding", None)
+            x = jax.device_put(dst, sh) if sh is not None else jnp.asarray(dst)
+        out.append(x)
+    return tree_unflatten(treedef, out)
+
+
 class CheckpointManager:
     """Save/resume of (params, opt_state, step, tokens).
 
@@ -147,25 +193,8 @@ class CheckpointManager:
         restores (all even splits share the [L] layout) take the direct
         sharded path."""
         ocp = self._ocp
-        self.manager.wait_until_finished()  # an in-flight async save is not readable
-        step = self.manager.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint found in {self.directory}")
-
-        meta = self._read_meta(step)
-        remap = None
-        if layout is not None and "num_hidden_layers" in meta:
-            src = (int(meta["num_hidden_layers"]), int(meta["pp_size"]),
-                   int(meta.get("pp_interleave", 1)))
-            layout = _layout3(layout)
-            if src[0] != layout[0]:
-                raise ValueError(
-                    f"checkpoint has {src[0]} layers, config wants "
-                    f"{layout[0]}")
-            src_rows, src_pos = _padded_layout(*src)
-            tgt_rows, tgt_pos = _padded_layout(*layout)
-            if src_rows != tgt_rows or src_pos != tgt_pos:
-                remap = (src_rows, src_pos, tgt_pos)
+        step, meta = self._resolve_step(step)
+        remap = self._resolve_remap(meta, layout)
 
         # ZeRO-1 guard: the dp-chunked optimizer state is dp-specific (leaf
         # shapes = dp * ceil(n_local/dp)) and a 1-D chunk cannot go through
@@ -190,62 +219,74 @@ class CheckpointManager:
                 "checkpoint: the optimizer state is stored as flat dp chunks; "
                 "restore under the saving run's (num_hidden_layers, pp_size)")
 
-        def is_stacked(path) -> bool:
-            return any(
-                getattr(k, "key", getattr(k, "name", None)) == "layers"
-                for k in path)
-
-        def as_abstract(tree):
-            from jax.tree_util import tree_flatten_with_path, tree_unflatten
-
-            flat, treedef = tree_flatten_with_path(tree)
-            out = []
-            for path, x in flat:
-                if remap is not None and is_stacked(path):
-                    # restore the saved (source-layout) shape on host
-                    out.append(jax.ShapeDtypeStruct(
-                        (remap[0],) + tuple(x.shape[1:]), x.dtype))
-                else:
-                    out.append(jax.ShapeDtypeStruct(
-                        x.shape, x.dtype,
-                        sharding=getattr(x, "sharding", None)))
-            return tree_unflatten(treedef, out)
-
         restored = self.manager.restore(
             step,
             args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(as_abstract(params_like)),
-                opt_state=ocp.args.StandardRestore(as_abstract(opt_state_like)),
+                params=ocp.args.StandardRestore(
+                    _as_abstract(params_like, remap)),
+                opt_state=ocp.args.StandardRestore(
+                    _as_abstract(opt_state_like, remap)),
             ),
         )
         params, opt_state = restored["params"], restored["opt_state"]
         if remap is not None:
-            src_rows, src_pos, tgt_pos = remap
-
-            def remap_tree(tree, like):
-                from jax.tree_util import tree_flatten_with_path, tree_unflatten
-
-                flat, treedef = tree_flatten_with_path(tree)
-                like_leaves = jax.tree.leaves(like)
-                out = []
-                for (path, x), ref in zip(flat, like_leaves):
-                    if is_stacked(path):
-                        a = np.asarray(jax.device_get(x))
-                        dst = np.zeros((ref.shape[0],) + a.shape[1:], a.dtype)
-                        dst[np.asarray(tgt_pos)] = a[np.asarray(src_pos)]
-                        sh = getattr(ref, "sharding", None)
-                        x = jax.device_put(dst, sh) if sh is not None else jnp.asarray(dst)
-                    out.append(x)
-                return tree_unflatten(treedef, out)
-
-            params = remap_tree(params, params_like)
-            opt_state = remap_tree(opt_state, opt_state_like)
+            params = _remap_tree(params, params_like, remap)
+            opt_state = _remap_tree(opt_state, opt_state_like, remap)
         return (
             params,
             opt_state,
             int(meta["step"]),
             int(meta["trained_tokens"]),
         )
+
+    def _resolve_step(self, step: Optional[int]):
+        """Latest (or given) readable step + its metadata; waits out any
+        in-flight async save first."""
+        self.manager.wait_until_finished()
+        step = self.manager.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {self.directory}")
+        return step, self._read_meta(step)
+
+    @staticmethod
+    def _resolve_remap(meta, layout):
+        """(src_rows, src_positions, tgt_positions) when the saved and
+        restoring stacked-layer layouts differ, else None."""
+        if layout is None or "num_hidden_layers" not in meta:
+            return None
+        src = (int(meta["num_hidden_layers"]), int(meta["pp_size"]),
+               int(meta.get("pp_interleave", 1)))
+        layout = _layout3(layout)
+        if src[0] != layout[0]:
+            raise ValueError(
+                f"checkpoint has {src[0]} layers, config wants {layout[0]}")
+        src_rows, src_pos = _padded_layout(*src)
+        tgt_rows, tgt_pos = _padded_layout(*layout)
+        if src_rows != tgt_rows or src_pos != tgt_pos:
+            return (src_rows, src_pos, tgt_pos)
+        return None
+
+    def load_params(self, params_like, step: Optional[int] = None,
+                    layout: Optional[tuple] = None):
+        """Params-only restore — the inference path: no optimizer state is
+        read (a serving host never allocates the 2x-param AdamW moments).
+        ``layout`` is the RESTORING run's (num_hidden_layers, pp_size
+        [, interleave]); an inference engine wants ``(L, 1)``, which remaps
+        pp-padded or interleave-permuted stacks to the contiguous order the
+        decode scan expects. Returns (params, step, trained_tokens)."""
+        ocp = self._ocp
+        step, meta = self._resolve_step(step)
+        remap = self._resolve_remap(meta, layout)
+        restored = self.manager.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(
+                    _as_abstract(params_like, remap))),
+        )
+        params = restored["params"]
+        if remap is not None:
+            params = _remap_tree(params, params_like, remap)
+        return params, int(meta["step"]), int(meta["trained_tokens"])
 
     def wait_until_finished(self) -> None:
         self.manager.wait_until_finished()

@@ -333,7 +333,9 @@ class Config:
                     "check_vma=True is incompatible with lax.cond stage "
                     "gating (the checker's auto-inserted pvary transposes "
                     "put real psums inside single-stage branches, which "
-                    "deadlocks); set stage_gating='where'")
+                    "deadlocks); set stage_gating='where' — or, on a CPU "
+                    "box, set use_cpu=true, which resolves the 'auto' "
+                    "gating to where-masking")
         if d.stage_gating == "cond" and d.use_cpu and d.tp_size > 1:
             # the gated branches carry tp collectives, and the XLA CPU
             # runtime's rendezvous intermittently aborts when a collective

@@ -169,8 +169,13 @@ class MicroBatchDataLoader:
 
         # Group into fixed-length rows INSIDE the arrow cache: each map
         # batch concatenates its documents and emits len//chunk rows,
-        # dropping the per-batch remainder — the reference's
-        # tokenizer_group_text contract (data.py:57-75).
+        # dropping the per-batch remainder. Packing stride deviates from
+        # the reference ON PURPOSE: tokenizer_group_text packs OVERLAPPING
+        # windows (stride seq_length over seq_length+1-token rows, so
+        # adjacent rows share one boundary token, reference data.py:70-75);
+        # here rows are non-overlapping seq_length+1 chunks — row counts
+        # and token alignment therefore differ from upstream for the same
+        # corpus, and no token is trained on twice per epoch.
         def group(batch):
             parts = [np.asarray(x, np.int32) for x in batch["ids"]]
             ids = (np.concatenate(parts) if parts

@@ -1,0 +1,27 @@
+"""TPU-native inference: KV-cache decode, sampling, continuous batching.
+
+The serving counterpart of the training stack — turns trained checkpoints
+into a batched generation engine:
+
+- ``kv_cache``: preallocated slot-based K/V cache (compact GQA heads, head
+  axis tp-sharded) + the masked dot-product decode kernel;
+- ``sampling``: greedy / temperature / top-k / top-p as pure jittable
+  functions with per-request parameter arrays;
+- ``engine``: jitted ``prefill`` / ``decode_step`` pair under shard_map on
+  a tp mesh, reusing the training ``decoder_layer`` (flash-capable prefill)
+  with the incremental-decode hooks;
+- ``batcher``: continuous batching — admit/retire variable-length requests
+  into the engine's fixed slots.
+
+Design notes and CLI usage: docs/INFERENCE.md.
+"""
+
+from picotron_tpu.inference.batcher import (  # noqa: F401
+    ContinuousBatcher,
+    GenerationResult,
+    Request,
+)
+from picotron_tpu.inference.engine import (  # noqa: F401
+    InferenceEngine,
+    inference_config,
+)

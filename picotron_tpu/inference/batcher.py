@@ -1,0 +1,181 @@
+"""Continuous batching: admit/retire variable-length requests into fixed
+engine slots.
+
+The engine's decode program has a fixed batch width (``engine.slots``), so
+throughput under mixed-length traffic is a scheduling problem: a slot whose
+sequence hits EOS must be recycled to a waiting request immediately, not
+when the whole batch drains (static batching's tail loss). The batcher is
+the host-side loop that does exactly that:
+
+  admit:  while a slot is free and requests wait, prefill the next prompt
+          (padded to its power-of-two bucket), insert its K/V into the
+          slot, and sample its first token from the prefill logits;
+  decode: ONE ``decode_step`` advances every occupied slot together —
+          per-slot sampling params ride along as arrays, so mixed
+          greedy/temperature/top-k/top-p traffic shares the program;
+  retire: slots that hit EOS or their token budget release (a 1-element
+          length write — stale K/V rows become unreachable) and free
+          capacity for the next admit.
+
+Free slots still flow through the decode program (fixed shapes are the
+deal with XLA); they carry token 0 at length 0 and their outputs are
+ignored. The whole loop is deterministic given the seed: one PRNG key
+chain, split once per admit and once per decode round.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import numpy as np
+
+from picotron_tpu.inference import sampling
+
+
+@dataclass
+class Request:
+    """One generation request. ``temperature == 0`` = greedy; ``top_k <= 0``
+    and ``top_p >= 1`` disable those filters."""
+
+    uid: str
+    prompt: list
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+
+
+@dataclass
+class GenerationResult:
+    uid: str
+    prompt: list
+    tokens: list  # generated ids, EOS included when hit
+    finish_reason: str  # "eos" | "length"
+
+
+@dataclass
+class _Slot:
+    req: Request
+    generated: list = field(default_factory=list)
+
+
+class ContinuousBatcher:
+    """Drive an InferenceEngine over a stream of requests.
+
+    >>> b = ContinuousBatcher(engine, params)
+    >>> b.submit(Request("a", [1, 2, 3], max_new_tokens=16))
+    >>> results = b.run()           # {"a": GenerationResult(...)}
+
+    ``params`` must already be placed on the engine mesh
+    (``engine.shard_params``). One batcher owns one cache; interleaving two
+    batchers on one engine is fine (separate caches), sharing a cache is
+    not (decode_step consumes it).
+    """
+
+    def __init__(self, engine, params, seed: int = 0):
+        self.engine = engine
+        self.params = params
+        self._key = jax.random.PRNGKey(seed)
+        self._cache = engine.init_cache()
+        self._slots: list = [None] * engine.slots
+        self._pending: deque = deque()
+        self._results: dict = {}
+        n = engine.slots
+        self._last_tok = np.zeros(n, np.int32)
+        self._temp = np.zeros(n, np.float32)
+        self._top_k = np.zeros(n, np.int32)
+        self._top_p = np.ones(n, np.float32)
+
+    # ---- queue surface ----------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        budget = self.engine.max_seq_len - len(req.prompt)
+        if budget < 1:
+            raise ValueError(
+                f"request {req.uid!r}: prompt of {len(req.prompt)} tokens "
+                f"leaves no room to generate under max_seq_len "
+                f"{self.engine.max_seq_len}")
+        self._pending.append(req)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._pending) or any(s is not None for s in self._slots)
+
+    def run(self, requests=None) -> dict:
+        """Submit ``requests`` (optional) and step until every submitted
+        request has finished. Returns {uid: GenerationResult}."""
+        for r in requests or ():
+            self.submit(r)
+        while self.busy:
+            self.step()
+        out, self._results = self._results, {}
+        return out
+
+    # ---- one scheduler round ----------------------------------------------
+
+    def _split(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _finish(self, i: int, reason: str) -> None:
+        s = self._slots[i]
+        self._results[s.req.uid] = GenerationResult(
+            s.req.uid, list(s.req.prompt), list(s.generated), reason)
+        self._slots[i] = None
+        self._cache = self.engine.release(self._cache, i)
+        self._last_tok[i] = 0
+        self._temp[i] = 0.0
+        self._top_k[i] = 0
+        self._top_p[i] = 1.0
+
+    def _token_done(self, i: int, tok: int) -> None:
+        """Record one generated token for slot i; retire on EOS/budget."""
+        s = self._slots[i]
+        s.generated.append(tok)
+        r = s.req
+        if r.eos_id is not None and tok == r.eos_id:
+            self._finish(i, "eos")
+        elif (len(s.generated) >= r.max_new_tokens
+              or len(r.prompt) + len(s.generated) >= self.engine.max_seq_len):
+            self._finish(i, "length")
+        else:
+            self._last_tok[i] = tok
+
+    def _admit(self) -> None:
+        for i in range(len(self._slots)):
+            if not self._pending:
+                return
+            if self._slots[i] is not None:
+                continue
+            req = self._pending.popleft()
+            kv, logits = self.engine.prefill(self.params, req.prompt)
+            self._cache = self.engine.insert(
+                self._cache, kv, i, len(req.prompt))
+            self._slots[i] = _Slot(req)
+            self._temp[i] = req.temperature
+            self._top_k[i] = req.top_k
+            self._top_p[i] = req.top_p
+            first = int(sampling.sample(
+                logits, self._split(),
+                np.float32([req.temperature]),
+                np.int32([req.top_k]),
+                np.float32([req.top_p]))[0])
+            self._token_done(i, first)
+
+    def step(self) -> None:
+        """Admit waiting requests into free slots, then advance every
+        occupied slot one token."""
+        self._admit()
+        if not any(s is not None for s in self._slots):
+            return
+        self._cache, toks, _ = self.engine.decode_step(
+            self.params, self._cache, self._last_tok, self._split(),
+            self._temp, self._top_k, self._top_p)
+        toks = np.asarray(toks)
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._token_done(i, int(toks[i]))

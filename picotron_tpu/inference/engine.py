@@ -1,0 +1,232 @@
+"""The batched generation engine: jitted prefill / decode_step on the mesh.
+
+Serving counterpart of ``train_step.py``. Two compiled programs cover a
+request's whole life:
+
+- ``prefill(params, prompt)``: the full-sequence model (the SAME
+  ``decoder_layer`` path training runs, flash-capable on TPU) over a
+  right-padded prompt bucket, returning the per-layer compact K/V blocks
+  plus the last real token's full-vocab logits. Prompts are padded to
+  power-of-two buckets so arbitrary lengths reuse a handful of compiled
+  shapes; pad rows are inert (causal mask ahead, length mask behind).
+- ``decode_step(params, cache, tokens, key, temperature, top_k, top_p)``:
+  one token for EVERY slot at once — embed, scan the stacked layers with
+  per-slot cache writes and masked dot-product attention
+  (kv_cache.decode_attention), head, and per-slot sampling — returning the
+  updated cache and the sampled tokens. Slots sit at independent positions
+  (``cache['lengths']``); RoPE is applied at each slot's own offset
+  (ops/rope.rope_at_positions).
+
+Sharding: the engine builds (or is handed) a ``('dp','pp','cp','tp')`` mesh
+with dp=pp=cp=1 and runs both programs under shard_map with the model's
+training PartitionSpecs — a TP-sharded checkpoint loads and decodes without
+resharding; the cache's head axis shards over 'tp' alongside the wk/wv
+columns that fill it. Pipeline- or interleave-trained checkpoints are
+handled at LOAD time (checkpoint.CheckpointManager.load / load_params remap
+stacked layer rows to the contiguous pp=1 layout), so the engine always
+sees a plain [L] stack.
+
+The cache is donated through decode_step and insert, so steady-state decode
+updates the K/V buffers in place — no per-token reallocation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from picotron_tpu.config import Config
+from picotron_tpu.inference import kv_cache, sampling
+from picotron_tpu.models import llama
+from picotron_tpu.ops.rope import precompute_rope, rope_at_positions
+from picotron_tpu.parallel.tp import tp_gather
+from picotron_tpu.topology import Topology, build_topology, named_shardings
+from picotron_tpu.utils import shard_map
+
+
+def inference_config(cfg: Config) -> Config:
+    """Derive the serving config from a training config: same model, but a
+    tp-only topology (dp=pp=cp=1) with the training-only rewrites (sequence
+    parallelism, fsdp/zero1, vma checking) off — none of them make sense at
+    query length 1, and sequence parallelism cannot even shard it."""
+    raw = cfg.to_dict()
+    raw["distributed"].update(dict(
+        dp_size=1, pp_size=1, cp_size=1, pp_interleave=1,
+        tp_sequence_parallel=False, fsdp=False, zero1=False,
+        check_vma=False, cp_zigzag=False))
+    return Config.from_dict(raw)
+
+
+class InferenceEngine:
+    """Fixed-slot generation engine over a tp mesh.
+
+    ``slots`` is the decode batch width: the continuous batcher admits and
+    retires requests into these fixed positions so the compiled decode
+    program never changes shape. ``max_seq_len`` bounds prompt + generated
+    tokens per slot (default: the model's max_position_embeddings).
+    """
+
+    def __init__(self, cfg: Config, topo: Optional[Topology] = None, *,
+                 slots: int = 8, max_seq_len: Optional[int] = None,
+                 cache_dtype=None, min_prefill_bucket: int = 16):
+        self.cfg = inference_config(cfg)
+        m, d = self.cfg.model, self.cfg.distributed
+        if topo is None:
+            topo = build_topology(1, 1, 1, d.tp_size)
+        if (topo.dp_size, topo.pp_size, topo.cp_size) != (1, 1, 1):
+            raise ValueError(
+                "InferenceEngine serves a tp-only mesh (dp=pp=cp=1); got "
+                f"dp={topo.dp_size} pp={topo.pp_size} cp={topo.cp_size}. "
+                "Data-parallel serving = one engine per replica.")
+        if topo.tp_size != d.tp_size:
+            raise ValueError(
+                f"mesh tp={topo.tp_size} != config tp_size={d.tp_size}")
+        self.topo = topo
+        self.slots = int(slots)
+        self.max_seq_len = int(max_seq_len or m.max_position_embeddings)
+        self.min_prefill_bucket = int(min_prefill_bucket)
+        self.cache_dtype = jnp.dtype(cache_dtype or m.dtype)
+        self._dt = jnp.dtype(m.dtype)
+
+        # angle tables cover the whole cache window; decode gathers rows at
+        # each slot's own offset
+        self._cos, self._sin = precompute_rope(
+            self.max_seq_len, m.head_dim, m.rope_theta, self._dt)
+
+        self._pspecs = llama.param_pspecs(m)
+        self._cspecs = kv_cache.cache_pspecs()
+        kv_spec = {"k": self._cspecs["k"], "v": self._cspecs["v"]}
+        mesh = topo.mesh
+
+        self._prefill_jit = jax.jit(shard_map(
+            self._prefill_impl, mesh,
+            in_specs=(self._pspecs, P(), P()),
+            out_specs=(kv_spec, P())))
+        self._decode_jit = jax.jit(shard_map(
+            self._decode_impl, mesh,
+            in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P(), P()),
+            out_specs=(self._cspecs, P(), P())),
+            donate_argnums=(1,))
+        self._insert_jit = jax.jit(kv_cache.insert_prefill,
+                                   donate_argnums=(0,))
+        self._release_jit = jax.jit(kv_cache.release, donate_argnums=(0,))
+        self._init_cache_jit = jax.jit(
+            partial(kv_cache.init_cache, m, self.slots, self.max_seq_len,
+                    dtype=self.cache_dtype),
+            out_shardings=named_shardings(topo, self._cspecs))
+
+    # ---- model programs (run inside shard_map; tp axis collectives live) --
+
+    def _prefill_impl(self, params, tokens, length):
+        """tokens [1, S_bucket] int32, length [1] -> (kv blocks, last-token
+        logits [1, V]). Pad tokens beyond ``length`` produce K/V rows the
+        length mask makes unreachable."""
+        cfg = self.cfg
+        S = tokens.shape[1]
+        cos_l = lax.dynamic_slice_in_dim(self._cos, 0, S, 0)
+        sin_l = lax.dynamic_slice_in_dim(self._sin, 0, S, 0)
+        h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
+
+        def body(hc, lp):
+            hc, kv = llama.decoder_layer(lp, hc, cos_l, sin_l, cfg,
+                                         return_kv=True)
+            return hc, kv
+
+        h, (K, V) = lax.scan(body, h, params["layers"])
+        # only the last real token's logits are consumed: slice its hidden
+        # row BEFORE the LM-head matmul and the vocab tp-gather, so the
+        # bucket pays one [1, H] @ [H, V] row instead of S_bucket of them
+        h_last = jnp.take_along_axis(h, (length - 1)[:, None, None], axis=1)
+        last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
+        return {"k": K.astype(self.cache_dtype),
+                "v": V.astype(self.cache_dtype)}, last.astype(jnp.float32)
+
+    def _decode_impl(self, params, cache, tokens, key, temperature,
+                     top_k, top_p):
+        """One autoregressive step for all slots: tokens [B] (each slot's
+        current last token), cache lengths give every slot its position."""
+        cfg = self.cfg
+        pos = cache["lengths"]  # [B] write index of the incoming token
+        cos_b, sin_b = rope_at_positions(self._cos, self._sin, pos)
+        h = llama.embed_lookup(params["embed"],
+                               tokens[:, None]).astype(self._dt)
+
+        def body(hc, xs):
+            lp, kc, vc = xs
+            hc, (kc, vc) = llama.decoder_layer(
+                lp, hc, cos_b, sin_b, cfg, cache=(kc, vc), pos=pos)
+            return hc, (kc, vc)
+
+        h, (K, V) = lax.scan(body, h, (params["layers"], cache["k"],
+                                       cache["v"]))
+        logits = tp_gather(llama.head_logits(params, h, cfg))[:, 0]
+        logits = logits.astype(jnp.float32)
+        next_tok = sampling.sample(logits, key, temperature, top_k, top_p)
+        # free slots (length 0) ride along for shape stability but stay at
+        # length 0 — their row-0 writes are never visible
+        new_cache = {"k": K, "v": V,
+                     "lengths": jnp.where(pos > 0, pos + 1, 0)}
+        return new_cache, next_tok, logits
+
+    # ---- host-facing API ---------------------------------------------------
+
+    def shard_params(self, params):
+        """Place a (global) parameter pytree onto this engine's mesh with
+        the model's training shardings — TP column/row splits land on their
+        devices, no resharding at step time."""
+        return jax.tree.map(jax.device_put, params,
+                            named_shardings(self.topo, self._pspecs))
+
+    def init_cache(self) -> dict:
+        """Fresh zeroed cache, sharded on the engine mesh."""
+        return self._init_cache_jit()
+
+    def prefill_bucket(self, prompt_len: int) -> int:
+        """Power-of-two padding bucket for a prompt (one compile each)."""
+        if prompt_len > self.max_seq_len:
+            raise ValueError(
+                f"prompt of {prompt_len} tokens exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        b = self.min_prefill_bucket
+        while b < prompt_len:
+            b *= 2
+        return min(b, self.max_seq_len)
+
+    def prefill(self, params, prompt_ids) -> tuple:
+        """Run one prompt through the full-sequence model. Returns
+        (kv_blocks, last_logits [1, V] fp32). Pads to the prompt's bucket
+        host-side; jit reuses one executable per bucket size."""
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        bucket = self.prefill_bucket(ids.size)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : ids.size] = ids
+        return self._prefill_jit(params, jnp.asarray(padded),
+                                 jnp.asarray([ids.size], jnp.int32))
+
+    def insert(self, cache, kv, slot: int, length: int) -> dict:
+        """Park a prefill's blocks into ``slot`` (consumes ``cache``)."""
+        return self._insert_jit(cache, kv, slot, length)
+
+    def release(self, cache, slot: int) -> dict:
+        """Free a slot for the next request (consumes ``cache``)."""
+        return self._release_jit(cache, slot)
+
+    def decode_step(self, params, cache, tokens, key, temperature,
+                    top_k, top_p) -> tuple:
+        """One token for every slot. tokens/temperature/top_k/top_p are
+        [slots] host or device arrays; returns (cache, next_tokens [slots],
+        logits [slots, V] fp32). Consumes ``cache``."""
+        return self._decode_jit(
+            params, cache,
+            jnp.asarray(np.asarray(tokens, np.int32)), key,
+            jnp.asarray(np.asarray(temperature, np.float32)),
+            jnp.asarray(np.asarray(top_k, np.int32)),
+            jnp.asarray(np.asarray(top_p, np.float32)))

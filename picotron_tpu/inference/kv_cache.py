@@ -1,0 +1,122 @@
+"""Preallocated slot-based KV cache + the masked dot-product decode kernel.
+
+The training stack has no notion of a past: ``models/llama.py`` recomputes
+every key/value each step. Serving needs the opposite — each generated token
+must attend over all previous keys without recomputing them — so the cache
+preallocates the whole attention past once and every decode step writes one
+row per sequence:
+
+- ``k``/``v``: ``[num_layers, slots, max_seq_len, n_kv_heads, head_dim]``.
+  The layer axis leads (rather than the naive ``[batch, layers, ...]``
+  ordering) so the decode step's ``lax.scan`` over the stacked layer axis
+  consumes the cache exactly the way it consumes the stacked params; within
+  a layer a block is ``[B, T, H, D]`` — the layout ``ops/attention.py``
+  already uses. Heads are the COMPACT GQA count (``num_key_value_heads``,
+  never repeated): repetition happens inside ``decode_attention`` via a
+  grouped einsum, so GQA models pay ``Hkv/Hq`` of the naive cache bytes.
+- ``lengths``: ``[slots]`` int32 — each sequence's write index (= tokens
+  currently parked). Slot ``b``'s visible keys are ``t < lengths[b]``; a
+  freed slot has ``lengths == 0`` and its stale rows are unreachable, which
+  is what makes slot recycling (inference/batcher.py) a 1-element write.
+
+Sharding: the head axis shards over 'tp' — the same split as the wk/wv
+columns that produce it — so a TP-sharded checkpoint decodes with zero
+resharding; everything else is replicated (``cache_pspecs``). Dtype follows
+the model's param dtype (bf16 on the production configs; fp32 tiny CPU
+models stay exact against the ``forward_logits`` oracle).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from picotron_tpu.config import ModelConfig
+from picotron_tpu.ops.attention import NEG_INF
+
+
+def cache_pspecs() -> dict:
+    """PartitionSpecs of the cache pytree: K/V head axis over 'tp', the
+    rest replicated (slots could shard over 'dp' later; the engine serves
+    a tp-only mesh today)."""
+    kv = P(None, None, None, "tp", None)
+    return {"k": kv, "v": kv, "lengths": P()}
+
+
+def init_cache(m: ModelConfig, slots: int, max_seq_len: int,
+               dtype=None) -> dict:
+    """Zeroed global-shape cache for ``slots`` concurrent sequences. Jit
+    with out_shardings (engine.init_cache) to materialize each device's
+    shard directly."""
+    dt = jnp.dtype(dtype if dtype is not None else m.dtype)
+    shape = (m.num_hidden_layers, slots, max_seq_len,
+             m.num_key_value_heads, m.head_dim)
+    return {
+        "k": jnp.zeros(shape, dt),
+        "v": jnp.zeros(shape, dt),
+        "lengths": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     lengths: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """Masked dot-product attention of S fresh queries against a cache block.
+
+    q: [B, S, n_heads, D] — the new tokens, the LAST of which sits at global
+    position ``lengths[b] - 1`` (its K/V are already written); k/v:
+    [B, T, n_kv_heads, D] cache blocks; lengths: [B] int32 valid-key counts.
+    GQA is handled natively by a grouped einsum over the compact kv heads —
+    no repeat, no extra cache bytes. fp32 softmax with the same NEG_INF
+    masking convention as ops/attention.py, output cast back to q.dtype.
+
+    S == 1 is the autoregressive decode step; S > 1 generalizes to chunked
+    continuation (each query i masks keys past its own position).
+    """
+    B, S, nh, D = q.shape
+    T, nkv = k.shape[1], k.shape[2]
+    g = nh // nkv
+    qg = q.reshape(B, S, nkv, g, D)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    # query s has global position lengths - S + s; key t visible iff t <= it
+    pos_q = lengths[:, None] - S + jnp.arange(S)[None, :]  # [B, S]
+    mask = jnp.arange(T)[None, None, :] <= pos_q[:, :, None]  # [B, S, T]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bkgst,btkd->bskgd", p, v.astype(jnp.float32))
+    return out.reshape(B, S, nh, D).astype(q.dtype)
+
+
+def insert_prefill(cache: dict, kv: dict, slot, length) -> dict:
+    """Park a prefill's ``{"k","v"}: [L, 1, S_bucket, H, D]`` blocks into
+    ``slot`` and set its length. Rows past ``length`` (the bucket pad) are
+    written but unreachable under the length mask. ``slot``/``length`` may
+    be traced scalars — one compile per bucket size, not per slot."""
+    slot = jnp.asarray(slot, jnp.int32)
+
+    def put(dst, src):
+        return lax.dynamic_update_slice(dst, src, (0, slot, 0, 0, 0))
+
+    return {
+        "k": put(cache["k"], kv["k"].astype(cache["k"].dtype)),
+        "v": put(cache["v"], kv["v"].astype(cache["v"].dtype)),
+        "lengths": cache["lengths"].at[slot].set(
+            jnp.asarray(length, jnp.int32)),
+    }
+
+
+def release(cache: dict, slot) -> dict:
+    """Free a slot: zero its length so no stale key is ever visible again.
+    The K/V rows themselves stay — the next occupant overwrites what it
+    needs and masks the rest."""
+    return {**cache, "lengths": cache["lengths"].at[slot].set(0)}
+
+
+def live_tokens(cache: dict) -> jax.Array:
+    """Total tokens currently parked across slots (occupancy metric for
+    the batcher/bench)."""
+    return jnp.sum(cache["lengths"])

@@ -1,0 +1,97 @@
+"""Sampling over next-token logits: greedy, temperature, top-k, top-p.
+
+Pure jittable functions over full-vocab logits ``[B, V]`` with PER-REQUEST
+parameter arrays ``[B]`` — one compiled program serves a continuous batch
+whose slots carry different settings (a slot's params change between steps
+without recompiling, because they are array values, not trace constants).
+
+Filter order follows the de-facto HF convention: temperature scaling first,
+then top-k, then top-p on the rescaled distribution. ``temperature == 0``
+means greedy (argmax) for that row; ``top_k <= 0`` and ``top_p >= 1``
+disable their filters. Masked logits use the same large-negative fill as
+ops/attention.py so fully-filtered rows stay finite.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from picotron_tpu.ops.attention import NEG_INF
+
+
+def greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    """Argmax decode: [B, V] -> [B] int32."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def apply_top_k(logits: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Keep each row's k highest logits (k: [B] int32; k <= 0 disables).
+    Ties at the threshold all survive — the kept set can exceed k on exact
+    ties, which only ever widens the candidate pool."""
+    V = logits.shape[-1]
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    idx = jnp.clip(k - 1, 0, V - 1)
+    thresh = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
+    keep = (k <= 0)[:, None] | (logits >= thresh)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def apply_top_p(logits: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus filter (p: [B] float; p >= 1 disables): keep the smallest
+    prefix of the descending-probability ordering whose cumulative mass
+    reaches p. The top-1 token always survives (its exclusive prefix mass
+    is 0 < p)."""
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_desc.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < p[:, None]  # exclusive prefix mass < p
+    # p <= 0 would otherwise mask every column (0 < 0 is False) and turn
+    # sampling into a constant token-0 emitter; pin the top-1 column True
+    keep_sorted = keep_sorted.at[:, 0].set(True)
+    cutoff = jnp.min(
+        jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1)
+    keep = (p >= 1.0)[:, None] | (logits >= cutoff[:, None])
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def _filter_top_k_top_p(scaled: jnp.ndarray, top_k: jnp.ndarray,
+                        top_p: jnp.ndarray) -> jnp.ndarray:
+    """Both filters off ONE descending sort (each standalone filter pays its
+    own). Equivalent to ``apply_top_p(apply_top_k(scaled, top_k), top_p)``:
+    the kept set of the sequential application is a prefix of the sort —
+    top-k keeps ranks < k, top-p keeps a prefix of the (k-masked) nucleus —
+    so a single cutoff-by-value reproduces it, ties included."""
+    V = scaled.shape[-1]
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    rank = jnp.arange(V)[None, :]
+    keep = (top_k[:, None] <= 0) | (rank < top_k[:, None])
+    probs = jax.nn.softmax(jnp.where(keep, sorted_desc, NEG_INF), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep &= (top_p[:, None] >= 1.0) | ((cum - probs) < top_p[:, None])
+    keep = keep.at[:, 0].set(True)  # the top-1 token always survives
+    cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1)
+    return jnp.where(scaled >= cutoff[:, None], scaled, NEG_INF)
+
+
+def sample(logits: jnp.ndarray, key, temperature: jnp.ndarray,
+           top_k: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Draw one token per row: greedy where ``temperature == 0``, otherwise
+    a categorical over temperature-scaled, top-k- then top-p-filtered
+    logits. All sampling params are [B] arrays (see module docstring);
+    rows draw independently from one key. An all-greedy batch (the common
+    serving default) short-circuits past the sort/softmax/draw pipeline —
+    decode pays one argmax per step."""
+    greedy_tok = greedy(logits)
+
+    def stochastic():
+        t = jnp.maximum(temperature, 1e-6)[:, None]
+        filtered = _filter_top_k_top_p(
+            logits.astype(jnp.float32) / t, top_k, top_p)
+        drawn = jax.random.categorical(key, filtered, axis=-1).astype(
+            jnp.int32)
+        return jnp.where(temperature <= 0.0, greedy_tok, drawn)
+
+    # no collectives in either branch, so the cond is shard_map-safe
+    return jax.lax.cond(jnp.all(temperature <= 0.0),
+                        lambda: greedy_tok, stochastic)

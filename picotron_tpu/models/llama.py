@@ -254,8 +254,21 @@ def embed_lookup(w, tokens, sp: bool = False):
     return sp_scatter(e) if sp else tp_reduce(e)
 
 
-def _attention(q, k, v, cfg: Config):
+def _attention(q, k, v, cfg: Config, cache=None, pos=None):
+    """Full-sequence attention (training / prefill), or — when ``cache`` is
+    given — the incremental decode path: ``cache`` is this layer's UPDATED
+    (k, v) block pair [B, max_len, n_kv_local, head_dim] (compact GQA
+    heads, never repeated) and ``pos`` [B] is the index just written per
+    sequence, so key t is visible iff t <= pos; the ``k``/``v`` positional
+    args are ignored. The decode kernel is a masked dot product over the
+    cache (inference/kv_cache.py) — flash brings nothing at query length 1.
+    """
     scale = 1.0 / math.sqrt(cfg.model.head_dim)
+    if cache is not None:
+        from picotron_tpu.inference.kv_cache import decode_attention
+
+        k_cache, v_cache = cache
+        return decode_attention(q, k_cache, v_cache, pos + 1, scale)
     impl = cfg.model.attention_impl
     if impl == "auto":
         impl = "flash" if on_tpu() else "sdpa"
@@ -296,14 +309,29 @@ def _norm(x, w, cfg: Config):
     return rms_norm(x, w, cfg.model.rms_norm_eps)
 
 
-def decoder_layer(lp, h, cos, sin, cfg: Config):
+def decoder_layer(lp, h, cos, sin, cfg: Config, cache=None, pos=None,
+                  return_kv: bool = False):
     """One decoder block with per-shard head counts (model.py:94-97,187-208).
 
     With sequence parallelism the residual stream ``h`` is seq-sharded over
     'tp': the norm runs on the local shard, the Megatron f/g collectives
     become all-gather (entering column-parallel) / reduce-scatter (leaving
     row-parallel), and attention/MLP still see the full (cp-local) sequence.
-    """
+
+    Inference hooks (picotron_tpu/inference/):
+    - ``return_kv=True`` (prefill): the full-sequence path runs unchanged
+      but the layer also returns its compact pre-repeat rotated K/V block
+      [B, S, n_kv_local, head_dim] for the caller to park in a KV cache —
+      return value becomes ``(h, (k, v))``.
+    - ``cache=(k_cache, v_cache)`` + ``pos`` [B] (decode): the new tokens'
+      K/V are written into the cache at each sequence's ``pos`` and
+      attention runs as a masked dot product over the cache
+      (``_attention``'s decode path); ``cos``/``sin`` must then be the
+      per-sequence [B, S, head_dim] tables from ``ops.rope
+      .rope_at_positions``. Return value is ``(h, (k_cache, v_cache))``
+      with the updated blocks. Decode is query-length-1 only and assumes
+      cp == 1 (the serving mesh is tp-only; inference/engine.py enforces
+      it)."""
     m, tp = cfg.model, cfg.distributed.tp_size
     nh, nkv, D = m.num_attention_heads // tp, m.num_key_value_heads // tp, m.head_dim
     sp = use_sp(cfg)
@@ -334,17 +362,31 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
     v = _ckpt_name((x @ lp["wv"]).reshape(B, S, nkv, D), "v_proj")
     q = _ckpt_name(apply_rope(q, cos, sin), "q_rope")
     k = _ckpt_name(apply_rope(k, cos, sin), "k_rope")
-    cp, cp_impl = cfg.distributed.cp_size, cfg.distributed.cp_impl
-    # GQA + context parallelism: the compact Hkv-head K/V ride the wire
-    # (Hq/Hkv x less ICI traffic than the reference's pre-repeat,
-    # model.py:141-142) whenever the CP algorithm supports it — always for
-    # the ring (expand per block), for Ulysses when the local kv heads
-    # split evenly over cp (expand after the all-to-all).
-    compact_cp = cp > 1 and (cp_impl == "ring" or nkv % cp == 0)
-    if nkv != nh and not compact_cp:
-        k = jnp.repeat(k, nh // nkv, axis=2)
-        v = jnp.repeat(v, nh // nkv, axis=2)
-    o = _attention(q, k, v, cfg).reshape(B, S, nh * D)
+
+    new_cache = None
+    if cache is not None:
+        # incremental decode: write this token's K/V at each sequence's
+        # position, attend over the whole cache block
+        assert S == 1, f"decode is single-token (got query length {S})"
+        rows = jnp.arange(B)
+        new_cache = (
+            cache[0].at[rows, pos].set(k[:, 0].astype(cache[0].dtype)),
+            cache[1].at[rows, pos].set(v[:, 0].astype(cache[1].dtype)))
+        o = _attention(q, None, None, cfg, cache=new_cache, pos=pos)
+    else:
+        kv_compact = (k, v)  # pre-repeat: what a prefill parks in the cache
+        cp, cp_impl = cfg.distributed.cp_size, cfg.distributed.cp_impl
+        # GQA + context parallelism: the compact Hkv-head K/V ride the wire
+        # (Hq/Hkv x less ICI traffic than the reference's pre-repeat,
+        # model.py:141-142) whenever the CP algorithm supports it — always
+        # for the ring (expand per block), for Ulysses when the local kv
+        # heads split evenly over cp (expand after the all-to-all).
+        compact_cp = cp > 1 and (cp_impl == "ring" or nkv % cp == 0)
+        if nkv != nh and not compact_cp:
+            k = jnp.repeat(k, nh // nkv, axis=2)
+            v = jnp.repeat(v, nh // nkv, axis=2)
+        o = _attention(q, k, v, cfg)
+    o = o.reshape(B, S, nh * D)
     h = h + leave(o @ lp["wo"])
 
     # MLP sub-block: column(gate,up) -> SwiGLU -> row(down)  (model.py:163-185)
@@ -352,7 +394,10 @@ def decoder_layer(lp, h, cos, sin, cfg: Config):
     g = _ckpt_name(x @ lp["w_gate"], "mlp_gate")
     u = _ckpt_name(x @ lp["w_up"], "mlp_up")
     y = _ckpt_name(jax.nn.silu(g) * u, "mlp_act")
-    return h + leave(y @ lp["w_down"])
+    out = h + leave(y @ lp["w_down"])
+    if new_cache is not None:
+        return out, new_cache
+    return (out, kv_compact) if return_kv else out
 
 
 def layer_valid_mask(stacked, cfg: Config):

@@ -28,10 +28,29 @@ def precompute_rope(seq_length: int, head_dim: int, base: float, dtype) -> tuple
 
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
-    """x: [batch, seq, heads, head_dim]; cos/sin: [seq, head_dim]."""
+    """x: [batch, seq, heads, head_dim]; cos/sin: [seq, head_dim] shared
+    across the batch (training), or [batch, seq, head_dim] per-sequence
+    tables (KV-cache decode, where each slot sits at its own position —
+    see ``rope_at_positions``)."""
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
     rotated = jnp.concatenate([-x2, x1], axis=-1)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 3:
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+    else:
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
     return x * c + rotated * s
+
+
+def rope_at_positions(cos: jnp.ndarray, sin: jnp.ndarray,
+                      pos: jnp.ndarray) -> tuple:
+    """Gather per-sequence angle rows for decode-at-offset: ``pos`` is [B]
+    (one new token per sequence) or [B, S]; returns [B, S, head_dim] tables
+    that ``apply_rope`` broadcasts over heads. Out-of-table positions clamp
+    to the last row (callers bound generation by max_seq_len)."""
+    if pos.ndim == 1:
+        pos = pos[:, None]
+    pos = jnp.clip(pos, 0, cos.shape[0] - 1)
+    return jnp.take(cos, pos, axis=0), jnp.take(sin, pos, axis=0)

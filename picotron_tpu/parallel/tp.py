@@ -103,8 +103,22 @@ def all_gather_dim_invariant(x, axis: str, dim: int):
     vma-typed operands and fails on a checker-off build). Single home for
     the jax-internal import: consumers are the ZeRO-1 param unsplit
     (train_step) and the gathered CE loss (ops/cross_entropy)."""
-    if axis in jax.typeof(x).vma:
-        from jax._src.lax.parallel import all_gather_invariant
+    from picotron_tpu.utils import typeof_vma
+
+    if axis in typeof_vma(x):
+        try:
+            # jax-internal: the invariant gather has no public spelling yet.
+            # Reached only under check_vma=True (a vma-typed trace), which
+            # itself requires a jax.shard_map-era release — so a failure
+            # here means a jax upgrade moved/removed the private symbol.
+            from jax._src.lax.parallel import all_gather_invariant
+        except ImportError as e:
+            raise ImportError(
+                "check_vma=True needs jax._src.lax.parallel."
+                "all_gather_invariant (present in jax >= 0.6 releases with "
+                "jax.shard_map's vma checker); this jax build does not "
+                "provide it — upgrade/downgrade jax or run with "
+                "distributed.check_vma=false") from e
 
         _trace("all_gather", axis, x, extra=f"dim={dim} invariant")
         return all_gather_invariant(x, axis, axis=dim, tiled=True)

@@ -47,6 +47,9 @@ STEP_TIMEOUTS = {
     "profile": 1800,
     "cond_gating": 1500,
     "offload_bw": 1500,
+    # serving-side: continuous-batched KV-cache decode tokens/s (no tunnel
+    # orchestrator of its own — the agenda timeout is its failure bound)
+    "bench_decode": 1500,
 }
 PROFILE_ANALYSIS_TIMEOUT = 300
 
@@ -189,6 +192,7 @@ def main(argv=None):
         "offload_bw": lambda: (
             [sys.executable, "-m", "picotron_tpu.tools.measure_offload_bw"],
             None),
+        "bench_decode": lambda: ([sys.executable, "bench_decode.py"], None),
     }
     assert set(step_cmds) == set(STEP_TIMEOUTS)
     known = set(STEP_TIMEOUTS)

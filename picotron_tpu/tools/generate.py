@@ -1,0 +1,183 @@
+"""Text generation CLI — the serving path end-to-end.
+
+    python -m picotron_tpu.tools.generate --config exp.json \
+        --load-path checkpoints --prompt-ids 5,276,388 --max-new-tokens 64
+
+Weights come from one of:
+  --load-path    orbax training checkpoint dir (params-only restore;
+                 pp/interleave-trained stacks are remapped to the engine's
+                 contiguous layout at load — checkpoint.load_params)
+  --hf-path      HF-format safetensors file/dir (checkpoint.load_hf_safetensors)
+  --random-init  seed-derived random weights (plumbing smoke runs)
+
+Prompts are repeatable --prompt-ids (comma-separated token ids — works
+air-gapped) or repeatable --prompt (text; needs the transformers tokenizer
+for model.name). All prompts run through one ContinuousBatcher, so a mixed
+batch exercises admission, slot recycling, and per-request sampling params.
+
+``--smoke`` is the `make decode-smoke` target: a built-in tiny CPU model
+with random weights generates from fixed prompts in seconds and exits
+nonzero on any malfunction — no config, checkpoint, or network needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from functools import partial
+from typing import Optional
+
+SMOKE_CONFIG = {
+    "distributed": {"tp_size": 1, "use_cpu": True},
+    "model": dict(
+        name="tiny-smoke", num_hidden_layers=4, num_attention_heads=8,
+        num_key_value_heads=4, hidden_size=64, intermediate_size=128,
+        vocab_size=256, max_position_embeddings=128, dtype="float32",
+        attention_impl="sdpa"),
+    "training": {"seq_length": 64},
+    "dataset": {"name": "synthetic"},
+}
+
+
+def _load_weights(args, cfg, engine):
+    """Resolve --load-path / --hf-path / --random-init to sharded params."""
+    import jax
+
+    from picotron_tpu import checkpoint as ckpt
+    from picotron_tpu.models import llama
+    from picotron_tpu.topology import named_shardings
+
+    if args.hf_path:
+        return ckpt.load_hf_safetensors(args.hf_path, cfg.model, engine.topo)
+    if args.load_path:
+        like = jax.eval_shape(partial(llama.init_params, m=cfg.model),
+                              jax.random.PRNGKey(0))
+        shardings = named_shardings(engine.topo,
+                                    llama.param_pspecs(cfg.model))
+        like = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            like, shardings)
+        mgr = ckpt.CheckpointManager(args.load_path)
+        params, step, tokens = mgr.load_params(
+            like, layout=(cfg.model.num_hidden_layers, 1))
+        mgr.close()
+        print(f"loaded step {step} ({tokens} trained tokens) "
+              f"from {args.load_path}")
+        return params
+    params = jax.jit(lambda k: llama.init_params(k, cfg.model))(
+        jax.random.PRNGKey(args.seed))
+    return engine.shard_params(params)
+
+
+def _build_requests(args, tokenizer) -> list:
+    from picotron_tpu.inference import Request
+
+    prompts = []
+    for spec in args.prompt_ids or ():
+        prompts.append([int(t) for t in spec.replace(" ", "").split(",") if t])
+    for text in args.prompt or ():
+        prompts.append(list(tokenizer(text)["input_ids"]))
+    if not prompts:
+        raise SystemExit("no prompts: pass --prompt-ids and/or --prompt")
+    return [
+        Request(uid=f"req{i}", prompt=p, max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature, top_k=args.top_k,
+                top_p=args.top_p, eos_id=args.eos_id)
+        for i, p in enumerate(prompts)
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="generate from a picotron-tpu checkpoint "
+                    "(continuous-batched KV-cache decode)")
+    ap.add_argument("--config", help="training config.json (model shape, tp)")
+    ap.add_argument("--load-path", default="", help="orbax checkpoint dir")
+    ap.add_argument("--hf-path", default="", help="HF safetensors file/dir")
+    ap.add_argument("--random-init", action="store_true",
+                    help="seed-derived random weights (plumbing smoke)")
+    ap.add_argument("--prompt-ids", action="append",
+                    help="comma-separated token ids (repeatable)")
+    ap.add_argument("--prompt", action="append",
+                    help="text prompt (repeatable; needs the HF tokenizer)")
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="0 = greedy")
+    ap.add_argument("--top-k", type=int, default=0, help="<= 0 disables")
+    ap.add_argument("--top-p", type=float, default=1.0, help=">= 1 disables")
+    ap.add_argument("--eos-id", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=4,
+                    help="decode batch width (engine slots)")
+    ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="built-in tiny CPU model + random init + fixed "
+                    "prompts (the `make decode-smoke` target)")
+    args = ap.parse_args(argv)
+
+    from picotron_tpu.config import Config
+    from picotron_tpu.train import _ensure_devices
+
+    if args.smoke:
+        cfg = Config.from_dict(SMOKE_CONFIG)
+        args.random_init = True
+        if not args.prompt_ids and not args.prompt:
+            args.prompt_ids = ["1,2,3,4,5,6,7,8", "9,10,11", "12,13,14,15,16"]
+        args.max_new_tokens = min(args.max_new_tokens, 16)
+    elif args.config:
+        with open(args.config) as f:
+            cfg = Config.from_dict(json.load(f))
+    else:
+        ap.error("pass --config (or --smoke)")
+    if not (args.load_path or args.hf_path or args.random_init):
+        ap.error("pass one of --load-path / --hf-path / --random-init")
+    _ensure_devices(cfg)
+
+    from picotron_tpu.inference import ContinuousBatcher, InferenceEngine
+
+    tokenizer = None
+    if args.prompt:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(cfg.model.name)
+
+    t0 = time.perf_counter()
+    engine = InferenceEngine(cfg, slots=args.slots,
+                             max_seq_len=args.max_seq_len)
+    params = _load_weights(args, cfg, engine)
+    requests = _build_requests(args, tokenizer)
+    setup_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    batcher = ContinuousBatcher(engine, params, seed=args.seed)
+    results = batcher.run(requests)
+    gen_s = time.perf_counter() - t0
+
+    n_tokens = 0
+    failed = False
+    for req in requests:
+        r = results[req.uid]
+        n_tokens += len(r.tokens)
+        ok = (len(r.tokens) > 0
+              and all(0 <= t < cfg.model.vocab_size for t in r.tokens))
+        failed |= not ok
+        line = (f"[{r.uid}] prompt={r.prompt} -> {r.tokens} "
+                f"({r.finish_reason})")
+        if tokenizer is not None:
+            line += f"\n  text: {tokenizer.decode(r.prompt + r.tokens)!r}"
+        print(line)
+    print(f"{n_tokens} tokens in {gen_s:.2f}s "
+          f"({n_tokens / max(gen_s, 1e-9):.1f} tok/s, "
+          f"setup {setup_s:.1f}s, slots={engine.slots}, "
+          f"tp={engine.topo.tp_size})")
+    if failed:
+        print("FAILED: some request produced no/invalid tokens",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

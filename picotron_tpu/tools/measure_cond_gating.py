@@ -39,6 +39,7 @@ from picotron_tpu.config import Config, ModelConfig
 from picotron_tpu.models import llama
 from picotron_tpu.topology import build_topology
 from picotron_tpu.utils import honor_cpu_env_pin
+from picotron_tpu.utils import shard_map as shard_map_compat
 
 P = jax.sharding.PartitionSpec
 
@@ -114,7 +115,7 @@ def main(argv=None):
         return jnp.where(pred, emb, h_recv)
 
     def shard(fn):
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map_compat(
             fn, mesh=topo.mesh,
             in_specs=(P(), P(), P(), P()), out_specs=P(),
             check_vma=False))

@@ -45,6 +45,7 @@ from picotron_tpu.parallel.tp import (
     reduce_scatter_dim,
 )
 from picotron_tpu.topology import Topology, batch_pspec, named_shardings
+from picotron_tpu.utils import shard_map as shard_map_compat, typeof_vma
 
 
 def lr_schedule(t):
@@ -257,7 +258,7 @@ def init_state(cfg: Config, topo: Topology, seed: int | None = None):
         ospecs = zero1_opt_pspecs(cfg, optimizer, pspecs)
         init_fn = lambda p: optimizer.init(
             jax.tree.map(partial(_zero1_slice, dp=cfg.distributed.dp_size), p))
-        opt_state = jax.jit(jax.shard_map(
+        opt_state = jax.jit(shard_map_compat(
             init_fn, mesh=topo.mesh, in_specs=(pspecs,), out_specs=ospecs,
             check_vma=cfg.distributed.check_vma))(params)
         return params, opt_state
@@ -407,7 +408,7 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
         # mix varying and invariant axes, hence the vma-driven set). With
         # the checker off the vma is empty and this is the plain dp x cp
         # mean.
-        extra = tuple(a for a in ("pp", "tp") if a in jax.typeof(loss).vma)
+        extra = tuple(a for a in ("pp", "tp") if a in typeof_vma(loss))
         loss = lax.pmean(loss, ("dp", "cp") + extra)
         return params, opt_state, loss
 
@@ -418,7 +419,7 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
     # carry explicit vma casts (utils.pvary_like, scan_carry_fixpoint) so
     # that flipping it on is a pure config change; tests/test_check_vma.py
     # builds and runs the step under the checker across topologies.
-    step = jax.shard_map(
+    step = shard_map_compat(
         _step, mesh=mesh,
         in_specs=(pspecs, ospecs, bspec, bspec),
         out_specs=(pspecs, ospecs, P()),

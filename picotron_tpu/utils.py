@@ -11,6 +11,15 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+# RNG values must not depend on how a computation is sharded: newer JAX
+# defaults jax_threefry_partitionable=True; 0.4.x does not, and there
+# jit(init_params, out_shardings=...) draws DIFFERENT weights per topology
+# (vocab-sharded embed under tp, stacked layers under pp) — which breaks
+# the cross-topology loss-trajectory oracle the whole test suite leans on.
+# Pin the partitionable generator on every version.
+if not jax.config.jax_threefry_partitionable:
+    jax.config.update("jax_threefry_partitionable", True)
+
 # dense bf16 peak FLOPs per chip
 TPU_PEAK_FLOPS = {
     "v4": 275e12,
@@ -21,6 +30,38 @@ TPU_PEAK_FLOPS = {
     "v6e": 918e12,
 }
 H100_PEAK_FLOPS = 989.5e12  # the reference's denominator (utils.py:42)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across JAX releases. Newer releases expose it as a
+    top-level API with the varying-manual-axes checker (``check_vma``);
+    older ones (<= 0.4.x) only have ``jax.experimental.shard_map.shard_map``
+    with the predecessor ``check_rep`` flag, whose replication checker
+    rejects valid custom_vjp collectives — there ``check_vma=False`` maps to
+    ``check_rep=False`` and ``check_vma=True`` raises (the vma checker does
+    not exist to run). Single home for the version split; every shard_map in
+    the repo goes through here."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    if check_vma:
+        raise NotImplementedError(
+            "distributed.check_vma=True needs jax.shard_map's varying-"
+            f"manual-axes checker (jax >= 0.6); this is jax {jax.__version__}")
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
+def typeof_vma(x) -> frozenset:
+    """The varying-manual-axes set of ``x``'s type, or the empty set on JAX
+    releases whose avals are not vma-typed (``jax.typeof`` absent). Every
+    vma-driven cast in the repo keys off this, so on an old JAX they all
+    collapse to provable no-ops instead of AttributeErrors."""
+    if hasattr(jax, "typeof"):
+        return frozenset(jax.typeof(x).vma)
+    return frozenset()
 
 
 def is_main_process() -> bool:
@@ -127,10 +168,10 @@ def pvary_like(x, *refs):
     from jax import lax
 
     target = frozenset().union(
-        *[jax.typeof(r).vma for r in jax.tree.leaves(refs)])
+        *[typeof_vma(r) for r in jax.tree.leaves(refs)])
 
     def cast(v):
-        need = tuple(sorted(target - jax.typeof(v).vma))
+        need = tuple(sorted(target - typeof_vma(v)))
         return lax.pcast(v, need, to="varying") if need else v
 
     return jax.tree.map(cast, x)
@@ -142,10 +183,9 @@ def vma_checking(axis: str) -> bool:
     skip the checker-only eval_shape passes (scan-carry fixpoints) on the
     production (``check_vma=False``) build, where every vma is empty and
     the casts are provable no-ops."""
-    import jax
     from jax import lax
 
-    return bool(jax.typeof(lax.axis_index(axis)).vma)
+    return bool(typeof_vma(lax.axis_index(axis)))
 
 
 def scan_carry_fixpoint(body, carry, x_example):
@@ -166,8 +206,8 @@ def scan_carry_fixpoint(body, carry, x_example):
     for _ in range(max(4, len(jax.tree.leaves(carry)) + 1)):
         out = jax.eval_shape(lambda c: body(c, x_example)[0], carry)
         new = jax.tree.map(pvary_like, carry, out)
-        if [jax.typeof(a).vma for a in jax.tree.leaves(new)] == \
-           [jax.typeof(a).vma for a in jax.tree.leaves(carry)]:
+        if [typeof_vma(a) for a in jax.tree.leaves(new)] == \
+           [typeof_vma(a) for a in jax.tree.leaves(carry)]:
             return new
         carry = new
     raise ValueError(

@@ -11,6 +11,7 @@ from picotron_tpu.ops.cross_entropy import (
     cross_entropy_gathered,
     cross_entropy_vocab_parallel,
 )
+from picotron_tpu.utils import shard_map as shard_map_compat
 
 
 def _data(B=2, S=64, H=32, V=256, seed=0):
@@ -23,7 +24,7 @@ def _data(B=2, S=64, H=32, V=256, seed=0):
 
 def _run_tp1(fn, x, w, t):
     mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
-    return jax.shard_map(fn, mesh=mesh, in_specs=(P(), P(), P()),
+    return shard_map_compat(fn, mesh=mesh, in_specs=(P(), P(), P()),
                          out_specs=P(), check_vma=False)(x, w, t)
 
 
@@ -93,7 +94,7 @@ def test_fused_tp_sharded_matches_single():
         loss, (dx, dw) = jax.value_and_grad(loss_fn, argnums=(0, 1))(x, w)
         return loss, jax.lax.psum(dx, "tp"), dw
 
-    loss, dx, dw = jax.shard_map(
+    loss, dx, dw = shard_map_compat(
         sharded, mesh=mesh, in_specs=(P(), P(None, "tp"), P()),
         out_specs=(P(), P(), P(None, "tp")), check_vma=False)(x, w, t)
 
@@ -117,7 +118,7 @@ def test_vocab_parallel_matches_gathered_tp_sharded():
     mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
 
     def run(fn):
-        return jax.shard_map(fn, mesh=mesh, in_specs=(P(), P(None, "tp"), P()),
+        return shard_map_compat(fn, mesh=mesh, in_specs=(P(), P(None, "tp"), P()),
                              out_specs=P(), check_vma=False)(x, w, t)
 
     ref = run(lambda x, w, t: cross_entropy_gathered(x @ w, t))

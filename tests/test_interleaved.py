@@ -15,6 +15,7 @@ from picotron_tpu.checkpoint import CheckpointManager
 from picotron_tpu.data import MicroBatchDataLoader
 from picotron_tpu.models.llama import pp_layer_layout
 from picotron_tpu.topology import topology_from_config
+from picotron_tpu.utils import shard_map as shard_map_compat
 
 # multi-minute equivalence/e2e matrices: excluded from `make test`
 pytestmark = pytest.mark.slow
@@ -100,7 +101,7 @@ def test_forward_logits_remaps_interleaved_layout(tiny_model_kwargs):
         # eval contract: full (replicated) param stack, every device runs
         # the whole model — forward_logits un-permutes the rows itself
         topo = topology_from_config(cfg_x)
-        fwd = jax.jit(jax.shard_map(
+        fwd = jax.jit(shard_map_compat(
             lambda p, t: llama.forward_logits(p, t, cfg_x),
             mesh=topo.mesh, in_specs=(P(), P()), out_specs=P(),
             check_vma=False))

@@ -10,6 +10,7 @@ from picotron_tpu import train_step as ts
 from picotron_tpu.data import MicroBatchDataLoader
 from picotron_tpu.models import llama
 from picotron_tpu.topology import topology_from_config
+from picotron_tpu.utils import shard_map as shard_map_compat
 
 
 def test_forward_shapes(cfg_factory):
@@ -18,7 +19,7 @@ def test_forward_shapes(cfg_factory):
     params, _ = ts.init_state(cfg, topo)
     tokens = jnp.zeros((2, cfg.training.seq_length), jnp.int32)
     fwd = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             lambda p, t: llama.forward_logits(p, t, cfg),
             mesh=topo.mesh,
             in_specs=(llama.param_pspecs(cfg.model), jax.sharding.PartitionSpec()),
@@ -96,7 +97,7 @@ def test_forward_logits_zigzag_layout_roundtrip(cfg_factory):
     def logits_for(cfg, toks, **fwd_kw):
         topo = topology_from_config(cfg)
         params, _ = ts.init_state(cfg, topo)
-        fwd = jax.jit(jax.shard_map(
+        fwd = jax.jit(shard_map_compat(
             lambda p, t: llama.forward_logits(p, t, cfg, **fwd_kw),
             mesh=topo.mesh,
             in_specs=(llama.param_pspecs(cfg.model), P(None, "cp")),

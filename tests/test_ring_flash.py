@@ -32,6 +32,7 @@ from picotron_tpu.parallel.cp import (
     chunk_positions,
     zigzag_perm,
 )
+from picotron_tpu.utils import shard_map as shard_map_compat
 
 B, S, H, D = 2, 256, 2, 64  # two 128-token chunks
 SCALE = 0.125
@@ -226,7 +227,7 @@ def test_gqa_cp_matches_full_attention_and_grads(mode):
             loss_fn, argnums=(0, 1, 2), has_aux=True)(q, k, v)
         return out, grads, jax.lax.psum(loss, "cp")
 
-    out, (dq, dk, dv), loss = jax.jit(jax.shard_map(
+    out, (dq, dk, dv), loss = jax.jit(shard_map_compat(
         shard_fn, mesh=mesh, in_specs=(spec, spec, spec, spec),
         out_specs=((spec, (spec, spec, spec), P())), check_vma=False,
     ))(q, k, v, w)
