@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench decode-smoke clean
+.PHONY: native test test-all test-isolated bench decode-smoke chaos-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -35,6 +35,12 @@ bench: native
 # no checkpoint or network needed.
 decode-smoke:
 	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke
+
+# Fault-injection suite on a CPU mesh (picotron_tpu/resilience/): chaos
+# SIGTERM/crash/NaN/truncation at fixed steps, kill->resume bit-for-bit
+# equivalence, corrupt-checkpoint fallback, supervisor restart bounds.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
 
 clean:
 	rm -rf picotron_tpu/native/_build

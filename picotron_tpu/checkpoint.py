@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import json
 import os
+import warnings
+from functools import partial
 from typing import Any, Optional
 
 import jax
@@ -43,6 +45,7 @@ import numpy as np
 
 from picotron_tpu.config import ModelConfig
 from picotron_tpu.models import llama
+from picotron_tpu.resilience.retry import retry
 from picotron_tpu.topology import Topology, named_shardings
 
 # --------------------------------------------------------------------------- #
@@ -123,11 +126,20 @@ class CheckpointManager:
     """
 
     def __init__(self, save_dir: str, max_to_keep: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True, io_attempts: int = 3,
+                 io_backoff: float = 0.5, io_jitter: float = 0.25):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.path.abspath(save_dir)
+        # retrying I/O (resilience): transient NFS/GCS flakes on save/restore
+        # are retried with exponential backoff before surfacing
+        self._retry = partial(retry, attempts=io_attempts, backoff=io_backoff,
+                              jitter=io_jitter)
+        # (step, meta) of the checkpoint the last load() actually restored —
+        # which, after a corrupt-latest fallback, is NOT the latest step
+        self.last_restored_step: Optional[int] = None
+        self.last_restored_meta: Optional[dict] = None
         # Async saves: orbax copies device arrays to host synchronously (so
         # donated buffers can be reused by the next step immediately), then
         # writes to disk in a background thread — training only stalls for
@@ -142,13 +154,17 @@ class CheckpointManager:
 
     def save(self, step: int, params, opt_state, trained_tokens: int,
              layout: Optional[tuple[int, int]] = None,
-             zero1: Optional[tuple[bool, int]] = None) -> None:
+             zero1: Optional[tuple[bool, int]] = None,
+             data_meta: Optional[dict] = None) -> None:
         """``layout`` = (num_hidden_layers, pp_size) of the saving run;
         recorded in the metadata so a restore under a different uneven-pp
         padding can remap the stacked layer rows (see ``load``).
         ``zero1`` = (enabled, dp_size): ZeRO-1 chunk shapes depend on dp, so
         the layout is recorded and ``load`` refuses a mismatched restore
-        instead of corrupting the optimizer state."""
+        instead of corrupting the optimizer state.
+        ``data_meta`` = the data-loader position/geometry
+        (MicroBatchDataLoader.state_meta): resume verifies it so a changed
+        batch geometry fails loudly instead of training on the wrong data."""
         ocp = self._ocp
         meta = {"step": step, "trained_tokens": int(trained_tokens)}
         if layout is not None:
@@ -157,16 +173,20 @@ class CheckpointManager:
             meta["pp_interleave"] = lay[2]
         if zero1 is not None:
             meta["zero1"], meta["zero1_dp"] = bool(zero1[0]), int(zero1[1])
-        self.manager.save(
+        if data_meta is not None:
+            meta["data"] = dict(data_meta)
+        self._retry(lambda: self.manager.save(
             step,
             args=ocp.args.Composite(
                 params=ocp.args.StandardSave(params),
                 opt_state=ocp.args.StandardSave(opt_state),
                 meta=ocp.args.JsonSave(meta),
             ),
-        )
+        ), desc=f"save step {step}")
         # No wait here: with async_save the disk write proceeds in the
         # background; readers go through load()/close(), which both wait.
+        # The retry covers the synchronous enqueue (D2H copy + directory
+        # setup); a failed background write surfaces at the next wait.
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
@@ -183,6 +203,12 @@ class CheckpointManager:
         (live arrays or ShapeDtypeStructs). Returns
         (params, opt_state, step, trained_tokens).
 
+        When ``step`` is None and the latest step is corrupt or partially
+        written, the restore warns and falls back to the previous step
+        (resilience: a crash mid-save must never strand the run); the step
+        actually restored is reported in the returned tuple and recorded as
+        ``last_restored_step``/``last_restored_meta``.
+
         ``layout`` = (num_hidden_layers, pp_size) of the *restoring* run.
         When the saved metadata records a different uneven-pp pad layout
         (llama.pp_layer_layout), the stacked layer leaves (params['layers']
@@ -193,41 +219,51 @@ class CheckpointManager:
         restores (all even splits share the [L] layout) take the direct
         sharded path."""
         ocp = self._ocp
-        step, meta = self._resolve_step(step)
-        remap = self._resolve_remap(meta, layout)
+        state: dict = {}
 
-        # ZeRO-1 guard: the dp-chunked optimizer state is dp-specific (leaf
-        # shapes = dp * ceil(n_local/dp)) and a 1-D chunk cannot go through
-        # the stacked-layer-row remap — refuse a mismatched restore with a
-        # real error instead of a shape crash or silent corruption. dp_size
-        # only matters when ZeRO-1 is on for either side: non-ZeRO optimizer
-        # state is dp-replicated and restores across dp changes fine.
-        saved_z = (bool(meta.get("zero1", False)), int(meta.get("zero1_dp", 1)))
-        if zero1 is not None:
-            want = (bool(zero1[0]), int(zero1[1]))
-            mismatch = (saved_z[0] != want[0]) or (
-                saved_z[0] and saved_z[1] != want[1])
-            if mismatch:
+        def guards(meta):
+            remap = state["remap"] = self._resolve_remap(meta, layout)
+            # ZeRO-1 guard: the dp-chunked optimizer state is dp-specific
+            # (leaf shapes = dp * ceil(n_local/dp)) and a 1-D chunk cannot go
+            # through the stacked-layer-row remap — refuse a mismatched
+            # restore with a real error instead of a shape crash or silent
+            # corruption. dp_size only matters when ZeRO-1 is on for either
+            # side: non-ZeRO optimizer state is dp-replicated and restores
+            # across dp changes fine.
+            saved_z = (bool(meta.get("zero1", False)),
+                       int(meta.get("zero1_dp", 1)))
+            if zero1 is not None:
+                want = (bool(zero1[0]), int(zero1[1]))
+                mismatch = (saved_z[0] != want[0]) or (
+                    saved_z[0] and saved_z[1] != want[1])
+                if mismatch:
+                    raise ValueError(
+                        f"optimizer state was saved with (zero1, dp) = "
+                        f"{saved_z} but this run has {want}; ZeRO-1 chunk "
+                        f"layouts are dp-specific — restore under the same "
+                        f"(zero1, dp_size) or re-shard the optimizer state "
+                        f"offline")
+            if saved_z[0] and remap is not None:
                 raise ValueError(
-                    f"optimizer state was saved with (zero1, dp) = {saved_z} "
-                    f"but this run has {want}; ZeRO-1 chunk layouts are "
-                    f"dp-specific — restore under the same (zero1, dp_size) "
-                    f"or re-shard the optimizer state offline")
-        if saved_z[0] and remap is not None:
-            raise ValueError(
-                "cannot remap an uneven-pp layer layout on a ZeRO-1 "
-                "checkpoint: the optimizer state is stored as flat dp chunks; "
-                "restore under the saving run's (num_hidden_layers, pp_size)")
+                    "cannot remap an uneven-pp layer layout on a ZeRO-1 "
+                    "checkpoint: the optimizer state is stored as flat dp "
+                    "chunks; restore under the saving run's "
+                    "(num_hidden_layers, pp_size)")
 
-        restored = self.manager.restore(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(
-                    _as_abstract(params_like, remap)),
-                opt_state=ocp.args.StandardRestore(
-                    _as_abstract(opt_state_like, remap)),
-            ),
-        )
+        def restore(s, meta):
+            remap = state["remap"]
+            return self.manager.restore(
+                s,
+                args=ocp.args.Composite(
+                    params=ocp.args.StandardRestore(
+                        _as_abstract(params_like, remap)),
+                    opt_state=ocp.args.StandardRestore(
+                        _as_abstract(opt_state_like, remap)),
+                ),
+            )
+
+        restored, meta = self._fallback_restore(step, guards, restore)
+        remap = state["remap"]
         params, opt_state = restored["params"], restored["opt_state"]
         if remap is not None:
             params = _remap_tree(params, params_like, remap)
@@ -239,14 +275,61 @@ class CheckpointManager:
             int(meta["trained_tokens"]),
         )
 
-    def _resolve_step(self, step: Optional[int]):
-        """Latest (or given) readable step + its metadata; waits out any
-        in-flight async save first."""
+    def _candidate_steps(self, step: Optional[int]) -> list[int]:
+        """Steps to try restoring, newest first; waits out any in-flight
+        async save. An explicit ``step`` is tried alone (the caller asked for
+        exactly that state; silently substituting another would be worse
+        than failing)."""
         self.manager.wait_until_finished()
-        step = self.manager.latest_step() if step is None else step
-        if step is None:
+        if step is not None:
+            return [step]
+        steps = sorted(self.manager.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(f"no checkpoint found in {self.directory}")
-        return step, self._read_meta(step)
+        return steps
+
+    def _fallback_restore(self, step: Optional[int], guards, restore):
+        """Try each candidate step newest-first: read meta (retried), run
+        ``guards(meta)`` (config-level errors — a wrong topology — propagate;
+        an older step cannot fix them), then ``restore(s, meta)`` (retried; a
+        failure here means corrupt/partial data, so warn and fall back).
+        Returns (restore result, meta).
+
+        A deterministically-corrupt step burns its io_attempts before the
+        fallback — deliberate: orbax wraps transient I/O and real corruption
+        in overlapping exception types, and losing save_frequency steps of
+        work to an unretried network flake costs far more than the seconds
+        of re-deserialization here (once per restart, not per step). Tests
+        with known-corrupt steps pass io_attempts=1."""
+        candidates = self._candidate_steps(step)
+        last_err = None
+        for s in candidates:
+            try:
+                meta = self._retry(partial(self._read_meta, s),
+                                   desc=f"read meta @{s}")
+            except Exception as e:
+                last_err = e
+                warnings.warn(
+                    f"checkpoint step {s} in {self.directory} has unreadable "
+                    f"metadata ({type(e).__name__}: {e}); falling back to "
+                    f"the previous step", RuntimeWarning)
+                continue
+            guards(meta)
+            try:
+                out = self._retry(partial(restore, s, meta),
+                                  desc=f"restore @{s}")
+            except Exception as e:
+                last_err = e
+                warnings.warn(
+                    f"checkpoint step {s} in {self.directory} is corrupt or "
+                    f"partially written ({type(e).__name__}); falling back "
+                    f"to the previous step", RuntimeWarning)
+                continue
+            self.last_restored_step, self.last_restored_meta = s, meta
+            return out, meta
+        raise FileNotFoundError(
+            f"no readable checkpoint in {self.directory} (tried steps "
+            f"{candidates})") from last_err
 
     @staticmethod
     def _resolve_remap(meta, layout):
@@ -273,19 +356,26 @@ class CheckpointManager:
         ``layout`` is the RESTORING run's (num_hidden_layers, pp_size
         [, interleave]); an inference engine wants ``(L, 1)``, which remaps
         pp-padded or interleave-permuted stacks to the contiguous order the
-        decode scan expects. Returns (params, step, trained_tokens)."""
+        decode scan expects. Returns (params, step, trained_tokens).
+        Shares the corrupt-latest fallback with ``load``."""
         ocp = self._ocp
-        step, meta = self._resolve_step(step)
-        remap = self._resolve_remap(meta, layout)
-        restored = self.manager.restore(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(
-                    _as_abstract(params_like, remap))),
-        )
+        state: dict = {}
+
+        def guards(meta):
+            state["remap"] = self._resolve_remap(meta, layout)
+
+        def restore(s, meta):
+            return self.manager.restore(
+                s,
+                args=ocp.args.Composite(
+                    params=ocp.args.StandardRestore(
+                        _as_abstract(params_like, state["remap"]))),
+            )
+
+        restored, meta = self._fallback_restore(step, guards, restore)
         params = restored["params"]
-        if remap is not None:
-            params = _remap_tree(params, params_like, remap)
+        if state["remap"] is not None:
+            params = _remap_tree(params, params_like, state["remap"])
         return params, int(meta["step"]), int(meta["trained_tokens"])
 
     def wait_until_finished(self) -> None:
@@ -324,18 +414,20 @@ _TOP_MAP = {
 class _SafetensorsReader:
     """Uniform reader over a single ``model.safetensors`` or a sharded
     ``model.safetensors.index.json`` directory (the two layouts the reference
-    handles at checkpoint.py:62-86)."""
+    handles at checkpoint.py:62-86). File opens are retried (HF snapshots
+    commonly live on network mounts); already-open handles are cached."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, io_attempts: int = 3,
+                 io_backoff: float = 0.5):
         from safetensors import safe_open
 
         self._safe_open = safe_open
+        self._retry = partial(retry, attempts=io_attempts, backoff=io_backoff)
         self._handles: dict[str, Any] = {}
         if os.path.isfile(path):
             self.index = None
             self._single = path
-            with safe_open(path, framework="np") as f:
-                self.names = set(f.keys())
+            self.names = set(self._handle(path).keys())
         else:
             index_file = os.path.join(path, "model.safetensors.index.json")
             single = os.path.join(path, "model.safetensors")
@@ -348,8 +440,7 @@ class _SafetensorsReader:
             elif os.path.exists(single):
                 self.index = None
                 self._single = single
-                with safe_open(single, framework="np") as f:
-                    self.names = set(f.keys())
+                self.names = set(self._handle(single).keys())
             else:
                 raise FileNotFoundError(
                     f"no model.safetensors[.index.json] under {path}"
@@ -360,11 +451,20 @@ class _SafetensorsReader:
             return self._single
         return os.path.join(self._dir, self.index[name])
 
-    def get(self, name: str) -> np.ndarray:
-        fpath = self._file_for(name)
+    def _handle(self, fpath: str):
         if fpath not in self._handles:
-            self._handles[fpath] = self._safe_open(fpath, framework="np").__enter__()
-        return self._handles[fpath].get_tensor(name)
+            self._handles[fpath] = self._retry(
+                lambda: self._safe_open(fpath, framework="np").__enter__(),
+                desc=f"open {os.path.basename(fpath)}")
+        return self._handles[fpath]
+
+    def get(self, name: str) -> np.ndarray:
+        return self._handle(self._file_for(name)).get_tensor(name)
+
+    def get_shape(self, name: str) -> tuple:
+        """Header-only shape lookup (``get_slice`` reads zero tensor bytes)."""
+        return tuple(self._handle(self._file_for(name))
+                     .get_slice(name).get_shape())
 
     def close(self) -> None:
         for h in self._handles.values():
@@ -477,22 +577,14 @@ def validate_hf_template(path: str, m: ModelConfig) -> None:
             want[tmpl.format(i=i)] = hf_shape(ours_layer[k], tr)
     optional = {_TOP_MAP["lm_head"][0]}  # tied embeddings omit the head
 
-    from safetensors import safe_open
-
     with _SafetensorsReader(path) as reader:
         missing = sorted(set(want) - reader.names - optional)
         if missing:
             raise ValueError(
                 f"{path} does not match the model config: missing tensors "
                 f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
-        shapes_by_file: dict[str, dict[str, tuple]] = {}
         for name in sorted(set(want) & reader.names):
-            f = reader._file_for(name)
-            if f not in shapes_by_file:
-                with safe_open(f, framework="np") as h:
-                    shapes_by_file[f] = {
-                        k: tuple(h.get_slice(k).get_shape()) for k in h.keys()}
-            got = shapes_by_file[f][name]
+            got = reader.get_shape(name)
             if got != want[name]:
                 raise ValueError(
                     f"{path} does not match the model config: {name} has "

@@ -218,6 +218,45 @@ class CheckpointConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault-tolerance knobs (picotron_tpu/resilience/, docs/RESILIENCE.md).
+    Defaults are production-safe: signals are caught, exits flush a
+    checkpoint, re-running the same command resumes, and a NaN step applies
+    no update. The chaos_* fields are a test/debug surface — deterministic
+    fault injection at a given 1-indexed step (0 = off)."""
+
+    # -- preemption safety --
+    handle_signals: bool = True  # SIGTERM/SIGINT -> finish dispatch, save, exit 75
+    save_on_exit: bool = True  # try/finally emergency save (needs save_frequency > 0)
+    # Empty load_path + an existing checkpoint under save_dir resumes from it
+    # (load_path "auto" asks for the same thing explicitly); re-running one
+    # command continues one run. False restores start-from-scratch semantics.
+    auto_resume: bool = True
+    # -- loss-anomaly guard --
+    # jit-side gate: a non-finite loss OR gradient applies no param/opt
+    # update (jnp.where select inside the train step — numerically identity
+    # on finite steps).
+    nonfinite_guard: bool = True
+    anomaly_policy: str = "skip"  # "skip" | "rollback" | "abort"
+    anomaly_ema_beta: float = 0.95
+    anomaly_zscore: float = 6.0  # spike = deviation > zscore * EMA-std
+    anomaly_warmup_steps: int = 20  # steps before spike detection arms
+    rollback_after: int = 3  # consecutive anomalies before a rollback
+    max_rollbacks: int = 2  # then abort (a livelocked run must not loop)
+    # -- retrying I/O (checkpoint saves/restores, safetensors reads) --
+    io_attempts: int = 3
+    io_backoff: float = 0.5  # seconds; doubles per attempt
+    io_jitter: float = 0.25  # uniform [1, 1+jitter] delay scale
+    # -- supervisor heartbeat (tools/supervise.py); also via $PICOTRON_HEARTBEAT --
+    heartbeat_path: str = ""
+    # -- chaos injection (resilience/chaos.py; each fires once per process) --
+    chaos_raise_step: int = 0
+    chaos_nan_step: int = 0
+    chaos_sigterm_step: int = 0
+    chaos_truncate_step: int = 0
+
+
+@dataclass
 class LoggingConfig:
     use_wandb: bool = False
     run_name: str = "picotron-tpu"
@@ -248,6 +287,7 @@ class Config:
     dataset: DatasetConfig = field(default_factory=DatasetConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     @property
     def world_size(self) -> int:
@@ -445,6 +485,40 @@ class Config:
             raise ValueError(
                 f"seq_length {t.seq_length} > max_position_embeddings "
                 f"{m.max_position_embeddings}")
+        r = self.resilience
+        if r.anomaly_policy not in ("skip", "rollback", "abort"):
+            raise ValueError(
+                f"unknown anomaly_policy {r.anomaly_policy!r} "
+                "(skip|rollback|abort)")
+        if r.anomaly_policy == "rollback" and self.checkpoint.save_frequency <= 0:
+            raise ValueError(
+                "anomaly_policy='rollback' needs checkpoint.save_frequency > 0 "
+                "(there is nothing to roll back to without checkpoints)")
+        if not 0.0 < r.anomaly_ema_beta < 1.0:
+            raise ValueError("anomaly_ema_beta must be in (0, 1)")
+        if r.io_attempts < 1:
+            raise ValueError("io_attempts must be >= 1")
+        if r.io_backoff < 0 or r.io_jitter < 0:
+            raise ValueError("io_backoff and io_jitter must be >= 0")
+        if r.rollback_after < 1:
+            raise ValueError("rollback_after must be >= 1")
+        if r.max_rollbacks < 0:
+            raise ValueError("max_rollbacks must be >= 0")
+        chaos_on = False
+        for name in ("chaos_raise_step", "chaos_nan_step",
+                     "chaos_sigterm_step", "chaos_truncate_step"):
+            v = getattr(r, name)
+            if v < 0:
+                raise ValueError(f"{name} must be >= 0 (0 = off)")
+            chaos_on = chaos_on or v > 0
+        if chaos_on and t.steps_per_call != 1:
+            # chaos fires at exact host-visible step boundaries (and NaN
+            # injection swaps in a poisoned single-step program for exactly
+            # one dispatch); inside a fused multi-step scan the target step
+            # has no dispatch boundary of its own, so the event would
+            # silently never fire — refuse instead
+            raise ValueError(
+                "chaos_*_step injection requires training.steps_per_call == 1")
 
     # ---- JSON round-trip (reference: train.py:62-63 consumes one JSON file) ----
 
@@ -468,6 +542,7 @@ class Config:
             dataset=build(DatasetConfig, raw.get("dataset", {})),
             checkpoint=build(CheckpointConfig, raw.get("checkpoint", {})),
             logging=build(LoggingConfig, raw.get("logging", {})),
+            resilience=build(ResilienceConfig, raw.get("resilience", {})),
         )
         cfg.validate()
         return cfg

@@ -19,7 +19,7 @@ out of single-controller JAX:
 
 from __future__ import annotations
 
-from typing import Iterator
+from typing import Iterator, Optional
 
 import numpy as np
 
@@ -198,6 +198,51 @@ class MicroBatchDataLoader:
         total = n_steps * self.grad_acc * self.rows_per_step
         wraps, self._cursor = divmod(self._cursor + total, len(self.samples))
         self._epoch += wraps
+
+    def seek_steps(self, n_steps: int) -> None:
+        """Position the cursor ABSOLUTELY at the start of global batch
+        ``n_steps`` (rollback support: a resumed-from-checkpoint run must
+        replay the exact batches the rolled-back steps consumed)."""
+        self._cursor = 0
+        self._epoch = 0
+        self.skip_steps(n_steps)
+
+    def state_meta(self, step: int) -> dict:
+        """Position + geometry for checkpoint metadata: ``consumed_rows`` is
+        the absolute sample-row position after ``step`` global batches, and
+        the geometry fields are what that position was computed FROM — a
+        resume whose config changed the batch geometry cannot silently
+        continue on wrong data (see ``verify_resume``)."""
+        return {
+            "consumed_steps": int(step),
+            "consumed_rows": int(step) * self.grad_acc * self.rows_per_step,
+            "grad_acc": self.grad_acc,
+            "rows_per_step": self.rows_per_step,
+            "seq_length": self.seq_length,
+            "num_samples": len(self.samples),
+        }
+
+    def verify_resume(self, saved: Optional[dict], step: int) -> None:
+        """Assert the saved loader position against what ``skip_steps(step)``
+        will reproduce under THIS config. Checkpoints predating the data
+        metadata (saved is None) pass — geometry drift was undetectable for
+        them anyway. Fails loudly on any mismatch: a changed micro-batch
+        size, grad-accum, dp width, seq_length, or corpus size means the
+        resumed run would train on different tokens than the original."""
+        if not saved:
+            return
+        cur = self.state_meta(step)
+        bad = {k: (saved[k], cur[k])
+               for k in sorted(set(saved) & set(cur)) if saved[k] != cur[k]}
+        if bad:
+            detail = ", ".join(
+                f"{k}: saved={s} now={n}" for k, (s, n) in bad.items())
+            raise ValueError(
+                f"checkpoint data-loader position does not match this "
+                f"config ({detail}); the batch geometry changed between "
+                f"save and resume — resuming would silently train on "
+                f"different data. Restore under the saving run's geometry "
+                f"or start a fresh run.")
 
     def __iter__(self) -> Iterator[dict]:
         return self

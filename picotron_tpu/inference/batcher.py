@@ -13,9 +13,9 @@ the host-side loop that does exactly that:
   decode: ONE ``decode_step`` advances every occupied slot together —
           per-slot sampling params ride along as arrays, so mixed
           greedy/temperature/top-k/top-p traffic shares the program;
-  retire: slots that hit EOS or their token budget release (a 1-element
-          length write — stale K/V rows become unreachable) and free
-          capacity for the next admit.
+  retire: slots that hit EOS, their token budget, or their wall-clock
+          deadline release (a 1-element length write — stale K/V rows
+          become unreachable) and free capacity for the next admit.
 
 Free slots still flow through the decode program (fixed shapes are the
 deal with XLA); they carry token 0 at length 0 and their outputs are
@@ -25,6 +25,7 @@ chain, split once per admit and once per decode round.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
@@ -38,7 +39,10 @@ from picotron_tpu.inference import sampling
 @dataclass
 class Request:
     """One generation request. ``temperature == 0`` = greedy; ``top_k <= 0``
-    and ``top_p >= 1`` disable those filters."""
+    and ``top_p >= 1`` disable those filters. ``timeout_s`` is a wall-clock
+    budget from admission: a stuck or over-budget request finishes with
+    reason "timeout" and frees its slot instead of occupying it forever
+    (None = no deadline)."""
 
     uid: str
     prompt: list
@@ -47,6 +51,7 @@ class Request:
     top_k: int = 0
     top_p: float = 1.0
     eos_id: Optional[int] = None
+    timeout_s: Optional[float] = None
 
 
 @dataclass
@@ -54,13 +59,14 @@ class GenerationResult:
     uid: str
     prompt: list
     tokens: list  # generated ids, EOS included when hit
-    finish_reason: str  # "eos" | "length"
+    finish_reason: str  # "eos" | "length" | "timeout"
 
 
 @dataclass
 class _Slot:
     req: Request
     generated: list = field(default_factory=list)
+    deadline: Optional[float] = None  # clock() time after which we retire
 
 
 class ContinuousBatcher:
@@ -76,9 +82,10 @@ class ContinuousBatcher:
     not (decode_step consumes it).
     """
 
-    def __init__(self, engine, params, seed: int = 0):
+    def __init__(self, engine, params, seed: int = 0, clock=time.monotonic):
         self.engine = engine
         self.params = params
+        self._clock = clock  # injectable so deadline tests are deterministic
         self._key = jax.random.PRNGKey(seed)
         self._cache = engine.init_cache()
         self._slots: list = [None] * engine.slots
@@ -93,6 +100,10 @@ class ContinuousBatcher:
     # ---- queue surface ----------------------------------------------------
 
     def submit(self, req: Request) -> None:
+        if not req.prompt:
+            # fail at submission, not inside run(): an admit-time prefill
+            # error would throw away every already-finished result
+            raise ValueError(f"request {req.uid!r}: empty prompt")
         budget = self.engine.max_seq_len - len(req.prompt)
         if budget < 1:
             raise ValueError(
@@ -155,7 +166,9 @@ class ContinuousBatcher:
             kv, logits = self.engine.prefill(self.params, req.prompt)
             self._cache = self.engine.insert(
                 self._cache, kv, i, len(req.prompt))
-            self._slots[i] = _Slot(req)
+            deadline = (self._clock() + req.timeout_s
+                        if req.timeout_s is not None else None)
+            self._slots[i] = _Slot(req, deadline=deadline)
             self._temp[i] = req.temperature
             self._top_k[i] = req.top_k
             self._top_p[i] = req.top_p
@@ -166,10 +179,21 @@ class ContinuousBatcher:
                 np.float32([req.top_p]))[0])
             self._token_done(i, first)
 
+    def _expire_deadlines(self) -> None:
+        """Retire every slot past its deadline with reason "timeout" — the
+        slot frees immediately, so a stuck or over-budget request cannot
+        starve the queue behind it. Runs once per scheduler round, before
+        the decode dispatch (an expired request gets no further tokens)."""
+        now = self._clock()
+        for i, s in enumerate(self._slots):
+            if s is not None and s.deadline is not None and now >= s.deadline:
+                self._finish(i, "timeout")
+
     def step(self) -> None:
         """Admit waiting requests into free slots, then advance every
         occupied slot one token."""
         self._admit()
+        self._expire_deadlines()
         if not any(s is not None for s in self._slots):
             return
         self._cache, toks, _ = self.engine.decode_step(

@@ -1,0 +1,41 @@
+"""Fault tolerance: preemption safety, loss-anomaly policies, retrying I/O,
+and deterministic chaos injection.
+
+Production TPU pods are preemptible and large runs hit faults daily —
+MegaScale and the Llama-3 infrastructure report both attribute most lost
+throughput to restarts and loss spikes, not steady-state speed. This package
+holds the host-side machinery that turns those events from run-killers into
+bounded hiccups:
+
+- ``preemption``  — SIGTERM/SIGINT guard: finish the in-flight dispatch,
+  write an emergency checkpoint, exit with ``EXIT_PREEMPTED``;
+- ``anomaly``     — EMA loss-spike detector with skip/rollback/abort
+  policies (the jit-side non-finite gate lives in ``train_step``);
+- ``retry``       — bounded exponential-backoff retry for checkpoint and
+  safetensors I/O;
+- ``chaos``       — config-driven deterministic fault injector (raise /
+  NaN loss / SIGTERM / checkpoint truncation at step k) so recovery has a
+  tier-1 test surface instead of being exercised only by real outages.
+
+The supervisor (``tools/supervise.py``) sits one level above: a bounded-
+restart watchdog around ``python -m picotron_tpu.train`` keyed off these
+exit codes and a heartbeat file.
+"""
+
+from picotron_tpu.resilience.anomaly import (  # noqa: F401
+    Anomaly,
+    AnomalyAbort,
+    LossAnomalyDetector,
+)
+from picotron_tpu.resilience.chaos import ChaosError, ChaosInjector  # noqa: F401
+from picotron_tpu.resilience.preemption import (  # noqa: F401
+    EXIT_PREEMPTED,
+    PreemptionGuard,
+    was_preempted,
+)
+from picotron_tpu.resilience.retry import retry  # noqa: F401
+
+# Distinct exit code for an anomaly-policy abort (vs 1 = crash, EXIT_PREEMPTED
+# = graceful preemption): the supervisor and schedulers can tell "the loss
+# diverged, human attention needed" from "re-run me".
+EXIT_ANOMALY = 76

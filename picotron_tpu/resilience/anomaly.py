@@ -1,0 +1,86 @@
+"""Host-side loss-anomaly detection with configurable recovery policies.
+
+Two layers catch a diverging run:
+
+1. The jit-compatible non-finite gate inside ``train_step`` (a ``jnp.where``
+   on loss finiteness) guarantees a NaN/Inf step applies **no** param or
+   optimizer update — that part must live on-device because by the time the
+   host sees the loss, a donated update would already have been applied.
+2. This detector sees every per-step loss on the host and flags both
+   non-finite values and finite *spikes* against an EMA baseline, then the
+   train loop applies the configured policy:
+
+   - ``skip``     — log and continue (the device gate already dropped the
+                    update for non-finite steps);
+   - ``rollback`` — after K consecutive anomalies, restore the last
+                    checkpoint and replay (bounded by ``max_rollbacks``);
+   - ``abort``    — raise ``AnomalyAbort`` (exit code ``EXIT_ANOMALY``).
+
+Spike statistics are EMA(loss) and EMA of squared deviation; a loss is a
+spike when its deviation exceeds ``zscore * std`` (with an absolute floor so
+a near-zero-variance plateau isn't hair-trigger). Anomalous values are NOT
+absorbed into the EMA — one spike must not drag the baseline up and mask
+the next one.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+
+class AnomalyAbort(RuntimeError):
+    """Raised by the train loop when the anomaly policy says stop."""
+
+
+@dataclass
+class Anomaly:
+    step: int
+    loss: float
+    kind: str  # "nonfinite" | "spike"
+    ema: Optional[float]  # baseline at detection time (None pre-warmup)
+    consecutive: int  # length of the current anomaly streak, this one included
+
+
+class LossAnomalyDetector:
+    def __init__(self, ema_beta: float = 0.95, zscore: float = 6.0,
+                 warmup_steps: int = 20, min_deviation: float = 0.05):
+        self.ema_beta = float(ema_beta)
+        self.zscore = float(zscore)
+        self.warmup_steps = int(warmup_steps)
+        self.min_deviation = float(min_deviation)
+        self.reset()
+
+    def reset(self) -> None:
+        """Forget all statistics — called after a rollback so the replayed
+        window re-warms instead of being judged against post-spike stats."""
+        self._ema: Optional[float] = None
+        self._var = 0.0
+        self._n = 0
+        self.consecutive = 0
+
+    def observe(self, step: int, loss: float) -> Optional[Anomaly]:
+        """Feed one per-step loss; returns an ``Anomaly`` or None."""
+        loss = float(loss)
+        if not math.isfinite(loss):
+            self.consecutive += 1
+            return Anomaly(step, loss, "nonfinite", self._ema, self.consecutive)
+
+        if self._ema is not None and self._n >= self.warmup_steps:
+            std = math.sqrt(max(self._var, 0.0))
+            if loss - self._ema > max(self.min_deviation, self.zscore * std):
+                self.consecutive += 1
+                return Anomaly(step, loss, "spike", self._ema, self.consecutive)
+
+        # healthy step: absorb into the baseline
+        self.consecutive = 0
+        if self._ema is None:
+            self._ema = loss
+        else:
+            b = self.ema_beta
+            dev = loss - self._ema
+            self._ema = b * self._ema + (1.0 - b) * loss
+            self._var = b * self._var + (1.0 - b) * dev * dev
+        self._n += 1
+        return None

@@ -1,0 +1,103 @@
+"""Deterministic, config-driven fault injection.
+
+Robustness code that is only exercised by real outages regresses silently —
+the chaos injector gives every recovery path a reproducible trigger so the
+tier-1 suite can prove kill→resume equivalence on a CPU mesh:
+
+- ``chaos_raise_step``    — raise ``ChaosError`` after step k completes
+                            (an unhandled crash; the train loop's
+                            try/finally must still flush a checkpoint);
+- ``chaos_nan_step``      — step k's dispatch runs a loss/grad-poisoned
+                            program (``train_step.build_train_step(...,
+                            poison_nonfinite=True)``), driving the on-device
+                            non-finite gate and the host detector;
+- ``chaos_sigterm_step``  — SIGTERM to our own pid after step k (a
+                            preemption; the PreemptionGuard path);
+- ``chaos_truncate_step`` — after step k's save, truncate the largest file
+                            of the newest checkpoint step (a partial write;
+                            the restore-fallback path).
+
+Each event fires at most once per process, so a rollback that replays step k
+does not re-trip the same fault (which would livelock the rollback policy).
+All steps are 1-indexed optimizer steps; 0 disables an event.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+from picotron_tpu.utils import log0
+
+
+class ChaosError(RuntimeError):
+    """The injected crash — deliberately NOT caught anywhere in the trainer,
+    so it exercises the same try/finally path a real bug would."""
+
+
+def truncate_latest_checkpoint(save_dir: str) -> str:
+    """Truncate the largest file under the newest orbax step directory to
+    simulate a partial/interrupted write. Returns the truncated path."""
+    steps = [d for d in os.listdir(save_dir)
+             if d.isdigit() and os.path.isdir(os.path.join(save_dir, d))]
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint step dirs under {save_dir}")
+    step_dir = os.path.join(save_dir, max(steps, key=int))
+    victim, size = None, -1
+    for root, _, files in os.walk(step_dir):
+        for f in files:
+            p = os.path.join(root, f)
+            s = os.path.getsize(p)
+            if s > size:
+                victim, size = p, s
+    if victim is None:
+        raise FileNotFoundError(f"no files under {step_dir}")
+    with open(victim, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    return victim
+
+
+class ChaosInjector:
+    def __init__(self, r, save_dir: str = ""):
+        """``r`` is a ResilienceConfig; ``save_dir`` is the checkpoint dir
+        (needed only for truncation)."""
+        self.raise_step = int(r.chaos_raise_step)
+        self.nan_step = int(r.chaos_nan_step)
+        self.sigterm_step = int(r.chaos_sigterm_step)
+        self.truncate_step = int(r.chaos_truncate_step)
+        self.save_dir = save_dir
+        self._fired: set = set()
+
+    @property
+    def active(self) -> bool:
+        return any(s > 0 for s in (self.raise_step, self.nan_step,
+                                   self.sigterm_step, self.truncate_step))
+
+    def _fire_once(self, event: str, at: int, step: int) -> bool:
+        if at > 0 and step == at and event not in self._fired:
+            self._fired.add(event)
+            return True
+        return False
+
+    def poison_step(self, step: int) -> bool:
+        """Whether the dispatch about to run step ``step`` should use the
+        NaN-poisoned program. Consumes the event."""
+        if self._fire_once("nan", self.nan_step, step):
+            log0(f"chaos: poisoning step {step} with a non-finite loss")
+            return True
+        return False
+
+    def after_step(self, step: int, manager=None) -> None:
+        """Fire post-step events. Truncation runs before sigterm/raise so a
+        combined config corrupts, then dies — the worst realistic ordering.
+        Raise fires last (it does not return)."""
+        if self._fire_once("truncate", self.truncate_step, step):
+            if manager is not None:
+                manager.wait_until_finished()  # corrupt a COMPLETE write
+            victim = truncate_latest_checkpoint(self.save_dir)
+            log0(f"chaos: truncated {victim} after step {step}")
+        if self._fire_once("sigterm", self.sigterm_step, step):
+            log0(f"chaos: SIGTERM to self after step {step}")
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self._fire_once("raise", self.raise_step, step):
+            raise ChaosError(f"chaos: injected crash after step {step}")

@@ -1,0 +1,76 @@
+"""Preemption safety: catch SIGTERM/SIGINT, finish the dispatch, save, exit.
+
+Preemptible TPU pools deliver SIGTERM with a short grace window. The guard
+turns that into a cooperative shutdown: the first signal only sets a flag —
+the train loop checks it at dispatch boundaries, writes an emergency
+checkpoint, and the process exits with ``EXIT_PREEMPTED`` so supervisors can
+tell "re-run the same command" from a crash. A second signal falls through
+to a KeyboardInterrupt (the operator really means it); the original handlers
+are restored on uninstall so embedding processes (pytest, notebooks) are
+left untouched.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Optional
+
+from picotron_tpu.utils import log0
+
+# EX_TEMPFAIL from sysexits.h: "transient failure, invoke again later" — the
+# exact semantics of a preempted-but-checkpointed run.
+EXIT_PREEMPTED = 75
+
+_LAST: Optional["PreemptionGuard"] = None
+
+
+class PreemptionGuard:
+    """Install with ``guard = PreemptionGuard().install()``; poll
+    ``guard.triggered`` at dispatch boundaries; ``uninstall()`` in a finally.
+    Also usable as a context manager."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._prev: dict = {}
+        self.triggered = False
+        self.signame: Optional[str] = None
+
+    def install(self) -> "PreemptionGuard":
+        global _LAST
+        for s in self._signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handle)
+            except ValueError:
+                # not the main thread (embedded runs): signal handlers are
+                # unavailable there — degrade to a no-op guard
+                log0("preemption guard: not on the main thread, "
+                     "signal handling disabled")
+                break
+        _LAST = self
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+
+    def _handle(self, signum, frame) -> None:
+        if self.triggered:
+            # second signal: the grace period is over — restore defaults and
+            # surface an interrupt so even a wedged loop dies
+            self.uninstall()
+            raise KeyboardInterrupt(f"second {signal.Signals(signum).name}")
+        self.triggered = True
+        self.signame = signal.Signals(signum).name
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+def was_preempted() -> bool:
+    """Whether the most recently installed guard caught a signal — the
+    entry point (``train.main``) keys its exit code off this."""
+    return _LAST is not None and _LAST.triggered

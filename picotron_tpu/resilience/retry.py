@@ -1,0 +1,50 @@
+"""Bounded retry with exponential backoff + jitter for flaky I/O.
+
+Checkpoint saves/restores and safetensors reads cross NFS/GCS mounts where
+transient errors (stale handles, connection resets, throttling) are routine
+on big fleets. One shared primitive keeps the policy uniform: attempts are
+bounded (a deterministic failure surfaces quickly, with the original
+exception), delays grow exponentially, and jitter decorrelates the herd of
+hosts that all hit the same flake at the same step.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Tuple, Type
+
+from picotron_tpu.utils import log0
+
+
+def retry(
+    fn: Callable,
+    attempts: int = 3,
+    backoff: float = 0.5,
+    jitter: float = 0.25,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    desc: str = "",
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Callable[[], float] = random.random,
+):
+    """Call ``fn()`` up to ``attempts`` times; return its result.
+
+    Delay before attempt k (1-indexed) is ``backoff * 2**(k-1)`` scaled by a
+    uniform jitter in [1, 1+jitter]. The final failure re-raises the original
+    exception unchanged. ``KeyboardInterrupt``/``SystemExit`` are never
+    swallowed (they are not ``Exception`` subclasses). ``sleep``/``rng`` are
+    injectable so tests run instantly and deterministically.
+    """
+    if attempts < 1:
+        raise ValueError(f"retry needs attempts >= 1, got {attempts}")
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts:
+                raise
+            delay = backoff * (2 ** (attempt - 1)) * (1.0 + jitter * rng())
+            log0(f"retry{f' [{desc}]' if desc else ''}: attempt "
+                 f"{attempt}/{attempts} failed ({type(e).__name__}: {e}); "
+                 f"retrying in {delay:.2f}s", flush=True)
+            sleep(delay)

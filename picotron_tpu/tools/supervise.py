@@ -1,0 +1,135 @@
+"""Bounded-restart watchdog: ``python -m picotron_tpu.tools.supervise [opts] -- cmd...``
+
+The outermost layer of the resilience stack (docs/RESILIENCE.md): keeps a
+trainer running across crashes and preemptions without ever looping forever.
+
+- **bounded restarts** — a nonzero exit relaunches the command after an
+  exponential backoff, at most ``--max-restarts`` times; then the child's
+  final exit code is propagated (a scheduler sees the real failure, not a
+  lying 0);
+- **stall detection** — the child heartbeats a file (the trainer touches
+  ``$PICOTRON_HEARTBEAT`` every dispatch); a heartbeat older than
+  ``--stall-timeout`` means the run is wedged (deadlocked collective, hung
+  remote mount): SIGTERM, a grace period, then SIGKILL, counted as a
+  restart;
+- **preemption aware** — exit code ``EXIT_PREEMPTED`` (75) means "resumable
+  checkpoint written, re-run me"; it is restarted like any failure but the
+  trainer's auto-resume makes the relaunch continue the run.
+
+Typical use::
+
+    python -m picotron_tpu.tools.supervise --max-restarts 5 \
+        --heartbeat /tmp/hb --stall-timeout 600 -- \
+        python -m picotron_tpu.train --config exp.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _heartbeat_age(path: str) -> float:
+    try:
+        return time.time() - os.path.getmtime(path)
+    except OSError:
+        return 0.0  # no file yet: the launch touch below seeds it
+
+
+def _touch(path: str) -> None:
+    with open(path, "a"):
+        os.utime(path, None)
+
+
+def _terminate(proc: subprocess.Popen, grace: float) -> int:
+    """SIGTERM, wait out the grace period, SIGKILL. Returns the exit code."""
+    proc.send_signal(signal.SIGTERM)
+    try:
+        return proc.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return proc.wait()
+
+
+def run_supervised(cmd, max_restarts: int = 3, backoff: float = 1.0,
+                   backoff_max: float = 60.0, heartbeat: str = "",
+                   stall_timeout: float = 0.0, term_grace: float = 10.0,
+                   poll_interval: float = 0.2) -> int:
+    """Run ``cmd`` under supervision; returns the exit code to propagate.
+    ``stall_timeout`` <= 0 disables stall detection. Importable so the chaos
+    suite drives it in-process (the children are still real subprocesses)."""
+    env = dict(os.environ)
+    if heartbeat:
+        env["PICOTRON_HEARTBEAT"] = heartbeat
+    attempt = 0  # restarts used so far
+    while True:
+        if heartbeat:
+            _touch(heartbeat)  # launch counts as liveness: startup gets a full window
+        print(f"supervise: launching (restart {attempt}/{max_restarts}): "
+              f"{' '.join(cmd)}", flush=True)
+        proc = subprocess.Popen(cmd, env=env)
+        stalled = False
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            if (heartbeat and stall_timeout > 0
+                    and _heartbeat_age(heartbeat) > stall_timeout):
+                print(f"supervise: heartbeat stale for > {stall_timeout}s; "
+                      f"killing the stalled trainer", flush=True)
+                rc = _terminate(proc, term_grace)
+                stalled = True
+                break
+            time.sleep(poll_interval)
+        if rc == 0 and not stalled:
+            print("supervise: trainer exited cleanly", flush=True)
+            return 0
+        attempt += 1
+        if attempt > max_restarts:
+            code = rc if rc >= 0 else 128 - rc  # shell convention for signal deaths
+            print(f"supervise: exhausted {max_restarts} restarts; "
+                  f"propagating exit code {code}", flush=True)
+            return code
+        delay = min(backoff * (2 ** (attempt - 1)), backoff_max)
+        print(f"supervise: exit code {rc}{' (stall-killed)' if stalled else ''}; "
+              f"restart {attempt}/{max_restarts} in {delay:.1f}s", flush=True)
+        time.sleep(delay)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="bounded-restart watchdog around a trainer command "
+                    "(everything after -- is the command line)")
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--backoff", type=float, default=1.0,
+                        help="first restart delay; doubles per restart")
+    parser.add_argument("--backoff-max", type=float, default=60.0)
+    parser.add_argument("--heartbeat", default="",
+                        help="heartbeat file (exported as PICOTRON_HEARTBEAT)")
+    parser.add_argument("--stall-timeout", type=float, default=0.0,
+                        help="seconds of stale heartbeat before a stall kill "
+                             "(0 = off)")
+    parser.add_argument("--term-grace", type=float, default=10.0,
+                        help="seconds between SIGTERM and SIGKILL on a stall")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- then the command to supervise")
+    args = parser.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command given (usage: supervise [opts] -- cmd ...)")
+    if args.stall_timeout > 0 and not args.heartbeat:
+        parser.error("--stall-timeout needs --heartbeat")
+    return run_supervised(
+        cmd, max_restarts=args.max_restarts, backoff=args.backoff,
+        backoff_max=args.backoff_max, heartbeat=args.heartbeat,
+        stall_timeout=args.stall_timeout, term_grace=args.term_grace)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,14 @@ trained tokens, MFU, device memory — which is exactly what the
 extract_metrics CLI scrapes (extract_metrics.py:55-68). wandb logging is
 opt-in with the same run-name convention (train.py:132-150); a jax.profiler
 trace window replaces the reference's absent profiler (SURVEY.md §5.1).
+
+Fault tolerance (picotron_tpu/resilience/, docs/RESILIENCE.md) is wired
+through the loop: SIGTERM/SIGINT finish the in-flight dispatch, flush an
+emergency checkpoint, and exit ``EXIT_PREEMPTED``; ANY crash still flushes a
+final save via try/finally; re-running the same command auto-resumes from
+the latest checkpoint; per-step losses feed an EMA anomaly detector with
+skip/rollback/abort policies; and a config-driven chaos injector gives all
+of it a deterministic test surface (``make chaos-smoke``).
 """
 
 from __future__ import annotations
@@ -70,167 +78,294 @@ def _wandb_init(cfg):
     return wandb
 
 
-def train(cfg, max_steps_override: Optional[int] = None):
-    """Run the training loop; returns (final_step, trained_tokens, last_loss)."""
+def _touch(path: str) -> None:
+    """Heartbeat for the supervisor's stall detector: mtime = liveness."""
+    try:
+        with open(path, "a"):
+            os.utime(path, None)
+    except OSError:
+        pass  # a lost heartbeat must never kill the training step
+
+
+def _savable(*trees) -> bool:
+    """Whether every leaf is a live array: after a crash INSIDE a donating
+    dispatch, the loop variables still reference the donated (deleted)
+    inputs, which cannot be saved — the last periodic checkpoint stands."""
+    import jax
+
+    return not any(
+        getattr(x, "is_deleted", lambda: False)()
+        for t in trees for x in jax.tree.leaves(t))
+
+
+def train(cfg, max_steps_override: Optional[int] = None,
+          loss_history: Optional[list] = None):
+    """Run the training loop; returns (final_step, trained_tokens, last_loss).
+
+    ``loss_history``, when given, collects ``(step, loss)`` per optimizer
+    step — the chaos/equivalence suite compares full trajectories through
+    it instead of scraping logs."""
     import jax
 
     from picotron_tpu import checkpoint as ckpt_mod
+    from picotron_tpu import resilience
     from picotron_tpu import train_step as ts
     from picotron_tpu import utils
     from picotron_tpu.data import MicroBatchDataLoader
     from picotron_tpu.models import llama
+    from picotron_tpu.resilience.anomaly import AnomalyAbort, LossAnomalyDetector
+    from picotron_tpu.resilience.chaos import ChaosInjector
+    from picotron_tpu.resilience.preemption import PreemptionGuard
     from picotron_tpu.topology import topology_from_config
 
     t0_setup = time.perf_counter()
     topo = topology_from_config(cfg)
-    m, t, c, lg = cfg.model, cfg.training, cfg.checkpoint, cfg.logging
+    m, t, c, lg, r = (cfg.model, cfg.training, cfg.checkpoint, cfg.logging,
+                      cfg.resilience)
     utils.set_all_seed(t.seed)
 
-    loader = MicroBatchDataLoader(cfg)
-    params, opt_state = ts.init_state(cfg, topo)
-    if c.hf_bootstrap_path:
-        # header-only names+shapes check — zero tensor bytes read; guards
-        # BOTH modes against a template that disagrees with the model config
-        ckpt_mod.validate_hf_template(c.hf_bootstrap_path, m)
-        if c.hf_bootstrap_reinit:
-            # reference semantics (checkpoint.py:99-100): the HF file is a
-            # shape template only; training starts from the seed-derived
-            # random init above
-            utils.log0(f"hf_bootstrap_reinit: validated "
-                       f"{c.hf_bootstrap_path} as a shape template; keeping "
-                       f"random init (reference re-randomize semantics)")
-        else:
-            params = ckpt_mod.load_hf_safetensors(
-                c.hf_bootstrap_path, m, topo,
-                interleave=cfg.distributed.pp_interleave,
-                fsdp=cfg.distributed.fsdp)
-    spc = t.steps_per_call
-    step_fn = ts.build_train_step(cfg, topo, multi_step=spc)
-    step_fn_single = step_fn if spc == 1 else None  # lazily built for the tail
+    guard = PreemptionGuard().install() if r.handle_signals \
+        else PreemptionGuard()  # not installed: .triggered stays False
+    chaos = ChaosInjector(r, save_dir=c.save_dir)
+    detector = LossAnomalyDetector(
+        ema_beta=r.anomaly_ema_beta, zscore=r.anomaly_zscore,
+        warmup_steps=r.anomaly_warmup_steps)
+    heartbeat = r.heartbeat_path or os.environ.get("PICOTRON_HEARTBEAT", "")
 
+    # state the finally below may touch — defined before anything can raise
     manager = None
-    if c.save_frequency > 0 or c.load_path:
-        manager = ckpt_mod.CheckpointManager(c.load_path or c.save_dir)
-
+    wandb = None
+    params = opt_state = None
+    step = last_saved_step = trained_tokens = 0
+    loss = float("nan")
+    profiling = profile_done = False
     layout = (m.num_hidden_layers, cfg.distributed.pp_size,
               cfg.distributed.pp_interleave)
     z1 = (cfg.distributed.zero1, cfg.distributed.dp_size)
-    step, trained_tokens = 0, 0
-    if c.load_path:
-        params, opt_state, step, trained_tokens = manager.load(
-            params, opt_state, layout=layout, zero1=z1)
-        loader.skip_steps(step)
-        utils.log0(f"resumed from {c.load_path} at step {step} "
-                   f"({utils.to_readable_format(trained_tokens)} tokens)")
-        if c.load_path != c.save_dir and c.save_frequency > 0:
-            manager.close()
-            manager = ckpt_mod.CheckpointManager(c.save_dir)
 
-    # wandb/log gating: only the controller process reports (reference
-    # train.py:101, utils.py:12-20)
-    wandb = _wandb_init(cfg) if (lg.use_wandb and utils.is_main_process()) else None
-    n_params = llama.num_params(m)
-    peak = utils.peak_flops_per_chip()
-    n_chips = topo.world_size
-    max_steps = max_steps_override or t.total_train_steps
-    utils.log0(f"model {m.name}: {utils.to_readable_format(n_params)} params | "
-          f"mesh dp={topo.dp_size} pp={topo.pp_size} cp={topo.cp_size} "
-          f"tp={topo.tp_size} on {n_chips} x {jax.devices()[0].device_kind} | "
-          f"global batch {cfg.global_batch_size} "
-          f"({utils.to_readable_format(cfg.tokens_per_step)} tokens/step) | "
-          f"setup {time.perf_counter() - t0_setup:.1f}s")
+    try:
+        loader = MicroBatchDataLoader(cfg)
+        params, opt_state = ts.init_state(cfg, topo)
+        if c.hf_bootstrap_path:
+            # header-only names+shapes check — zero tensor bytes read; guards
+            # BOTH modes against a template that disagrees with the model config
+            ckpt_mod.validate_hf_template(c.hf_bootstrap_path, m)
+            if c.hf_bootstrap_reinit:
+                # reference semantics (checkpoint.py:99-100): the HF file is a
+                # shape template only; training starts from the seed-derived
+                # random init above
+                utils.log0(f"hf_bootstrap_reinit: validated "
+                           f"{c.hf_bootstrap_path} as a shape template; keeping "
+                           f"random init (reference re-randomize semantics)")
+            else:
+                params = ckpt_mod.load_hf_safetensors(
+                    c.hf_bootstrap_path, m, topo,
+                    interleave=cfg.distributed.pp_interleave,
+                    fsdp=cfg.distributed.fsdp)
+        spc = t.steps_per_call
+        step_fn = ts.build_train_step(cfg, topo, multi_step=spc)
+        step_fn_single = step_fn if spc == 1 else None  # lazily built for the tail
+        step_fn_poison = None  # lazily built chaos NaN-injection program
 
-    loss = float("nan")
-    last_saved_step = step
-    profiling = profile_done = False
-    while step < max_steps and (t.max_tokens is None or trained_tokens < t.max_tokens):
-        # Profiler window snaps to dispatch boundaries (a dispatch is spc
-        # steps): stop is checked before start so a window narrower than one
-        # dispatch still traces one full dispatch; the done latch makes the
-        # window fire exactly once.
-        if profiling and lg.profile_stop and step >= lg.profile_stop:
-            jax.profiler.stop_trace()
-            profiling, profile_done = False, True
-        if (lg.profile_start and not profiling and not profile_done
-                and step >= lg.profile_start):
-            jax.profiler.start_trace(lg.profile_dir)
-            profiling = True
-        t_start = time.perf_counter()
-        step_before = step
-        # spc optimizer steps per device dispatch; a tail shorter than spc
-        # (by step count OR token budget) would trigger a recompile at a new
-        # stack shape — run those steps singly instead.
-        steps_left = max_steps - step
-        if t.max_tokens is not None:
-            tokens_left = t.max_tokens - trained_tokens
-            steps_left = min(steps_left, -(-tokens_left // cfg.tokens_per_step))
-        k = spc if steps_left >= spc else 1
-        if k > 1:
-            tokens, targets = ts.shard_batch_stack(
-                [next(loader) for _ in range(k)], topo)
-            params, opt_state, loss_arr = step_fn(params, opt_state, tokens, targets)
-            losses = [float(x) for x in jax.block_until_ready(loss_arr)]
-        else:
-            tokens, targets = ts.shard_batch(next(loader), topo)
-            if step_fn_single is None:
-                step_fn_single = ts.build_train_step(cfg, topo)
-            params, opt_state, loss_arr = step_fn_single(
-                params, opt_state, tokens, targets)
-            losses = [float(jax.block_until_ready(loss_arr))]
-        dt_call = time.perf_counter() - t_start
-
-        # Throughput is per dispatch (identical for every step in the group);
-        # mfu/memory are computed lazily, once, and only if a step logs.
-        tok_s = k * cfg.tokens_per_step / dt_call
-        tok_s_chip = tok_s / n_chips
-        stats = None
-        for i, loss in enumerate(losses):
-            step += 1
-            trained_tokens += cfg.tokens_per_step
-            if step % lg.log_frequency == 0 and stats is None:
-                stats = (utils.get_mfu(tok_s_chip, n_params, m.num_hidden_layers,
-                                       m.hidden_size, t.seq_length, peak),
-                         utils.device_memory_gb())
-            mfu, mem = stats if stats is not None else (None, None)
-            if step % lg.log_frequency == 0:
-                parts = [
-                    f"Step: {step:<5d}",
-                    f"Loss: {loss:6.4f}",
-                    f"Global batch size: {utils.to_readable_format(cfg.tokens_per_step)}",
-                    f"Tokens/s: {utils.to_readable_format(tok_s)}",
-                    f"Tokens/s/chip: {utils.to_readable_format(tok_s_chip)}",
-                    f"Tokens: {utils.to_readable_format(trained_tokens)}",
-                ]
-                if mfu is not None:
-                    parts.append(f"MFU: {mfu:.2f}%")
-                if mem is not None:
-                    parts.append(f"Memory usage: {mem:.2f}GB")
-                utils.log0(" | ".join(parts), flush=True)
-            if wandb is not None and step % lg.log_frequency == 0:
-                wandb.log({"loss": loss, "tokens_per_sec": tok_s,
-                           "tokens_per_sec_per_chip": tok_s_chip,
-                           "trained_tokens": trained_tokens,
-                           **({"mfu": mfu} if mfu is not None else {}),
-                           **({"memory_gb": mem} if mem is not None else {})},
-                          step=step)
-
-        # Save at group boundaries only: params here are the end-of-group
-        # state, so the recorded step must be the end-of-group step.
-        if (manager is not None and c.save_frequency > 0
-                and step // c.save_frequency > step_before // c.save_frequency):
-            manager.save(step, params, opt_state, trained_tokens, layout=layout,
-                         zero1=z1)
+        # Resume resolution: an explicit load_path is REQUIRED to hold a
+        # checkpoint; "auto" (or, with resilience.auto_resume, an empty
+        # load_path while save_frequency > 0) discovers the latest checkpoint
+        # under save_dir when one exists — re-running the same command
+        # continues the run instead of restarting it from scratch.
+        resume_dir, resume_required = None, False
+        if c.load_path and c.load_path != "auto":
+            resume_dir, resume_required = c.load_path, True
+        elif c.load_path == "auto" or (r.auto_resume and c.save_frequency > 0):
+            resume_dir = c.save_dir
+        if c.save_frequency > 0 or resume_dir:
+            manager = ckpt_mod.CheckpointManager(
+                resume_dir or c.save_dir, io_attempts=r.io_attempts,
+                io_backoff=r.io_backoff, io_jitter=r.io_jitter)
+        if manager is not None and resume_dir and (
+                resume_required or manager.latest_step() is not None):
+            params, opt_state, step, trained_tokens = manager.load(
+                params, opt_state, layout=layout, zero1=z1)
+            # geometry guard BEFORE skipping: a changed batch geometry would
+            # silently position the loader on different data
+            loader.verify_resume(
+                (manager.last_restored_meta or {}).get("data"), step)
+            loader.skip_steps(step)
             last_saved_step = step
+            utils.log0(f"resumed from {resume_dir} at step {step} "
+                       f"({utils.to_readable_format(trained_tokens)} tokens)")
+            if resume_dir != c.save_dir and c.save_frequency > 0:
+                manager.close()
+                manager = ckpt_mod.CheckpointManager(
+                    c.save_dir, io_attempts=r.io_attempts,
+                    io_backoff=r.io_backoff, io_jitter=r.io_jitter)
 
-    if profiling:
-        jax.profiler.stop_trace()
-    if manager is not None:
-        if c.save_frequency > 0 and step != last_saved_step:
-            manager.save(step, params, opt_state, trained_tokens, layout=layout,
-                         zero1=z1)
-        manager.close()
-    if wandb is not None:
-        wandb.finish()
+        # wandb/log gating: only the controller process reports (reference
+        # train.py:101, utils.py:12-20)
+        wandb = _wandb_init(cfg) if (lg.use_wandb and utils.is_main_process()) else None
+        n_params = llama.num_params(m)
+        peak = utils.peak_flops_per_chip()
+        n_chips = topo.world_size
+        max_steps = max_steps_override or t.total_train_steps
+        utils.log0(f"model {m.name}: {utils.to_readable_format(n_params)} params | "
+              f"mesh dp={topo.dp_size} pp={topo.pp_size} cp={topo.cp_size} "
+              f"tp={topo.tp_size} on {n_chips} x {jax.devices()[0].device_kind} | "
+              f"global batch {cfg.global_batch_size} "
+              f"({utils.to_readable_format(cfg.tokens_per_step)} tokens/step) | "
+              f"setup {time.perf_counter() - t0_setup:.1f}s")
+
+        rollbacks = 0
+        while step < max_steps and (t.max_tokens is None or trained_tokens < t.max_tokens):
+            if guard.triggered:
+                utils.log0(f"preemption: {guard.signame} received; flushing "
+                           f"checkpoint at step {step} and exiting "
+                           f"{resilience.EXIT_PREEMPTED}", flush=True)
+                break
+            if heartbeat:
+                _touch(heartbeat)
+            # Profiler window snaps to dispatch boundaries (a dispatch is spc
+            # steps): stop is checked before start so a window narrower than one
+            # dispatch still traces one full dispatch; the done latch makes the
+            # window fire exactly once.
+            if profiling and lg.profile_stop and step >= lg.profile_stop:
+                jax.profiler.stop_trace()
+                profiling, profile_done = False, True
+            if (lg.profile_start and not profiling and not profile_done
+                    and step >= lg.profile_start):
+                jax.profiler.start_trace(lg.profile_dir)
+                profiling = True
+            t_start = time.perf_counter()
+            step_before = step
+            # spc optimizer steps per device dispatch; a tail shorter than spc
+            # (by step count OR token budget) would trigger a recompile at a new
+            # stack shape — run those steps singly instead.
+            steps_left = max_steps - step
+            if t.max_tokens is not None:
+                tokens_left = t.max_tokens - trained_tokens
+                steps_left = min(steps_left, -(-tokens_left // cfg.tokens_per_step))
+            k = spc if steps_left >= spc else 1
+            poisoned = chaos.poison_step(step + 1)  # config pins spc==1 here
+            if k > 1:
+                tokens, targets = ts.shard_batch_stack(
+                    [next(loader) for _ in range(k)], topo)
+                params, opt_state, loss_arr = step_fn(params, opt_state, tokens, targets)
+                losses = [float(x) for x in jax.block_until_ready(loss_arr)]
+            else:
+                tokens, targets = ts.shard_batch(next(loader), topo)
+                if poisoned:
+                    if step_fn_poison is None:
+                        step_fn_poison = ts.build_train_step(
+                            cfg, topo, poison_nonfinite=True)
+                    fn = step_fn_poison
+                else:
+                    if step_fn_single is None:
+                        step_fn_single = ts.build_train_step(cfg, topo)
+                    fn = step_fn_single
+                params, opt_state, loss_arr = fn(
+                    params, opt_state, tokens, targets)
+                losses = [float(jax.block_until_ready(loss_arr))]
+            dt_call = time.perf_counter() - t_start
+
+            # Throughput is per dispatch (identical for every step in the group);
+            # mfu/memory are computed lazily, once, and only if a step logs.
+            tok_s = k * cfg.tokens_per_step / dt_call
+            tok_s_chip = tok_s / n_chips
+            stats = None
+            do_rollback = False
+            for i, loss in enumerate(losses):
+                step += 1
+                trained_tokens += cfg.tokens_per_step
+                if loss_history is not None:
+                    loss_history.append((step, loss))
+                anom = detector.observe(step, loss)
+                if anom is not None:
+                    utils.log0(
+                        f"loss anomaly at step {step}: loss={loss:.6g} "
+                        f"kind={anom.kind} consecutive={anom.consecutive} "
+                        f"policy={r.anomaly_policy}", flush=True)
+                    if r.anomaly_policy == "abort":
+                        raise AnomalyAbort(
+                            f"anomalous loss {loss} at step {step} "
+                            f"(kind={anom.kind}); anomaly_policy='abort'")
+                    if (r.anomaly_policy == "rollback"
+                            and anom.consecutive >= r.rollback_after):
+                        do_rollback = True
+                if step % lg.log_frequency == 0 and stats is None:
+                    stats = (utils.get_mfu(tok_s_chip, n_params, m.num_hidden_layers,
+                                           m.hidden_size, t.seq_length, peak),
+                             utils.device_memory_gb())
+                mfu, mem = stats if stats is not None else (None, None)
+                if step % lg.log_frequency == 0:
+                    parts = [
+                        f"Step: {step:<5d}",
+                        f"Loss: {loss:6.4f}",
+                        f"Global batch size: {utils.to_readable_format(cfg.tokens_per_step)}",
+                        f"Tokens/s: {utils.to_readable_format(tok_s)}",
+                        f"Tokens/s/chip: {utils.to_readable_format(tok_s_chip)}",
+                        f"Tokens: {utils.to_readable_format(trained_tokens)}",
+                    ]
+                    if mfu is not None:
+                        parts.append(f"MFU: {mfu:.2f}%")
+                    if mem is not None:
+                        parts.append(f"Memory usage: {mem:.2f}GB")
+                    utils.log0(" | ".join(parts), flush=True)
+                if wandb is not None and step % lg.log_frequency == 0:
+                    wandb.log({"loss": loss, "tokens_per_sec": tok_s,
+                               "tokens_per_sec_per_chip": tok_s_chip,
+                               "trained_tokens": trained_tokens,
+                               **({"mfu": mfu} if mfu is not None else {}),
+                               **({"memory_gb": mem} if mem is not None else {})},
+                              step=step)
+
+            # Save at group boundaries only: params here are the end-of-group
+            # state, so the recorded step must be the end-of-group step.
+            if (manager is not None and c.save_frequency > 0
+                    and step // c.save_frequency > step_before // c.save_frequency):
+                manager.save(step, params, opt_state, trained_tokens, layout=layout,
+                             zero1=z1, data_meta=loader.state_meta(step))
+                last_saved_step = step
+
+            chaos.after_step(step, manager=manager)
+
+            if do_rollback:
+                if manager is None or manager.latest_step() is None:
+                    raise AnomalyAbort(
+                        f"rollback requested at step {step} but no "
+                        f"checkpoint exists under {c.save_dir}")
+                rollbacks += 1
+                if rollbacks > r.max_rollbacks:
+                    raise AnomalyAbort(
+                        f"anomaly persisted through {r.max_rollbacks} "
+                        f"rollbacks; aborting at step {step}")
+                params, opt_state, step, trained_tokens = manager.load(
+                    params, opt_state, layout=layout, zero1=z1)
+                loader.seek_steps(step)
+                detector.reset()
+                last_saved_step = step
+                utils.log0(f"anomaly rollback #{rollbacks}: restored step "
+                           f"{step}, replaying", flush=True)
+    finally:
+        if profiling:
+            jax.profiler.stop_trace()
+        guard.uninstall()
+        try:
+            # the emergency/final flush: reached on clean completion,
+            # preemption, AND any crash — a run never loses more than the
+            # current dispatch (unless that dispatch consumed the donated
+            # state, in which case the last periodic checkpoint stands)
+            if (manager is not None and c.save_frequency > 0 and r.save_on_exit
+                    and step > last_saved_step and _savable(params, opt_state)):
+                manager.save(step, params, opt_state, trained_tokens,
+                             layout=layout, zero1=z1,
+                             data_meta=loader.state_meta(step))
+                utils.log0(f"flushed checkpoint at step {step}", flush=True)
+        finally:
+            if manager is not None:
+                try:
+                    manager.close()  # drains any in-flight async save
+                except Exception as e:
+                    utils.log0(f"checkpoint manager close failed: {e!r}")
+            if wandb is not None:
+                wandb.finish()
     return step, trained_tokens, loss
 
 
@@ -251,7 +386,18 @@ def main(argv=None):
     cfg = Config.from_dict(raw)
     _ensure_devices(cfg)
     _maybe_init_distributed()
-    step, tokens, loss = train(cfg, max_steps_override=args.max_steps)
+    from picotron_tpu import resilience
+    from picotron_tpu.resilience.anomaly import AnomalyAbort
+
+    try:
+        step, tokens, loss = train(cfg, max_steps_override=args.max_steps)
+    except AnomalyAbort as e:
+        log0(f"aborted by anomaly policy: {e}")
+        return resilience.EXIT_ANOMALY
+    if resilience.was_preempted():
+        log0(f"preempted; checkpoint flushed at step {step} — exit "
+             f"{resilience.EXIT_PREEMPTED} (re-run the same command to resume)")
+        return resilience.EXIT_PREEMPTED
     log0(f"done: {step} steps, {tokens} tokens, final loss {loss:.4f}")
     return 0
 

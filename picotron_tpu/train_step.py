@@ -101,23 +101,31 @@ def _spec_axes(spec) -> tuple:
     return tuple(axes)
 
 
-def clip_by_global_norm_sharded(grads, pspecs, max_norm):
-    """Mesh-aware global-norm clip. Each leaf's squared sum is psum'd over
-    exactly the axes that shard it (replicated axes excluded so nothing is
-    double-counted), so every device computes the same true global norm —
-    matching optax.clip_by_global_norm numerics on a single device and
-    keeping replicated params in sync on any topology. Works for both the
-    param-shaped grad tree (pspecs = llama.param_pspecs) and the ZeRO-1
-    chunk tree (pspecs = zero1_chunk_specs)."""
+def global_sq_norm_sharded(tree, pspecs):
+    """True global squared norm of a sharded tree: each leaf's squared sum
+    is psum'd over exactly the axes that shard it (replicated axes excluded
+    so nothing is double-counted), so every device computes the same scalar.
+    Works for both the param-shaped grad tree (pspecs = llama.param_pspecs)
+    and the ZeRO-1 chunk tree (pspecs = zero1_chunk_specs). Shared by the
+    global-norm clip and the non-finite gate (any NaN/Inf anywhere in the
+    tree — even on a single shard — poisons the psum'd total on EVERY
+    device, which is what makes the gate's select globally consistent)."""
     spec_leaves = jax.tree.leaves(pspecs, is_leaf=lambda x: isinstance(x, P))
     total = jnp.float32(0.0)
-    for g, spec in zip(jax.tree.leaves(grads), spec_leaves):
+    for g, spec in zip(jax.tree.leaves(tree), spec_leaves):
         sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
         axes = _spec_axes(spec)
         if axes:
             sq = lax.psum(sq, axes)
         total = total + sq
-    gn = jnp.sqrt(total)
+    return total
+
+
+def clip_by_global_norm_sharded(grads, pspecs, max_norm):
+    """Mesh-aware global-norm clip, matching optax.clip_by_global_norm
+    numerics on a single device and keeping replicated params in sync on
+    any topology (see global_sq_norm_sharded)."""
+    gn = jnp.sqrt(global_sq_norm_sharded(grads, pspecs))
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(gn, 1e-16))
     return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
 
@@ -271,12 +279,19 @@ def init_state(cfg: Config, topo: Topology, seed: int | None = None):
     return params, opt_state
 
 
-def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
+def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1,
+                     poison_nonfinite: bool = False):
     """Returns jitted (params, opt_state, tokens, targets) ->
     (params, opt_state, loss). tokens/targets are [M, mbs*dp, seq] int32,
     sharded (None, 'dp', 'cp'). With multi_step=K the returned function runs
     K optimizer steps per call over stacked [K, M, mbs*dp, seq] batches
-    (shard with shard_batch_stack) and returns per-step losses [K]."""
+    (shard with shard_batch_stack) and returns per-step losses [K].
+
+    ``poison_nonfinite=True`` builds the chaos-injection variant: the
+    engine's loss and gradients are NaN-poisoned after the backward, exactly
+    simulating a numerically blown step (resilience/chaos.py). Used by the
+    fault-injection suite to drive the non-finite gate below; never enabled
+    in production programs."""
     mesh = topo.mesh
     pp = cfg.distributed.pp_size
     engine = cfg.distributed.pp_engine
@@ -303,7 +318,10 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
     sp_div = (cfg.distributed.tp_size
               if llama.use_sp(cfg) else 1)
 
+    guard = cfg.resilience.nonfinite_guard
+
     def _step(params, opt_state, tokens, targets):
+        params_in, opt_in = params, opt_state
         stage_fn = lambda p, h, tok, tgt: llama.stage_apply(p, h, tok, tgt, cos, sin, cfg)
         h_shape = (tokens.shape[1], tokens.shape[2] // sp_div,
                    cfg.model.hidden_size)
@@ -333,6 +351,27 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
             loss, grads = pipeline_afab(stage_fn, params, tokens, targets, pp,
                                         h_shape, dt, acc_dtype=acc_dt)
 
+        if poison_nonfinite:
+            # chaos build: poison loss AND grads after the engine — the
+            # observable signature of a real numeric blow-up (NaN forward
+            # implies NaN backward), injected engine-agnostically
+            loss = loss + jnp.asarray(jnp.nan, loss.dtype)
+            grads = jax.tree.map(
+                lambda g: g + jnp.asarray(jnp.nan, g.dtype), grads)
+
+        # Logging mean over the data axes (utils.py:93-98), hoisted before
+        # the update so the non-finite gate below can key off the GLOBAL
+        # loss (pmean of anything non-finite is non-finite on every device —
+        # a shard-local isfinite would desync replicated params). Any pp/tp
+        # axis the loss is still TYPED varying over joins the mean as a
+        # value-identity replication certificate (the loss is replicated
+        # over them by pipeline-psum / CE semantics; a single pmean cannot
+        # mix varying and invariant axes, hence the vma-driven set). With
+        # the checker off the vma is empty and this is the plain dp x cp
+        # mean.
+        extra = tuple(a for a in ("pp", "tp") if a in typeof_vma(loss))
+        loss = lax.pmean(loss, ("dp", "cp") + extra)
+
         # grad sync: mean over the fused dp×cp group (data_parallel.py:47,83),
         # psum over pp for stage-replicated params, cast fp32 -> param dtype
         # (data_parallel.py:161-165). With ZeRO-1 the dp share of the mean
@@ -350,6 +389,8 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
             if sp_div > 1:
                 grads = sync_sp_norm_grads(grads)
             g_chunks = jax.tree.map(partial(_zero1_scatter, dp=dp), grads)
+            grads_ok = (jnp.isfinite(global_sq_norm_sharded(g_chunks, cspecs))
+                        if guard else None)
             if cfg.training.grad_clip > 0:
                 # clip BEFORE the param-dtype downcast: the reference clips
                 # fp32 main_grads (data_parallel.py:161-165 casts after sync)
@@ -389,6 +430,8 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
             grads = sync_pp_replicated_grads(grads, pspecs)
             if sp_div > 1:
                 grads = sync_sp_norm_grads(grads)
+            grads_ok = (jnp.isfinite(global_sq_norm_sharded(grads, pspecs))
+                        if guard else None)
             if cfg.training.grad_clip > 0:
                 # clip the fp32 grads, then downcast — matches the reference's
                 # fp32-master-grad clipping order; the pspec-aware clip psums
@@ -401,15 +444,22 @@ def build_train_step(cfg: Config, topo: Topology, multi_step: int = 1):
 
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-        # logging mean over the data axes (utils.py:93-98). Any pp/tp axis
-        # the loss is still TYPED varying over joins the mean as a
-        # value-identity replication certificate (the loss is replicated
-        # over them by pipeline-psum / CE semantics; a single pmean cannot
-        # mix varying and invariant axes, hence the vma-driven set). With
-        # the checker off the vma is empty and this is the plain dp x cp
-        # mean.
-        extra = tuple(a for a in ("pp", "tp") if a in typeof_vma(loss))
-        loss = lax.pmean(loss, ("dp", "cp") + extra)
+        if guard:
+            # Non-finite gate (resilience): a step with a NaN/Inf loss OR
+            # non-finite gradients applies NO param or optimizer update —
+            # zeroing grads would not suffice (AdamW still decays weights
+            # and moments on zero grads), so the whole new state is
+            # where-selected against the old. The grad check matters on its
+            # own: a backward-only overflow (finite loss, Inf grad) would
+            # otherwise poison params while the loss gate waves it through.
+            # On finite steps jnp.where(True, new, old) IS new: numerically
+            # identity, bit-for-bit. Both preds are globally reduced (pmean'd
+            # loss; per-leaf-psum'd grad norm), identical on every device,
+            # so replicated params stay in sync.
+            ok = jnp.isfinite(loss) & grads_ok
+            keep = lambda new, old: jnp.where(ok, new, old)
+            params = jax.tree.map(keep, params, params_in)
+            opt_state = jax.tree.map(keep, opt_state, opt_in)
         return params, opt_state, loss
 
     # The varying-axes checker (distributed.check_vma) is off by default:

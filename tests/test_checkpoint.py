@@ -96,6 +96,42 @@ def test_resume_under_different_topology(tiny_model_kwargs, tmp_path):
     mgr.close()
 
 
+def test_corrupt_latest_falls_back_to_previous_step(tiny_model_kwargs, tmp_path):
+    """Truncate the latest orbax step's largest file: ``load()`` must warn
+    and restore the previous step; with every step corrupt it must raise a
+    clean FileNotFoundError, not an orbax stack trace mid-restore."""
+    from picotron_tpu.resilience.chaos import truncate_latest_checkpoint
+
+    cfg = make_config(tiny_model_kwargs, dp=2, tp=2, acc=1)
+    topo = topology_from_config(cfg)
+    params, opt_state = ts.init_state(cfg, topo)
+    loader = MicroBatchDataLoader(cfg)
+
+    d = str(tmp_path / "ckpt")
+    mgr = ckpt.CheckpointManager(d, io_attempts=1)
+    mgr.save(1, params, opt_state, trained_tokens=10)
+    params, opt_state, _ = _train(cfg, topo, params, opt_state, loader, 1)
+    mgr.save(2, params, opt_state, trained_tokens=20)
+    mgr.wait_until_finished()
+
+    truncate_latest_checkpoint(d)  # step 2 is now partially written
+    with pytest.warns(RuntimeWarning, match="corrupt or partially written"):
+        p2, o2, step_no, tokens = mgr.load(params, opt_state)
+    assert (step_no, tokens) == (1, 10)
+    assert mgr.last_restored_step == 1
+    assert np.isfinite(float(_train(cfg, topo, p2, o2, loader, 1)[2]))
+
+    # corrupt the survivor too: a clean, typed failure
+    import shutil
+
+    shutil.rmtree(str(tmp_path / "ckpt" / "2"))
+    truncate_latest_checkpoint(d)  # now step 1
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(FileNotFoundError, match="no readable checkpoint"):
+            mgr.load(params, opt_state)
+    mgr.close()
+
+
 def test_hf_safetensors_roundtrip(tiny_model_kwargs, tmp_path):
     """Export to HF naming, re-import, require exact tree equality and an
     identical forward — validates both directions of the name map

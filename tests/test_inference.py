@@ -183,6 +183,35 @@ def test_batcher_recycles_slots_mixed_lengths(tiny_model_kwargs):
         assert solo["solo"].tokens == batched[r.uid].tokens, r.uid
 
 
+def test_batcher_request_timeout_frees_slot(tiny_model_kwargs):
+    """A request past its wall-clock deadline finishes with reason "timeout"
+    and releases its slot, so a queued request behind it still completes —
+    driven by an injected clock (1s per scheduler tick) for determinism."""
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            self.t += 1.0
+            return self.t
+
+    cfg, engine = _engine(tiny_model_kwargs, slots=1)
+    params = _params(cfg, engine)
+    b = ContinuousBatcher(engine, params, clock=Clock())
+    res = b.run([
+        Request("hog", [1, 2, 3], max_new_tokens=64, timeout_s=3.0),
+        Request("queued", [4, 5, 6], max_new_tokens=4),
+    ])
+    assert res["hog"].finish_reason == "timeout"
+    assert 0 < len(res["hog"].tokens) < 64  # partial output is returned
+    assert res["queued"].finish_reason == "length"
+    assert len(res["queued"].tokens) == 4
+    # no deadline => never times out, identical to the pre-deadline behavior
+    free = ContinuousBatcher(engine, params, clock=Clock()).run(
+        [Request("a", [1, 2, 3], max_new_tokens=8)])["a"]
+    assert free.finish_reason == "length" and len(free.tokens) == 8
+
+
 def test_batcher_eos_terminates_early(tiny_model_kwargs):
     cfg, engine = _engine(tiny_model_kwargs)
     params = _params(cfg, engine)

@@ -1,0 +1,360 @@
+"""Fault-injection suite (picotron_tpu/resilience/, docs/RESILIENCE.md).
+
+Every recovery path gets a deterministic chaos trigger and a bit-for-bit
+oracle: the uninterrupted run's per-step loss trajectory. Kill→resume,
+crash→finally-save→resume, NaN-step no-update, corrupt-latest fallback,
+anomaly rollback, and the bounded-restart supervisor are all proven on the
+dp=2,tp=2 CPU mesh — robustness regressions fail here instead of surfacing
+as lost production runs. ``make chaos-smoke`` runs exactly this file.
+"""
+
+import os
+import signal
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+
+from picotron_tpu import resilience
+from picotron_tpu import train_step as ts
+from picotron_tpu.data import MicroBatchDataLoader
+from picotron_tpu.resilience.anomaly import AnomalyAbort, LossAnomalyDetector
+from picotron_tpu.resilience.chaos import ChaosError
+from picotron_tpu.resilience.preemption import PreemptionGuard
+from picotron_tpu.resilience.retry import retry
+from picotron_tpu.tools.supervise import run_supervised
+from picotron_tpu.topology import topology_from_config
+from picotron_tpu.train import train
+
+from conftest import make_config
+
+# the shared training shape: the acceptance mesh (dp=2, tp=2), 6 steps
+_TINY = dict(
+    num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+    hidden_size=64, intermediate_size=128, vocab_size=256,
+    max_position_embeddings=128, rope_theta=10000.0, dtype="float32",
+    attention_impl="sdpa")
+_COMMON = dict(dp=2, tp=2, mbs=2, seq=32, total_train_steps=6)
+
+
+def _cfg(save_dir, **res):
+    cfg = make_config(_TINY, **_COMMON)
+    cfg.checkpoint.save_dir = str(save_dir)
+    cfg.checkpoint.save_frequency = 2
+    for k, v in res.items():
+        setattr(cfg.resilience, k, v)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    """Per-step (step, loss) trajectory of the uninterrupted 6-step run —
+    the oracle every recovery path must reproduce exactly."""
+    hist = []
+    steps, _, _ = train(_cfg(tmp_path_factory.mktemp("base") / "ckpt"),
+                        loss_history=hist)
+    assert steps == 6 and all(np.isfinite(l) for _, l in hist)
+    return hist
+
+
+# --------------------------------------------------------------------------- #
+# host-side units: retry, anomaly detector, preemption guard
+# --------------------------------------------------------------------------- #
+
+
+def test_retry_succeeds_after_transient_failures():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry(flaky, attempts=3, backoff=0.5, jitter=0.0,
+                 sleep=sleeps.append) == "ok"
+    assert sleeps == [0.5, 1.0]  # exponential backoff, no jitter
+
+
+def test_retry_exhausts_and_reraises_original():
+    sleeps = []
+    with pytest.raises(OSError, match="permanent"):
+        retry(lambda: (_ for _ in ()).throw(OSError("permanent")),
+              attempts=3, backoff=0.1, jitter=0.0, sleep=sleeps.append)
+    assert len(sleeps) == 2  # no sleep after the final attempt
+
+
+def test_anomaly_detector_flags_nonfinite_and_spikes():
+    det = LossAnomalyDetector(ema_beta=0.9, zscore=3.0, warmup_steps=5,
+                              min_deviation=0.05)
+    # warmup: a flat-ish loss stream arms the detector without tripping
+    for s in range(1, 8):
+        assert det.observe(s, 5.0 + 0.01 * (s % 2)) is None
+    a = det.observe(8, float("nan"))
+    assert a is not None and a.kind == "nonfinite" and a.consecutive == 1
+    a = det.observe(9, 50.0)  # a huge finite spike, consecutive with the NaN
+    assert a is not None and a.kind == "spike" and a.consecutive == 2
+    # healthy step resets the streak; the spike was NOT absorbed into the EMA
+    assert det.observe(10, 5.0) is None
+    assert det.consecutive == 0
+    det.reset()
+    assert det.observe(11, 500.0) is None  # post-reset: re-warming, not judged
+
+
+def test_preemption_guard_flags_sigterm_and_restores_handlers():
+    prev = signal.getsignal(signal.SIGTERM)
+    guard = PreemptionGuard().install()
+    try:
+        assert not guard.triggered
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.triggered and guard.signame == "SIGTERM"
+        assert resilience.was_preempted()
+    finally:
+        guard.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+# --------------------------------------------------------------------------- #
+# the jit-side non-finite gate
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("zero1", [False, True])
+def test_nonfinite_step_applies_no_update(zero1):
+    """A NaN-poisoned dispatch must leave params AND optimizer state bitwise
+    unchanged (zeroed grads would not do it: AdamW still decays weights and
+    moments) — on the plain path and the ZeRO-1 chunked-update path."""
+    cfg = make_config(_TINY, dp=2, tp=2 if not zero1 else 1, mbs=2, seq=32,
+                      zero1=zero1)
+    topo = topology_from_config(cfg)
+    params, opt = ts.init_state(cfg, topo)
+    loader = MicroBatchDataLoader(cfg)
+    tok, tgt = ts.shard_batch(next(loader), topo)
+
+    before = [np.asarray(jax.device_get(x)).copy()
+              for x in jax.tree.leaves((params, opt))]
+    poisoned = ts.build_train_step(cfg, topo, poison_nonfinite=True)
+    params, opt, loss = poisoned(params, opt, tok, tgt)
+    assert not np.isfinite(float(loss))
+    after = [np.asarray(jax.device_get(x))
+             for x in jax.tree.leaves((params, opt))]
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+
+    # training continues: the next (clean) step updates params and is finite
+    step = ts.build_train_step(cfg, topo)
+    tok, tgt = ts.shard_batch(next(loader), topo)
+    params2, _, loss2 = step(params, opt, tok, tgt)
+    assert np.isfinite(float(loss2))
+    assert any(
+        not np.array_equal(a, np.asarray(jax.device_get(b)))
+        for a, b in zip(before[:len(jax.tree.leaves(params2))],
+                        jax.tree.leaves(params2)))
+
+
+# --------------------------------------------------------------------------- #
+# kill -> resume equivalence (the tentpole acceptance)
+# --------------------------------------------------------------------------- #
+
+
+def test_sigterm_kill_then_resume_matches_baseline(baseline, tmp_path):
+    """Chaos SIGTERM at step 3: the run flushes an emergency checkpoint and
+    stops; re-running the SAME command auto-resumes and the combined
+    per-step loss trajectory equals the uninterrupted run bit-for-bit."""
+    d = tmp_path / "ckpt"
+    hist_a = []
+    steps_a, _, _ = train(_cfg(d, chaos_sigterm_step=3), loss_history=hist_a)
+    assert steps_a == 3
+    assert resilience.was_preempted()
+
+    hist_b = []
+    steps_b, tokens_b, _ = train(_cfg(d), loss_history=hist_b)
+    assert steps_b == 6
+    assert not resilience.was_preempted()
+    assert hist_a + hist_b == baseline  # bit-for-bit, floats compared exactly
+
+
+def test_crash_still_flushes_checkpoint_and_resumes(baseline, tmp_path):
+    """Chaos raise at step 3 (an unhandled crash between checkpoints): the
+    try/finally must still flush a step-3 save, and auto-resume completes
+    the run on the baseline trajectory."""
+    d = tmp_path / "ckpt"
+    hist_a = []
+    with pytest.raises(ChaosError, match="injected crash after step 3"):
+        train(_cfg(d, chaos_raise_step=3), loss_history=hist_a)
+    assert hist_a == baseline[:3]
+
+    hist_b = []
+    steps_b, _, _ = train(_cfg(d), loss_history=hist_b)
+    assert steps_b == 6
+    assert hist_a + hist_b == baseline
+
+
+def test_auto_resume_off_restarts_from_scratch(baseline, tmp_path):
+    """resilience.auto_resume=False restores start-from-scratch semantics
+    even with checkpoints present."""
+    d = tmp_path / "ckpt"
+    train(_cfg(d, chaos_sigterm_step=3))
+    hist = []
+    train(_cfg(d, auto_resume=False), loss_history=hist)
+    assert hist[0] == baseline[0]  # step 1 again, not step 4
+
+
+# --------------------------------------------------------------------------- #
+# anomaly policies
+# --------------------------------------------------------------------------- #
+
+
+def test_nan_skip_policy_logs_and_continues(tmp_path, capsys):
+    """Policy 'skip' (default): the NaN step applies no update, is logged
+    with step + policy, and training runs to completion."""
+    hist = []
+    steps, _, loss = train(_cfg(tmp_path / "ckpt", chaos_nan_step=2),
+                           loss_history=hist)
+    assert steps == 6
+    assert not np.isfinite(hist[1][1])  # step 2 observed the injected NaN
+    assert all(np.isfinite(l) for s, l in hist if s != 2)
+    assert np.isfinite(loss)
+    out = capsys.readouterr().out
+    assert "loss anomaly at step 2" in out and "policy=skip" in out
+
+
+def test_rollback_policy_restores_and_replays(baseline, tmp_path):
+    """Policy 'rollback': after the NaN at step 5, restore the step-4
+    checkpoint, reposition the loader, and replay — the replayed steps 5-6
+    match the uninterrupted trajectory bit-for-bit."""
+    hist = []
+    steps, _, _ = train(
+        _cfg(tmp_path / "ckpt", chaos_nan_step=5, anomaly_policy="rollback",
+             rollback_after=1), loss_history=hist)
+    assert steps == 6
+    finite = [h for h in hist if np.isfinite(h[1])]
+    assert finite == baseline  # 1-4, then replayed 5-6
+
+
+def test_abort_policy_raises_and_flushes(tmp_path):
+    import picotron_tpu.checkpoint as ckpt
+
+    d = tmp_path / "ckpt"
+    with pytest.raises(AnomalyAbort, match="anomaly_policy='abort'"):
+        train(_cfg(d, chaos_nan_step=3, anomaly_policy="abort"))
+    # the finally flushed the pre-abort state (step 3: gate kept step-2 params)
+    mgr = ckpt.CheckpointManager(str(d))
+    assert mgr.latest_step() == 3
+    mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# corrupt-latest fallback + data-geometry guard
+# --------------------------------------------------------------------------- #
+
+
+def test_truncated_latest_checkpoint_falls_back(baseline, tmp_path):
+    """Chaos-truncate the newest step's largest file after its save: resume
+    warns, falls back to the previous step, and completes on the baseline
+    trajectory."""
+    d = tmp_path / "ckpt"
+    cfg = _cfg(d, chaos_truncate_step=4)
+    cfg.training.total_train_steps = 4
+    train(cfg)
+
+    hist = []
+    cfg2 = _cfg(d, io_attempts=1)  # deterministic corruption: don't re-poll
+    with pytest.warns(RuntimeWarning, match="corrupt or partially written"):
+        steps, _, _ = train(cfg2, loss_history=hist)
+    assert steps == 6
+    assert hist == baseline[2:]  # resumed from step 2, replayed 3-6
+
+
+def test_changed_batch_geometry_fails_loudly(tmp_path):
+    """Resume under a different micro-batch size: the recorded loader
+    position no longer matches, and the run must refuse instead of silently
+    training on different data."""
+    d = tmp_path / "ckpt"
+    train(_cfg(d, chaos_sigterm_step=3))
+    cfg2 = make_config(_TINY, **{**_COMMON, "mbs": 1})
+    cfg2.checkpoint.save_dir = str(d)
+    cfg2.checkpoint.save_frequency = 2
+    with pytest.raises(ValueError, match="batch geometry changed"):
+        train(cfg2)
+
+
+# --------------------------------------------------------------------------- #
+# supervisor
+# --------------------------------------------------------------------------- #
+
+_CRASHY = textwrap.dedent("""
+    import os, sys
+    p = sys.argv[1]
+    n = int(open(p).read()) if os.path.exists(p) else 0
+    open(p, "w").write(str(n + 1))
+    sys.exit(7 if n < 2 else 0)
+""")
+
+
+def test_supervisor_restarts_until_success(tmp_path):
+    script = tmp_path / "crashy.py"
+    script.write_text(_CRASHY)
+    counter = tmp_path / "count"
+    rc = run_supervised([sys.executable, str(script), str(counter)],
+                        max_restarts=3, backoff=0.01)
+    assert rc == 0
+    assert counter.read_text() == "3"  # two crashes + the clean third run
+
+
+def test_supervisor_bounds_restarts_and_propagates_exit_code(tmp_path):
+    script = tmp_path / "crashy.py"
+    script.write_text(_CRASHY)
+    counter = tmp_path / "count"
+    rc = run_supervised([sys.executable, str(script), str(counter)],
+                        max_restarts=1, backoff=0.01)
+    assert rc == 7  # the child's final exit code, not a lying zero
+    assert counter.read_text() == "2"  # initial launch + exactly one restart
+
+
+def test_supervisor_kills_stalled_trainer(tmp_path):
+    script = tmp_path / "hang.py"
+    script.write_text("import time\ntime.sleep(60)\n")
+    hb = tmp_path / "hb"
+    rc = run_supervised([sys.executable, str(script)], max_restarts=0,
+                        heartbeat=str(hb), stall_timeout=0.5, term_grace=2.0,
+                        poll_interval=0.05)
+    assert rc == 143  # 128 + SIGTERM: the stall kill is visible to schedulers
+
+
+# --------------------------------------------------------------------------- #
+# config surface
+# --------------------------------------------------------------------------- #
+
+
+def make_config_resilience(**res):
+    cfg = make_config(_TINY)
+    for k, v in res.items():
+        setattr(cfg.resilience, k, v)
+    cfg.validate()
+    return cfg
+
+
+def test_resilience_config_validation_fields():
+    with pytest.raises(ValueError, match="anomaly_policy"):
+        make_config_resilience(anomaly_policy="explode")
+    with pytest.raises(ValueError, match="save_frequency"):
+        make_config_resilience(anomaly_policy="rollback")
+    with pytest.raises(ValueError, match="io_attempts"):
+        make_config_resilience(io_attempts=0)
+    with pytest.raises(ValueError, match="steps_per_call"):
+        cfg = make_config(_TINY, steps_per_call=2)
+        cfg.resilience.chaos_nan_step = 3
+        cfg.validate()
+    # round trip: the resilience section survives to_dict/from_dict
+    from picotron_tpu.config import Config
+
+    cfg = make_config(_TINY)
+    cfg.resilience.chaos_sigterm_step = 9
+    cfg2 = Config.from_dict(cfg.to_dict())
+    assert cfg2.resilience.chaos_sigterm_step == 9
+    assert cfg2.resilience.anomaly_policy == "skip"
