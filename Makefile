@@ -32,9 +32,14 @@ bench: native
 
 # Serving-path smoke: tiny-model CPU generate through the full
 # prefill/KV-cache/batcher/CLI stack (picotron_tpu/inference) — seconds,
-# no checkpoint or network needed.
+# no checkpoint or network needed. Runs the blocked decode fast path
+# (on-device stop state, one host sync per block) and the int8 KV cache,
+# then the blocked-decode bench so dispatches-per-token shows up in logs.
 decode-smoke:
 	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
+	  --kv-cache-dtype int8 --decode-block-len 4
+	JAX_PLATFORMS=cpu python bench_decode.py --block-len 8
 
 # Fault-injection suite on a CPU mesh (picotron_tpu/resilience/): chaos
 # SIGTERM/crash/NaN/truncation at fixed steps, kill->resume bit-for-bit

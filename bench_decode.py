@@ -1,21 +1,33 @@
 """Decode throughput benchmark: continuous-batched KV-cache generation.
 
 The serving-side complement of bench.py's training MFU: with every engine
-slot busy, how many tokens/sec does the jitted decode step sustain?
+slot busy, how many tokens/sec does the decode hot path sustain?
 Protocol: prefill fills all slots with fixed-length random prompts, a
-warmup call absorbs compilation, then ``steps`` decode rounds are timed
-end-to-end (including the host round-trip that feeds each sampled token
-back — that latency is part of serving).
+warmup call absorbs compilation, then the timed window runs end-to-end
+(including the host round-trip that feeds sampled tokens back — that
+latency is part of serving).
+
+Two modes, selected by ``--block-len``:
+
+- ``--block-len 1`` (default): the classic per-token loop — one
+  ``decode_step`` dispatch, one host sync, per generated token
+  (dispatches/token = 1.0);
+- ``--block-len N``: the blocked fast path — ``decode_block`` runs N
+  autoregressive steps inside one jitted program with on-device stop
+  state, so the host syncs once per N tokens (dispatches/token = 1/N).
+  The tokens/s delta between the two modes IS the host-dispatch overhead
+  the block amortizes.
 
 Prints ONE JSON line starting ``{"metric"`` (the bench_record contract, so
 the tunnel watcher / orchestrator can find and classify it in step logs):
-tokens/s/chip on SmolLM-1.7B on TPU, a tiny-model smoke metric on CPU.
-``vs_baseline`` is null — the reference repo has no serving path to
-compare against.
+tokens/s/chip on SmolLM-1.7B on TPU, a tiny-model smoke metric on CPU,
+with ``dispatches_per_token`` riding along so the host-sync win is visible
+in the bench trajectory.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
@@ -24,14 +36,17 @@ from picotron_tpu.bench_record import BENCH_METRICS
 
 
 def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
-        steps: int, warmup: int = 8):
+        steps: int, warmup: int = 8, block_len: int = 1):
+    """Time ``steps`` decode rounds (tokens per slot). Returns
+    (tokens/s, dispatches_per_token, engine)."""
     import jax
     import numpy as np
 
     from picotron_tpu.inference import InferenceEngine
     from picotron_tpu.models import llama
 
-    engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len)
+    engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
+                             decode_block_len=block_len)
     params = engine.shard_params(jax.jit(
         lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
     cache = engine.init_cache()
@@ -47,25 +62,62 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     top_p = np.ones(slots, np.float32)
     key = jax.random.PRNGKey(0)
 
-    assert prompt_len + warmup + steps <= max_seq_len, "cache would overflow"
-    for _ in range(warmup):
-        key, sub = jax.random.split(key)
-        cache, toks, _ = engine.decode_step(params, cache, toks, sub,
-                                            temp, top_k, top_p)
-    jax.block_until_ready(toks)
+    assert steps % block_len == 0, "steps must divide into whole blocks"
+    assert prompt_len + warmup * block_len + steps <= max_seq_len, \
+        "cache would overflow"
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        key, sub = jax.random.split(key)
-        cache, toks, _ = engine.decode_step(params, cache, toks, sub,
-                                            temp, top_k, top_p)
-        toks = np.asarray(toks)  # the host feedback every real server pays
-    dt = time.perf_counter() - t0
-    assert np.all((toks >= 0) & (toks < cfg.model.vocab_size))
-    return slots * steps / dt, engine
+    if block_len == 1:
+        for _ in range(warmup):
+            key, sub = jax.random.split(key)
+            cache, toks, _ = engine.decode_step(params, cache, toks, sub,
+                                                temp, top_k, top_p)
+        jax.block_until_ready(toks)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            key, sub = jax.random.split(key)
+            cache, toks, _ = engine.decode_step(params, cache, toks, sub,
+                                                temp, top_k, top_p)
+            toks = np.asarray(toks)  # the host feedback every real server pays
+        dt = time.perf_counter() - t0
+        dispatches = steps
+        last = toks
+    else:
+        eos = np.full(slots, -1, np.int32)  # bench streams never stop early
+
+        def block(cache, toks, key):
+            subs = []
+            for _ in range(block_len):
+                key, sub = jax.random.split(key)
+                subs.append(np.asarray(sub))
+            budget = np.full(slots, block_len, np.int32)
+            cache, out, counts = engine.decode_block(
+                params, cache, toks, np.stack(subs), eos, budget,
+                temp, top_k, top_p)
+            out = np.asarray(out)  # one host sync per block, not per token
+            assert np.all(np.asarray(counts) == block_len)
+            return cache, out[:, -1], key
+
+        for _ in range(warmup):
+            cache, toks, key = block(cache, toks, key)
+        t0 = time.perf_counter()
+        for _ in range(steps // block_len):
+            cache, toks, key = block(cache, toks, key)
+        dt = time.perf_counter() - t0
+        dispatches = steps // block_len
+        last = toks
+
+    assert np.all((last >= 0) & (last < cfg.model.vocab_size))
+    return slots * steps / dt, dispatches / steps, engine
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="decode throughput bench")
+    ap.add_argument("--block-len", type=int, default=1,
+                    help="decode steps fused per dispatch (1 = per-token "
+                         "loop; N = blocked fast path, 1/N dispatches per "
+                         "token)")
+    args = ap.parse_args(argv)
+
     from picotron_tpu.utils import honor_cpu_env_pin
 
     honor_cpu_env_pin()
@@ -91,7 +143,7 @@ def main() -> None:
         "dataset": {"name": "synthetic"},
     })
     try:
-        tok_s, engine = run(cfg, **sizes)
+        tok_s, dpt, engine = run(cfg, block_len=args.block_len, **sizes)
     except Exception as e:  # noqa: BLE001 - the record IS the error channel
         print(json.dumps({
             "metric": BENCH_METRICS["bench_decode"], "value": None,
@@ -102,10 +154,12 @@ def main() -> None:
     metric = (BENCH_METRICS["bench_decode"] if tpu
               else "decode_tokens_per_sec_cpu_smoke")
     print(f"# slots={sizes['slots']} prompt={sizes['prompt_len']} "
-          f"steps={sizes['steps']} chips={chips} "
-          f"tokens/s={tok_s:.1f}", file=sys.stderr)
+          f"steps={sizes['steps']} chips={chips} block_len={args.block_len} "
+          f"dispatches/token={dpt:.3f} tokens/s={tok_s:.1f}", file=sys.stderr)
     print(json.dumps({"metric": metric, "value": round(tok_s / chips, 1),
-                      "unit": "tokens/s/chip", "vs_baseline": None}))
+                      "unit": "tokens/s/chip", "vs_baseline": None,
+                      "block_len": args.block_len,
+                      "dispatches_per_token": round(dpt, 4)}))
 
 
 if __name__ == "__main__":

@@ -257,6 +257,31 @@ class ResilienceConfig:
 
 
 @dataclass
+class InferenceConfig:
+    """Serving knobs (picotron_tpu/inference/, docs/INFERENCE.md). These
+    only affect the InferenceEngine / ContinuousBatcher path; training
+    ignores them."""
+
+    # Autoregressive steps fused into one jitted decode dispatch
+    # (engine.decode_block): per-slot EOS/budget stop state lives on device,
+    # so the host syncs once per block instead of once per token. 1 = the
+    # classic per-token loop (one dispatch per token). Also bounds admission
+    # latency: the batcher admits/retires only at block boundaries.
+    decode_block_len: int = 8
+    # KV cache storage dtype: "auto" = the model's param dtype; "int8" =
+    # per-row per-kv-head absmax-quantized storage with fp32 scales
+    # (kv_cache.quantize_kv) — ~2x the slots or context at the same HBM
+    # budget, dequantized inside decode attention.
+    kv_cache_dtype: str = "auto"
+    # Prompts longer than this prefill as a sequence of fixed-width chunk
+    # dispatches writing K/V straight into the target slot
+    # (engine.prefill_chunked): O(1) compiled shapes in prompt length and
+    # flat peak activation memory. Prompts at or under it keep the
+    # pow-2-bucketed one-shot prefill.
+    prefill_chunk: int = 512
+
+
+@dataclass
 class LoggingConfig:
     use_wandb: bool = False
     run_name: str = "picotron-tpu"
@@ -288,6 +313,7 @@ class Config:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    inference: InferenceConfig = field(default_factory=InferenceConfig)
 
     @property
     def world_size(self) -> int:
@@ -504,6 +530,15 @@ class Config:
             raise ValueError("rollback_after must be >= 1")
         if r.max_rollbacks < 0:
             raise ValueError("max_rollbacks must be >= 0")
+        inf = self.inference
+        if inf.decode_block_len < 1:
+            raise ValueError("inference.decode_block_len must be >= 1")
+        if inf.prefill_chunk < 1:
+            raise ValueError("inference.prefill_chunk must be >= 1")
+        if inf.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"unknown inference.kv_cache_dtype {inf.kv_cache_dtype!r} "
+                "(auto|int8)")
         chaos_on = False
         for name in ("chaos_raise_step", "chaos_nan_step",
                      "chaos_sigterm_step", "chaos_truncate_step"):
@@ -543,6 +578,7 @@ class Config:
             checkpoint=build(CheckpointConfig, raw.get("checkpoint", {})),
             logging=build(LoggingConfig, raw.get("logging", {})),
             resilience=build(ResilienceConfig, raw.get("resilience", {})),
+            inference=build(InferenceConfig, raw.get("inference", {})),
         )
         cfg.validate()
         return cfg

@@ -4,14 +4,17 @@ The serving counterpart of the training stack — turns trained checkpoints
 into a batched generation engine:
 
 - ``kv_cache``: preallocated slot-based K/V cache (compact GQA heads, head
-  axis tp-sharded) + the masked dot-product decode kernel;
+  axis tp-sharded; optional int8 storage with per-row absmax scales) + the
+  masked dot-product decode kernel;
 - ``sampling``: greedy / temperature / top-k / top-p as pure jittable
   functions with per-request parameter arrays;
-- ``engine``: jitted ``prefill`` / ``decode_step`` pair under shard_map on
-  a tp mesh, reusing the training ``decoder_layer`` (flash-capable prefill)
-  with the incremental-decode hooks;
+- ``engine``: jitted ``prefill`` / ``prefill_chunked`` / ``decode_step`` /
+  ``decode_block`` programs under shard_map on a tp mesh, reusing the
+  training ``decoder_layer`` (flash-capable prefill) with the
+  incremental-decode hooks; ``decode_block`` fuses ``decode_block_len``
+  steps with on-device EOS/budget stop state — one host sync per block;
 - ``batcher``: continuous batching — admit/retire variable-length requests
-  into the engine's fixed slots.
+  into the engine's fixed slots, consuming whole decode blocks.
 
 Design notes and CLI usage: docs/INFERENCE.md.
 """

@@ -7,20 +7,37 @@ sequence hits EOS must be recycled to a waiting request immediately, not
 when the whole batch drains (static batching's tail loss). The batcher is
 the host-side loop that does exactly that:
 
+  expire: slots past their wall-clock deadline retire FIRST, so a slot
+          freed by a timeout is refilled in the same round, not the next;
   admit:  while a slot is free and requests wait, prefill the next prompt
-          (padded to its power-of-two bucket), insert its K/V into the
-          slot, and sample its first token from the prefill logits;
-  decode: ONE ``decode_step`` advances every occupied slot together —
-          per-slot sampling params ride along as arrays, so mixed
-          greedy/temperature/top-k/top-p traffic shares the program;
-  retire: slots that hit EOS, their token budget, or their wall-clock
-          deadline release (a 1-element length write — stale K/V rows
-          become unreachable) and free capacity for the next admit.
+          (pow-2-bucketed one-shot at or under ``engine.prefill_chunk``,
+          chunked straight into the slot above it), and sample its first
+          token from the prefill logits;
+  decode: ONE ``decode_block`` advances every occupied slot by up to
+          ``engine.decode_block_len`` tokens — per-slot sampling params,
+          EOS ids, and token budgets ride along as arrays, and the
+          EOS/budget stop state lives ON DEVICE, so the host syncs once
+          per block instead of once per token (``decode_block_len == 1``
+          is the classic per-token loop);
+  retire: slots that hit EOS or their token budget — decided on device,
+          confirmed host-side from the block's produced counts — release
+          (a 1-element length write; stale K/V rows become unreachable)
+          and free capacity for the next admit. Post-EOS pad tokens in a
+          block row are trimmed via the produced counts.
 
 Free slots still flow through the decode program (fixed shapes are the
-deal with XLA); they carry token 0 at length 0 and their outputs are
+deal with XLA); they carry a zero budget at length 0 and their outputs are
 ignored. The whole loop is deterministic given the seed: one PRNG key
-chain, split once per admit and once per decode round.
+chain, split once per admit and once per in-block step (so the chain —
+and with it every sampled stream — is identical across block lengths as
+long as requests finish at block boundaries, and identical to the
+per-token loop at ``decode_block_len == 1``).
+
+``decode_dispatches`` / ``prefill_dispatches`` / ``generated_tokens``
+count engine calls and output tokens across the batcher's lifetime —
+``decode_dispatches / generated_tokens`` is the dispatches-per-token
+metric bench_decode.py tracks (1 for the per-token loop, ~1/block_len
+when every slot stays busy).
 """
 
 from __future__ import annotations
@@ -79,7 +96,7 @@ class ContinuousBatcher:
     ``params`` must already be placed on the engine mesh
     (``engine.shard_params``). One batcher owns one cache; interleaving two
     batchers on one engine is fine (separate caches), sharing a cache is
-    not (decode_step consumes it).
+    not (the decode programs consume it).
     """
 
     def __init__(self, engine, params, seed: int = 0, clock=time.monotonic):
@@ -96,6 +113,12 @@ class ContinuousBatcher:
         self._temp = np.zeros(n, np.float32)
         self._top_k = np.zeros(n, np.int32)
         self._top_p = np.ones(n, np.float32)
+        self._eos = np.full(n, -1, np.int32)
+        self._budget = np.zeros(n, np.int32)
+        # lifetime dispatch/throughput counters (bench + tests)
+        self.decode_dispatches = 0
+        self.prefill_dispatches = 0
+        self.generated_tokens = 0
 
     # ---- queue surface ----------------------------------------------------
 
@@ -142,11 +165,24 @@ class ContinuousBatcher:
         self._temp[i] = 0.0
         self._top_k[i] = 0
         self._top_p[i] = 1.0
+        self._eos[i] = -1
+        self._budget[i] = 0
+
+    def _remaining(self, i: int) -> int:
+        """Tokens slot i may still produce: its max_new_tokens budget capped
+        by the sequence window — the host truth the device's on-block
+        budget state mirrors."""
+        s = self._slots[i]
+        r = s.req
+        cap = min(r.max_new_tokens,
+                  self.engine.max_seq_len - len(r.prompt))
+        return max(cap - len(s.generated), 0)
 
     def _token_done(self, i: int, tok: int) -> None:
         """Record one generated token for slot i; retire on EOS/budget."""
         s = self._slots[i]
         s.generated.append(tok)
+        self.generated_tokens += 1
         r = s.req
         if r.eos_id is not None and tok == r.eos_id:
             self._finish(i, "eos")
@@ -163,15 +199,25 @@ class ContinuousBatcher:
             if self._slots[i] is not None:
                 continue
             req = self._pending.popleft()
-            kv, logits = self.engine.prefill(self.params, req.prompt)
-            self._cache = self.engine.insert(
-                self._cache, kv, i, len(req.prompt))
+            if len(req.prompt) > self.engine.prefill_chunk:
+                # long prompt: fixed-width chunks straight into the slot —
+                # O(1) compiled shapes in prompt length
+                n_chunks = -(-len(req.prompt) // self.engine.prefill_chunk)
+                self._cache, logits = self.engine.prefill_chunked(
+                    self.params, self._cache, req.prompt, i)
+                self.prefill_dispatches += n_chunks
+            else:
+                kv, logits = self.engine.prefill(self.params, req.prompt)
+                self._cache = self.engine.insert(
+                    self._cache, kv, i, len(req.prompt))
+                self.prefill_dispatches += 1
             deadline = (self._clock() + req.timeout_s
                         if req.timeout_s is not None else None)
             self._slots[i] = _Slot(req, deadline=deadline)
             self._temp[i] = req.temperature
             self._top_k[i] = req.top_k
             self._top_p[i] = req.top_p
+            self._eos[i] = req.eos_id if req.eos_id is not None else -1
             first = int(sampling.sample(
                 logits, self._split(),
                 np.float32([req.temperature]),
@@ -182,24 +228,39 @@ class ContinuousBatcher:
     def _expire_deadlines(self) -> None:
         """Retire every slot past its deadline with reason "timeout" — the
         slot frees immediately, so a stuck or over-budget request cannot
-        starve the queue behind it. Runs once per scheduler round, before
-        the decode dispatch (an expired request gets no further tokens)."""
+        starve the queue behind it. Runs FIRST in each scheduler round
+        (before admission), so a slot freed by a timeout is refilled in the
+        same round instead of idling one full block."""
         now = self._clock()
         for i, s in enumerate(self._slots):
             if s is not None and s.deadline is not None and now >= s.deadline:
                 self._finish(i, "timeout")
 
     def step(self) -> None:
-        """Admit waiting requests into free slots, then advance every
-        occupied slot one token."""
-        self._admit()
+        """Expire overdue slots, admit waiting requests into free slots,
+        then advance every occupied slot by one decode block (up to
+        ``engine.decode_block_len`` tokens per slot, one dispatch)."""
         self._expire_deadlines()
+        self._admit()
         if not any(s is not None for s in self._slots):
             return
-        self._cache, toks, _ = self.engine.decode_step(
-            self.params, self._cache, self._last_tok, self._split(),
-            self._temp, self._top_k, self._top_p)
-        toks = np.asarray(toks)
         for i, s in enumerate(self._slots):
-            if s is not None:
-                self._token_done(i, int(toks[i]))
+            self._budget[i] = self._remaining(i) if s is not None else 0
+        block = self.engine.decode_block_len
+        keys = np.stack([np.asarray(self._split()) for _ in range(block)])
+        self._cache, toks, counts = self.engine.decode_block(
+            self.params, self._cache, self._last_tok, keys,
+            self._eos, self._budget, self._temp, self._top_k, self._top_p)
+        self.decode_dispatches += 1
+        toks = np.asarray(toks)
+        counts = np.asarray(counts)
+        for i in range(len(self._slots)):
+            if self._slots[i] is None:
+                continue
+            # the device already stopped this row at EOS/budget; walking the
+            # produced prefix through _token_done applies the same rules
+            # host-side (appending the tokens and retiring the slot)
+            for t in toks[i, : counts[i]]:
+                if self._slots[i] is None:  # device/host rule mismatch guard
+                    break
+                self._token_done(i, int(t))

@@ -1,33 +1,44 @@
-"""The batched generation engine: jitted prefill / decode_step on the mesh.
+"""The batched generation engine: jitted prefill / decode programs on the mesh.
 
-Serving counterpart of ``train_step.py``. Two compiled programs cover a
-request's whole life:
+Serving counterpart of ``train_step.py``. Three compiled program families
+cover a request's whole life:
 
 - ``prefill(params, prompt)``: the full-sequence model (the SAME
   ``decoder_layer`` path training runs, flash-capable on TPU) over a
   right-padded prompt bucket, returning the per-layer compact K/V blocks
-  plus the last real token's full-vocab logits. Prompts are padded to
-  power-of-two buckets so arbitrary lengths reuse a handful of compiled
-  shapes; pad rows are inert (causal mask ahead, length mask behind).
-- ``decode_step(params, cache, tokens, key, temperature, top_k, top_p)``:
-  one token for EVERY slot at once — embed, scan the stacked layers with
-  per-slot cache writes and masked dot-product attention
-  (kv_cache.decode_attention), head, and per-slot sampling — returning the
-  updated cache and the sampled tokens. Slots sit at independent positions
-  (``cache['lengths']``); RoPE is applied at each slot's own offset
-  (ops/rope.rope_at_positions).
+  (quantized for int8 caches) plus the last real token's full-vocab logits.
+  Prompts are padded to power-of-two buckets so arbitrary lengths reuse a
+  handful of compiled shapes; pad rows are inert (causal mask ahead, length
+  mask behind). Prompts longer than ``prefill_chunk`` instead run
+  ``prefill_chunked``: fixed-width chunk dispatches that attend causally
+  over the already-written cache prefix plus the current chunk and write
+  K/V straight into the target slot — O(1) compiled shapes in prompt
+  length, flat peak activation memory.
+- ``decode_block(params, cache, tokens, keys, eos_id, budget, ...)``:
+  ``decode_block_len`` autoregressive steps for EVERY slot inside ONE
+  jitted program (``lax.scan`` over steps). Per-slot stop state lives on
+  device — ``eos_id`` [B] (−1 = none), remaining-token ``budget`` [B], and
+  the active mask derived from ``cache['lengths']`` — so a slot that hits
+  EOS or exhausts its budget mid-block goes inactive, emits pad tokens,
+  and stops advancing its cache length: the block result is exactly what
+  that many single steps would have produced. One host sync per block
+  instead of per token. ``decode_block_len == 1`` is the classic per-token
+  loop.
+- ``decode_step(...)``: the single-token program (kept for callers that
+  want per-token logits; the batcher drives ``decode_block``).
 
 Sharding: the engine builds (or is handed) a ``('dp','pp','cp','tp')`` mesh
-with dp=pp=cp=1 and runs both programs under shard_map with the model's
+with dp=pp=cp=1 and runs the programs under shard_map with the model's
 training PartitionSpecs — a TP-sharded checkpoint loads and decodes without
-resharding; the cache's head axis shards over 'tp' alongside the wk/wv
-columns that fill it. Pipeline- or interleave-trained checkpoints are
-handled at LOAD time (checkpoint.CheckpointManager.load / load_params remap
-stacked layer rows to the contiguous pp=1 layout), so the engine always
-sees a plain [L] stack.
+resharding; the cache's head axis (and the int8 scale tensors' head axis)
+shards over 'tp' alongside the wk/wv columns that fill it. Pipeline- or
+interleave-trained checkpoints are handled at LOAD time
+(checkpoint.CheckpointManager.load / load_params remap stacked layer rows
+to the contiguous pp=1 layout), so the engine always sees a plain [L] stack.
 
-The cache is donated through decode_step and insert, so steady-state decode
-updates the K/V buffers in place — no per-token reallocation.
+The cache is donated through every decode/insert/chunk program, so
+steady-state generation updates the K/V buffers in place — no per-token
+reallocation.
 """
 
 from __future__ import annotations
@@ -70,13 +81,18 @@ class InferenceEngine:
     retires requests into these fixed positions so the compiled decode
     program never changes shape. ``max_seq_len`` bounds prompt + generated
     tokens per slot (default: the model's max_position_embeddings).
+    ``decode_block_len`` / ``kv_cache_dtype`` / ``prefill_chunk`` default
+    from ``cfg.inference`` (config.InferenceConfig); keyword overrides win.
     """
 
     def __init__(self, cfg: Config, topo: Optional[Topology] = None, *,
                  slots: int = 8, max_seq_len: Optional[int] = None,
-                 cache_dtype=None, min_prefill_bucket: int = 16):
+                 cache_dtype=None, min_prefill_bucket: int = 16,
+                 decode_block_len: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
+        inf = self.cfg.inference
         if topo is None:
             topo = build_topology(1, 1, 1, d.tp_size)
         if (topo.dp_size, topo.pp_size, topo.cp_size) != (1, 1, 1):
@@ -91,7 +107,29 @@ class InferenceEngine:
         self.slots = int(slots)
         self.max_seq_len = int(max_seq_len or m.max_position_embeddings)
         self.min_prefill_bucket = int(min_prefill_bucket)
-        self.cache_dtype = jnp.dtype(cache_dtype or m.dtype)
+        self.decode_block_len = int(decode_block_len
+                                    if decode_block_len is not None
+                                    else inf.decode_block_len)
+        if self.decode_block_len < 1:
+            raise ValueError("decode_block_len must be >= 1")
+        self.prefill_chunk = int(prefill_chunk if prefill_chunk is not None
+                                 else inf.prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        # a chunk wider than the cache window could never be written
+        # (mirrors prefill_bucket's min(bucket, max_seq_len) cap)
+        self.prefill_chunk = min(self.prefill_chunk, self.max_seq_len)
+        # int8 is accepted through either the config knob or cache_dtype
+        # (string "int8", jnp.int8, or np.dtype — normalized, so the dtype
+        # spelling can't silently build an unquantized int8 cache); an
+        # EXPLICIT cache_dtype wins over the config, so a caller can turn
+        # quantization off as well as on
+        if cache_dtype is not None:
+            self.quantized = jnp.dtype(cache_dtype) == jnp.dtype(jnp.int8)
+        else:
+            self.quantized = inf.kv_cache_dtype == "int8"
+        self.cache_dtype = (jnp.dtype(jnp.int8) if self.quantized
+                            else jnp.dtype(cache_dtype or m.dtype))
         self._dt = jnp.dtype(m.dtype)
 
         # angle tables cover the whole cache window; decode gathers rows at
@@ -100,17 +138,28 @@ class InferenceEngine:
             self.max_seq_len, m.head_dim, m.rope_theta, self._dt)
 
         self._pspecs = llama.param_pspecs(m)
-        self._cspecs = kv_cache.cache_pspecs()
-        kv_spec = {"k": self._cspecs["k"], "v": self._cspecs["v"]}
+        self._cspecs = kv_cache.cache_pspecs(self.quantized)
+        kv_spec = {n: s for n, s in self._cspecs.items() if n != "lengths"}
         mesh = topo.mesh
 
         self._prefill_jit = jax.jit(shard_map(
             self._prefill_impl, mesh,
             in_specs=(self._pspecs, P(), P()),
             out_specs=(kv_spec, P())))
+        self._prefill_chunk_jit = jax.jit(shard_map(
+            self._prefill_chunk_impl, mesh,
+            in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P()),
+            out_specs=(self._cspecs, P())),
+            donate_argnums=(1,))
         self._decode_jit = jax.jit(shard_map(
             self._decode_impl, mesh,
             in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P(), P()),
+            out_specs=(self._cspecs, P(), P())),
+            donate_argnums=(1,))
+        self._decode_block_jit = jax.jit(shard_map(
+            self._decode_block_impl, mesh,
+            in_specs=(self._pspecs, self._cspecs,
+                      P(), P(), P(), P(), P(), P(), P()),
             out_specs=(self._cspecs, P(), P())),
             donate_argnums=(1,))
         self._insert_jit = jax.jit(kv_cache.insert_prefill,
@@ -118,10 +167,20 @@ class InferenceEngine:
         self._release_jit = jax.jit(kv_cache.release, donate_argnums=(0,))
         self._init_cache_jit = jax.jit(
             partial(kv_cache.init_cache, m, self.slots, self.max_seq_len,
-                    dtype=self.cache_dtype),
+                    dtype=self.cache_dtype, quantized=self.quantized),
             out_shardings=named_shardings(topo, self._cspecs))
 
     # ---- model programs (run inside shard_map; tp axis collectives live) --
+
+    def _pack_kv(self, K, V):
+        """Prefill K/V blocks in cache storage form: quantize (int8 mode)
+        or cast to the cache dtype."""
+        if self.quantized:
+            qk, ks = kv_cache.quantize_kv(K)
+            qv, vs = kv_cache.quantize_kv(V)
+            return {"k": qk, "v": qv, "k_scale": ks, "v_scale": vs}
+        return {"k": K.astype(self.cache_dtype),
+                "v": V.astype(self.cache_dtype)}
 
     def _prefill_impl(self, params, tokens, length):
         """tokens [1, S_bucket] int32, length [1] -> (kv blocks, last-token
@@ -144,35 +203,127 @@ class InferenceEngine:
         # bucket pays one [1, H] @ [H, V] row instead of S_bucket of them
         h_last = jnp.take_along_axis(h, (length - 1)[:, None, None], axis=1)
         last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
-        return {"k": K.astype(self.cache_dtype),
-                "v": V.astype(self.cache_dtype)}, last.astype(jnp.float32)
+        return self._pack_kv(K, V), last.astype(jnp.float32)
 
-    def _decode_impl(self, params, cache, tokens, key, temperature,
-                     top_k, top_p):
-        """One autoregressive step for all slots: tokens [B] (each slot's
-        current last token), cache lengths give every slot its position."""
+    def _split_cache(self, cache):
+        """(per-layer K/V leaves to scan, lengths) — the scan consumes every
+        [L, ...] cache leaf the way it consumes the stacked params."""
+        return ({n: a for n, a in cache.items() if n != "lengths"},
+                cache["lengths"])
+
+    def _decode_core(self, params, cache, tokens):
+        """One model step for all slots: embed ``tokens`` [B], scan the
+        layer stack with per-slot cache writes at ``cache['lengths']``,
+        return (updated per-layer leaves, logits [B, V] fp32). Lengths are
+        NOT advanced here — single-step and blocked callers apply their own
+        activity rule."""
         cfg = self.cfg
         pos = cache["lengths"]  # [B] write index of the incoming token
         cos_b, sin_b = rope_at_positions(self._cos, self._sin, pos)
         h = llama.embed_lookup(params["embed"],
                                tokens[:, None]).astype(self._dt)
+        leaves, _ = self._split_cache(cache)
 
         def body(hc, xs):
-            lp, kc, vc = xs
-            hc, (kc, vc) = llama.decoder_layer(
-                lp, hc, cos_b, sin_b, cfg, cache=(kc, vc), pos=pos)
-            return hc, (kc, vc)
+            lp, lc = xs
+            hc, lc = llama.decoder_layer(lp, hc, cos_b, sin_b, cfg,
+                                         cache=lc, pos=pos)
+            return hc, lc
 
-        h, (K, V) = lax.scan(body, h, (params["layers"], cache["k"],
-                                       cache["v"]))
+        h, new_leaves = lax.scan(body, h, (params["layers"], leaves))
         logits = tp_gather(llama.head_logits(params, h, cfg))[:, 0]
-        logits = logits.astype(jnp.float32)
+        return new_leaves, logits.astype(jnp.float32)
+
+    def _decode_impl(self, params, cache, tokens, key, temperature,
+                     top_k, top_p):
+        """One autoregressive step for all slots: tokens [B] (each slot's
+        current last token), cache lengths give every slot its position."""
+        pos = cache["lengths"]
+        new_leaves, logits = self._decode_core(params, cache, tokens)
         next_tok = sampling.sample(logits, key, temperature, top_k, top_p)
         # free slots (length 0) ride along for shape stability but stay at
         # length 0 — their row-0 writes are never visible
-        new_cache = {"k": K, "v": V,
+        new_cache = {**new_leaves,
                      "lengths": jnp.where(pos > 0, pos + 1, 0)}
         return new_cache, next_tok, logits
+
+    def _decode_block_impl(self, params, cache, tokens, keys, eos_id,
+                           budget, temperature, top_k, top_p):
+        """``decode_block_len`` autoregressive steps in one program.
+
+        tokens [B] (each slot's current last token), keys [block_len, 2]
+        (one PRNG key per in-block step — the host's per-round split chain,
+        so block_len == 1 reproduces the per-token loop bit-for-bit),
+        eos_id [B] int32 (−1 = none), budget [B] int32 remaining tokens.
+        A slot is active while it has a parked sequence AND budget; hitting
+        EOS zeroes its budget. Inactive slots emit pad token 0, stop
+        advancing their cache length, and their (recomputed) row writes
+        land beyond the length mask — invisible, exactly like the free
+        slots that already ride through the single-step program.
+
+        Returns (cache, tokens [B, block_len], counts [B]): ``counts[b]``
+        leading entries of row b are the tokens slot b actually produced.
+        """
+
+        def step(carry, key_t):
+            cache, tok, budget = carry
+            pos = cache["lengths"]
+            active = (pos > 0) & (budget > 0)
+            new_leaves, logits = self._decode_core(params, cache, tok)
+            sampled = sampling.sample(logits, key_t, temperature,
+                                      top_k, top_p)
+            emit = jnp.where(active, sampled, 0)
+            new_budget = jnp.where(active, budget - 1, budget)
+            hit_eos = active & (eos_id >= 0) & (sampled == eos_id)
+            new_budget = jnp.where(hit_eos, 0, new_budget)
+            new_cache = {**new_leaves,
+                         "lengths": jnp.where(active, pos + 1, pos)}
+            next_tok = jnp.where(active, sampled, tok)
+            return (new_cache, next_tok, new_budget), (emit, active)
+
+        (cache, _, _), (toks, actives) = lax.scan(
+            step, (cache, tokens, budget), keys)
+        return (cache, jnp.swapaxes(toks, 0, 1),
+                jnp.sum(actives.astype(jnp.int32), axis=0))
+
+    def _prefill_chunk_impl(self, params, cache, tokens, slot, start, valid):
+        """One fixed-width prefill chunk for one slot: tokens [1, C] (pad
+        past ``valid``), written into the cache at rows
+        [start, start + C) of ``slot``. Queries attend causally over the
+        already-written prefix plus the chunk (decode_attention with
+        S = C); pad queries' outputs and their K/V rows beyond
+        ``start + valid`` sit past the final length — unreachable. Returns
+        (cache with lengths[slot] = start + valid, the last valid token's
+        logits [1, V] fp32 — consumed by the caller on the final chunk)."""
+        cfg = self.cfg
+        C = tokens.shape[1]
+        start = jnp.asarray(start, jnp.int32)
+        pos_rows = (start + jnp.arange(C, dtype=jnp.int32))[None, :]  # [1,C]
+        cos_b, sin_b = rope_at_positions(self._cos, self._sin, pos_rows)
+        h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
+        leaves, lengths = self._split_cache(cache)
+        pos = jnp.full((1,), start, jnp.int32)
+
+        def body(hc, xs):
+            lp, lc = xs
+            # this slot's [1, T, ...] block rows, updated then scattered back
+            slot_c = {n: lax.dynamic_slice_in_dim(a, slot, 1, axis=0)
+                      for n, a in lc.items()}
+            hc, slot_new = llama.decoder_layer(lp, hc, cos_b, sin_b, cfg,
+                                               cache=slot_c, pos=pos)
+            lc = {n: lax.dynamic_update_slice_in_dim(lc[n], slot_new[n],
+                                                     slot, axis=0)
+                  for n in lc}
+            return hc, lc
+
+        h, new_leaves = lax.scan(body, h, (params["layers"], leaves))
+        idx = jnp.clip(valid - 1, 0, C - 1)
+        h_last = jnp.take_along_axis(
+            h, jnp.full((1, 1, 1), idx, jnp.int32), axis=1)
+        last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
+        new_cache = {**new_leaves,
+                     "lengths": lengths.at[slot].set(start + valid)}
+        return new_cache, last.astype(jnp.float32)
 
     # ---- host-facing API ---------------------------------------------------
 
@@ -211,6 +362,40 @@ class InferenceEngine:
         return self._prefill_jit(params, jnp.asarray(padded),
                                  jnp.asarray([ids.size], jnp.int32))
 
+    def prefill_chunked(self, params, cache, prompt_ids, slot: int) -> tuple:
+        """Prefill one prompt as ``ceil(len / prefill_chunk)`` fixed-width
+        chunk dispatches writing K/V straight into ``slot`` (consumes
+        ``cache``). Returns (cache, last_logits [1, V] fp32). One compiled
+        shape regardless of prompt length; the ragged final chunk pads to
+        the chunk width with rows past the final length unreachable."""
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if ids.size > self.max_seq_len:
+            raise ValueError(
+                f"prompt of {ids.size} tokens exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        C = self.prefill_chunk
+        logits = None
+        for s0 in range(0, ids.size, C):
+            end = min(s0 + C, ids.size)
+            # the write window is the chunk's full [start, start + C) rows;
+            # past max_seq_len, dynamic_update_slice would CLAMP the start
+            # and silently shift the chunk onto earlier rows — instead slide
+            # the window back and re-feed the overlap tokens, whose rows
+            # recompute to the values already parked there (same prefix,
+            # same positions, same program)
+            start = min(s0, self.max_seq_len - C)
+            chunk = ids[start:end]
+            padded = np.zeros((1, C), np.int32)
+            padded[0, : chunk.size] = chunk
+            cache, logits = self._prefill_chunk_jit(
+                params, cache, jnp.asarray(padded),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(chunk.size, jnp.int32))
+        return cache, logits
+
     def insert(self, cache, kv, slot: int, length: int) -> dict:
         """Park a prefill's blocks into ``slot`` (consumes ``cache``)."""
         return self._insert_jit(cache, kv, slot, length)
@@ -227,6 +412,28 @@ class InferenceEngine:
         return self._decode_jit(
             params, cache,
             jnp.asarray(np.asarray(tokens, np.int32)), key,
+            jnp.asarray(np.asarray(temperature, np.float32)),
+            jnp.asarray(np.asarray(top_k, np.int32)),
+            jnp.asarray(np.asarray(top_p, np.float32)))
+
+    def decode_block(self, params, cache, tokens, keys, eos_id, budget,
+                     temperature, top_k, top_p) -> tuple:
+        """``decode_block_len`` tokens for every slot in one dispatch.
+        ``keys`` is [decode_block_len, 2] (one PRNG key per in-block step);
+        ``eos_id`` [slots] int32 (−1 = none), ``budget`` [slots] int32
+        remaining tokens (0 for free slots). Returns (cache,
+        tokens [slots, decode_block_len], produced counts [slots]).
+        Consumes ``cache``."""
+        keys = jnp.asarray(keys)
+        if keys.shape[0] != self.decode_block_len:
+            raise ValueError(
+                f"keys has {keys.shape[0]} rows; decode_block_len is "
+                f"{self.decode_block_len} (one key per in-block step)")
+        return self._decode_block_jit(
+            params, cache,
+            jnp.asarray(np.asarray(tokens, np.int32)), keys,
+            jnp.asarray(np.asarray(eos_id, np.int32)),
+            jnp.asarray(np.asarray(budget, np.int32)),
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
             jnp.asarray(np.asarray(top_p, np.float32)))

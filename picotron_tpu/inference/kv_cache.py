@@ -18,12 +18,21 @@ row per sequence:
   currently parked). Slot ``b``'s visible keys are ``t < lengths[b]``; a
   freed slot has ``lengths == 0`` and its stale rows are unreachable, which
   is what makes slot recycling (inference/batcher.py) a 1-element write.
+- int8 mode (``inference.kv_cache_dtype: "int8"``): ``k``/``v`` store
+  absmax-quantized int8 rows and the cache gains ``k_scale``/``v_scale``
+  ``[num_layers, slots, max_seq_len, n_kv_heads]`` fp32 tensors — one scale
+  per written row per kv head, so quantization error never crosses a head
+  or a position. Quantization happens on write (``cache_write`` /
+  prefill), dequantization inside ``attend`` right before the fp32-softmax
+  attention. Cache bytes ≈ (1 + 4/head_dim) per element vs 2 for bf16 —
+  ~53% at head_dim 64, i.e. ~2x the slots or context at the same HBM.
 
 Sharding: the head axis shards over 'tp' — the same split as the wk/wv
 columns that produce it — so a TP-sharded checkpoint decodes with zero
-resharding; everything else is replicated (``cache_pspecs``). Dtype follows
-the model's param dtype (bf16 on the production configs; fp32 tiny CPU
-models stay exact against the ``forward_logits`` oracle).
+resharding; the scale tensors shard their (trailing) head axis the same
+way; everything else is replicated (``cache_pspecs``). Unquantized dtype
+follows the model's param dtype (bf16 on the production configs; fp32 tiny
+CPU models stay exact against the ``forward_logits`` oracle).
 """
 
 from __future__ import annotations
@@ -36,28 +45,141 @@ from jax.sharding import PartitionSpec as P
 from picotron_tpu.config import ModelConfig
 from picotron_tpu.ops.attention import NEG_INF
 
+# int8 symmetric range; scales are stored in fp32 so dequantization is one
+# multiply with no double-rounding
+INT8_MAX = 127.0
+SCALE_DTYPE = jnp.float32
 
-def cache_pspecs() -> dict:
+
+def cache_pspecs(quantized: bool = False) -> dict:
     """PartitionSpecs of the cache pytree: K/V head axis over 'tp', the
     rest replicated (slots could shard over 'dp' later; the engine serves
-    a tp-only mesh today)."""
+    a tp-only mesh today). int8 caches add per-row scale tensors whose
+    trailing head axis shards over 'tp' alongside the K/V heads they
+    scale."""
     kv = P(None, None, None, "tp", None)
-    return {"k": kv, "v": kv, "lengths": P()}
+    specs = {"k": kv, "v": kv, "lengths": P()}
+    if quantized:
+        scale = P(None, None, None, "tp")
+        specs["k_scale"] = scale
+        specs["v_scale"] = scale
+    return specs
 
 
 def init_cache(m: ModelConfig, slots: int, max_seq_len: int,
-               dtype=None) -> dict:
+               dtype=None, quantized: bool = False) -> dict:
     """Zeroed global-shape cache for ``slots`` concurrent sequences. Jit
     with out_shardings (engine.init_cache) to materialize each device's
     shard directly."""
-    dt = jnp.dtype(dtype if dtype is not None else m.dtype)
     shape = (m.num_hidden_layers, slots, max_seq_len,
              m.num_key_value_heads, m.head_dim)
-    return {
-        "k": jnp.zeros(shape, dt),
-        "v": jnp.zeros(shape, dt),
-        "lengths": jnp.zeros((slots,), jnp.int32),
-    }
+    if quantized:
+        cache = {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], SCALE_DTYPE),
+            "v_scale": jnp.zeros(shape[:-1], SCALE_DTYPE),
+        }
+    else:
+        dt = jnp.dtype(dtype if dtype is not None else m.dtype)
+        cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    cache["lengths"] = jnp.zeros((slots,), jnp.int32)
+    return cache
+
+
+def cache_bytes(cache: dict) -> int:
+    """Total bytes the cache pytree occupies (K/V + scales + lengths) —
+    the HBM-budget metric the int8 mode halves."""
+    return sum(a.size * a.dtype.itemsize for a in jax.tree.leaves(cache))
+
+
+# --------------------------------------------------------------------------- #
+# int8 quantization
+# --------------------------------------------------------------------------- #
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple:
+    """Absmax-quantize rows of ``x`` [..., head_dim] to int8: one fp32
+    scale per leading index (= per written row per kv head). A zero row
+    quantizes to zeros with scale 0 — dequantization is exact there."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / INT8_MAX
+    q = jnp.round(xf / jnp.maximum(scale, 1e-12)[..., None])
+    return jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8), scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Inverse of ``quantize_kv``: [..., D] int8 * [...] scale -> dtype."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def quantized(cache: dict) -> bool:
+    """Whether a cache pytree (full or per-layer) stores int8 K/V."""
+    return "k_scale" in cache
+
+
+# --------------------------------------------------------------------------- #
+# per-layer cache ops (run inside the engine's layer scan / shard_map)
+# --------------------------------------------------------------------------- #
+
+
+def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                pos: jnp.ndarray) -> dict:
+    """Write fresh K/V rows into one layer's cache block and return the
+    updated block. Two shapes of write:
+
+    - decode (``S == 1``): ``k_new``/``v_new`` [B, 1, H, D] with ``pos``
+      [B] — every slot writes one row at its own position (a per-row
+      scatter; free slots write their invisible row 0);
+    - chunked prefill (``S > 1``): [1, S, H, D] with ``pos`` [1] — one
+      slot writes a contiguous block of rows starting at ``pos[0]``.
+
+    int8 caches quantize on write; the scale rows land at the same
+    positions in ``k_scale``/``v_scale``.
+    """
+    B, S = k_new.shape[0], k_new.shape[1]
+    out = dict(layer_cache)
+
+    def store(name, sname, new):
+        if quantized(layer_cache):
+            vals, scales = quantize_kv(new)
+        else:
+            vals, scales = new.astype(layer_cache[name].dtype), None
+        if S == 1:
+            rows = jnp.arange(B)
+            out[name] = layer_cache[name].at[rows, pos].set(vals[:, 0])
+            if scales is not None:
+                out[sname] = layer_cache[sname].at[rows, pos].set(
+                    scales[:, 0].astype(SCALE_DTYPE))
+        else:
+            assert B == 1, f"block writes are single-slot (got batch {B})"
+            start = jnp.asarray(pos[0], jnp.int32)
+            out[name] = lax.dynamic_update_slice(
+                layer_cache[name], vals, (0, start, 0, 0))
+            if scales is not None:
+                out[sname] = lax.dynamic_update_slice(
+                    layer_cache[sname], scales.astype(SCALE_DTYPE),
+                    (0, start, 0))
+
+    store("k", "k_scale", k_new)
+    store("v", "v_scale", v_new)
+    return out
+
+
+def attend(q: jnp.ndarray, layer_cache: dict, lengths: jnp.ndarray,
+           scale: float) -> jnp.ndarray:
+    """Masked attention of S fresh queries against one layer's cache block,
+    dequantizing int8 storage on the fly (fp32, matching the fp32 softmax
+    statistics the kernel already computes)."""
+    if quantized(layer_cache):
+        k = dequantize_kv(layer_cache["k"], layer_cache["k_scale"],
+                          jnp.float32)
+        v = dequantize_kv(layer_cache["v"], layer_cache["v_scale"],
+                          jnp.float32)
+    else:
+        k, v = layer_cache["k"], layer_cache["v"]
+    return decode_attention(q, k, v, lengths, scale)
 
 
 def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -71,8 +193,9 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     no repeat, no extra cache bytes. fp32 softmax with the same NEG_INF
     masking convention as ops/attention.py, output cast back to q.dtype.
 
-    S == 1 is the autoregressive decode step; S > 1 generalizes to chunked
-    continuation (each query i masks keys past its own position).
+    S == 1 is the autoregressive decode step; S > 1 is chunked continuation
+    — prefill chunks attending over the already-written prefix plus
+    themselves (each query i masks keys past its own position).
     """
     B, S, nh, D = q.shape
     T, nkv = k.shape[1], k.shape[2]
@@ -91,22 +214,29 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(B, S, nh, D).astype(q.dtype)
 
 
+# --------------------------------------------------------------------------- #
+# whole-cache ops (host-facing, jitted by the engine)
+# --------------------------------------------------------------------------- #
+
+
 def insert_prefill(cache: dict, kv: dict, slot, length) -> dict:
-    """Park a prefill's ``{"k","v"}: [L, 1, S_bucket, H, D]`` blocks into
-    ``slot`` and set its length. Rows past ``length`` (the bucket pad) are
-    written but unreachable under the length mask. ``slot``/``length`` may
-    be traced scalars — one compile per bucket size, not per slot."""
+    """Park a prefill's ``{"k","v"[,"k_scale","v_scale"]}:
+    [L, 1, S_bucket, H, D(, )]`` blocks into ``slot`` and set its length
+    (the engine's prefill already quantized the blocks for int8 caches).
+    Rows past ``length`` (the bucket pad) are written but unreachable under
+    the length mask. ``slot``/``length`` may be traced scalars — one
+    compile per bucket size, not per slot."""
     slot = jnp.asarray(slot, jnp.int32)
 
-    def put(dst, src):
-        return lax.dynamic_update_slice(dst, src, (0, slot, 0, 0, 0))
+    def put(name):
+        dst, src = cache[name], kv[name].astype(cache[name].dtype)
+        return lax.dynamic_update_slice(
+            dst, src, (0, slot) + (0,) * (dst.ndim - 2))
 
-    return {
-        "k": put(cache["k"], kv["k"].astype(cache["k"].dtype)),
-        "v": put(cache["v"], kv["v"].astype(cache["v"].dtype)),
-        "lengths": cache["lengths"].at[slot].set(
-            jnp.asarray(length, jnp.int32)),
-    }
+    out = {name: put(name) for name in cache if name != "lengths"}
+    out["lengths"] = cache["lengths"].at[slot].set(
+        jnp.asarray(length, jnp.int32))
+    return out
 
 
 def release(cache: dict, slot) -> dict:

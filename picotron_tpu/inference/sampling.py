@@ -55,23 +55,33 @@ def apply_top_p(logits: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(keep, logits, NEG_INF)
 
 
-def _filter_top_k_top_p(scaled: jnp.ndarray, top_k: jnp.ndarray,
-                        top_p: jnp.ndarray) -> jnp.ndarray:
+def filter_top_k_top_p(scaled: jnp.ndarray, top_k: jnp.ndarray,
+                       top_p: jnp.ndarray) -> jnp.ndarray:
     """Both filters off ONE descending sort (each standalone filter pays its
     own). Equivalent to ``apply_top_p(apply_top_k(scaled, top_k), top_p)``:
-    the kept set of the sequential application is a prefix of the sort —
-    top-k keeps ranks < k, top-p keeps a prefix of the (k-masked) nucleus —
-    so a single cutoff-by-value reproduces it, ties included."""
+    the kept set of the sequential application is a value-cutoff set of
+    the sort — top-k keeps values at or above the k-th largest (threshold
+    TIES INCLUDED, exactly like ``apply_top_k``: a rank < k mask would
+    drop ties and, worse, shrink the softmax normalization the nucleus is
+    measured against), top-p keeps a prefix of the (k-masked) nucleus —
+    so a single cutoff-by-value reproduces it. ``top_p <= 0`` pins the
+    top-1 column like ``apply_top_p`` does — tests/test_inference.py pins
+    both properties against the sequential application."""
     V = scaled.shape[-1]
     sorted_desc = -jnp.sort(-scaled, axis=-1)
-    rank = jnp.arange(V)[None, :]
-    keep = (top_k[:, None] <= 0) | (rank < top_k[:, None])
+    idx = jnp.clip(top_k - 1, 0, V - 1)
+    thresh = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
+    keep = (top_k[:, None] <= 0) | (sorted_desc >= thresh)
     probs = jax.nn.softmax(jnp.where(keep, sorted_desc, NEG_INF), axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep &= (top_p[:, None] >= 1.0) | ((cum - probs) < top_p[:, None])
     keep = keep.at[:, 0].set(True)  # the top-1 token always survives
     cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1)
     return jnp.where(scaled >= cutoff[:, None], scaled, NEG_INF)
+
+
+# transitional alias (pre-PR-3 private name)
+_filter_top_k_top_p = filter_top_k_top_p
 
 
 def sample(logits: jnp.ndarray, key, temperature: jnp.ndarray,
@@ -86,7 +96,7 @@ def sample(logits: jnp.ndarray, key, temperature: jnp.ndarray,
 
     def stochastic():
         t = jnp.maximum(temperature, 1e-6)[:, None]
-        filtered = _filter_top_k_top_p(
+        filtered = filter_top_k_top_p(
             logits.astype(jnp.float32) / t, top_k, top_p)
         drawn = jax.random.categorical(key, filtered, axis=-1).astype(
             jnp.int32)

@@ -257,18 +257,21 @@ def embed_lookup(w, tokens, sp: bool = False):
 def _attention(q, k, v, cfg: Config, cache=None, pos=None):
     """Full-sequence attention (training / prefill), or — when ``cache`` is
     given — the incremental decode path: ``cache`` is this layer's UPDATED
-    (k, v) block pair [B, max_len, n_kv_local, head_dim] (compact GQA
-    heads, never repeated) and ``pos`` [B] is the index just written per
-    sequence, so key t is visible iff t <= pos; the ``k``/``v`` positional
-    args are ignored. The decode kernel is a masked dot product over the
-    cache (inference/kv_cache.py) — flash brings nothing at query length 1.
+    cache block dict (``{"k","v"[, "k_scale","v_scale"]}``, each
+    [B, max_len, n_kv_local, ...] with compact GQA heads, never repeated)
+    and ``pos`` [B] is the first index just written per sequence; the
+    ``k``/``v`` positional args are ignored. The decode kernel is a masked
+    dot product over the cache (inference/kv_cache.py) — flash brings
+    nothing at query length 1.
     """
     scale = 1.0 / math.sqrt(cfg.model.head_dim)
     if cache is not None:
-        from picotron_tpu.inference.kv_cache import decode_attention
+        from picotron_tpu.inference.kv_cache import attend
 
-        k_cache, v_cache = cache
-        return decode_attention(q, k_cache, v_cache, pos + 1, scale)
+        # S queries starting at per-sequence write index ``pos``: the valid
+        # key count is pos + S (S == 1 decode, S > 1 chunked prefill);
+        # ``attend`` dequantizes int8 cache blocks on the fly
+        return attend(q, cache, pos + q.shape[1], scale)
     impl = cfg.model.attention_impl
     if impl == "auto":
         impl = "flash" if on_tpu() else "sdpa"
@@ -323,15 +326,16 @@ def decoder_layer(lp, h, cos, sin, cfg: Config, cache=None, pos=None,
       but the layer also returns its compact pre-repeat rotated K/V block
       [B, S, n_kv_local, head_dim] for the caller to park in a KV cache —
       return value becomes ``(h, (k, v))``.
-    - ``cache=(k_cache, v_cache)`` + ``pos`` [B] (decode): the new tokens'
-      K/V are written into the cache at each sequence's ``pos`` and
-      attention runs as a masked dot product over the cache
-      (``_attention``'s decode path); ``cos``/``sin`` must then be the
-      per-sequence [B, S, head_dim] tables from ``ops.rope
-      .rope_at_positions``. Return value is ``(h, (k_cache, v_cache))``
-      with the updated blocks. Decode is query-length-1 only and assumes
-      cp == 1 (the serving mesh is tp-only; inference/engine.py enforces
-      it)."""
+    - ``cache={"k","v"[,"k_scale","v_scale"]}`` + ``pos`` [B] (decode /
+      chunked prefill): the new tokens' K/V are written into the per-layer
+      cache block starting at each sequence's ``pos`` (int8 caches
+      quantize on write — kv_cache.cache_write) and attention runs as a
+      masked dot product over the cache (``_attention``'s decode path);
+      ``cos``/``sin`` must then be the per-sequence [B, S, head_dim]
+      tables from ``ops.rope.rope_at_positions``. S == 1 is the per-slot
+      decode step; S > 1 is a single-slot prefill chunk. Return value is
+      ``(h, updated_cache_dict)``. Both assume cp == 1 (the serving mesh
+      is tp-only; inference/engine.py enforces it)."""
     m, tp = cfg.model, cfg.distributed.tp_size
     nh, nkv, D = m.num_attention_heads // tp, m.num_key_value_heads // tp, m.head_dim
     sp = use_sp(cfg)
@@ -365,13 +369,13 @@ def decoder_layer(lp, h, cos, sin, cfg: Config, cache=None, pos=None,
 
     new_cache = None
     if cache is not None:
-        # incremental decode: write this token's K/V at each sequence's
-        # position, attend over the whole cache block
-        assert S == 1, f"decode is single-token (got query length {S})"
-        rows = jnp.arange(B)
-        new_cache = (
-            cache[0].at[rows, pos].set(k[:, 0].astype(cache[0].dtype)),
-            cache[1].at[rows, pos].set(v[:, 0].astype(cache[1].dtype)))
+        # incremental decode (S == 1, one row per slot) or chunked prefill
+        # (S > 1, one slot's contiguous block): write the fresh K/V at each
+        # sequence's position (quantizing for int8 caches), attend over the
+        # whole cache block
+        from picotron_tpu.inference.kv_cache import cache_write
+
+        new_cache = cache_write(cache, k, v, pos)
         o = _attention(q, None, None, cfg, cache=new_cache, pos=pos)
     else:
         kv_compact = (k, v)  # pre-repeat: what a prefill parks in the cache

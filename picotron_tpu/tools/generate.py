@@ -112,6 +112,18 @@ def main(argv=None) -> int:
     ap.add_argument("--slots", type=int, default=4,
                     help="decode batch width (engine slots)")
     ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--decode-block-len", type=int, default=None,
+                    help="decode steps fused per dispatch (default: "
+                         "config inference.decode_block_len; 1 = per-token "
+                         "loop)")
+    ap.add_argument("--kv-cache-dtype", choices=["auto", "int8"],
+                    default=None,
+                    help="KV cache storage (default: config "
+                         "inference.kv_cache_dtype; int8 = quantized "
+                         "cache, ~2x slots/context per HBM byte)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked-prefill width for prompts longer than "
+                         "this (default: config inference.prefill_chunk)")
     ap.add_argument("--smoke", action="store_true",
                     help="built-in tiny CPU model + random init + fixed "
                     "prompts (the `make decode-smoke` target)")
@@ -143,9 +155,13 @@ def main(argv=None) -> int:
 
         tokenizer = AutoTokenizer.from_pretrained(cfg.model.name)
 
+    if args.kv_cache_dtype is not None:
+        cfg.inference.kv_cache_dtype = args.kv_cache_dtype
     t0 = time.perf_counter()
     engine = InferenceEngine(cfg, slots=args.slots,
-                             max_seq_len=args.max_seq_len)
+                             max_seq_len=args.max_seq_len,
+                             decode_block_len=args.decode_block_len,
+                             prefill_chunk=args.prefill_chunk)
     params = _load_weights(args, cfg, engine)
     requests = _build_requests(args, tokenizer)
     setup_s = time.perf_counter() - t0
@@ -168,10 +184,14 @@ def main(argv=None) -> int:
         if tokenizer is not None:
             line += f"\n  text: {tokenizer.decode(r.prompt + r.tokens)!r}"
         print(line)
+    dpt = batcher.decode_dispatches / max(batcher.generated_tokens, 1)
     print(f"{n_tokens} tokens in {gen_s:.2f}s "
           f"({n_tokens / max(gen_s, 1e-9):.1f} tok/s, "
           f"setup {setup_s:.1f}s, slots={engine.slots}, "
-          f"tp={engine.topo.tp_size})")
+          f"tp={engine.topo.tp_size}, block={engine.decode_block_len}, "
+          f"kv={'int8' if engine.quantized else str(engine.cache_dtype)}, "
+          f"{batcher.decode_dispatches} decode dispatches = "
+          f"{dpt:.3f}/token)")
     if failed:
         print("FAILED: some request produced no/invalid tokens",
               file=sys.stderr)

@@ -131,6 +131,54 @@ def test_top_p_filter_keeps_minimal_nucleus():
     np.testing.assert_array_equal(out_off, np.asarray(logits))
 
 
+def test_fused_filter_matches_sequential_application():
+    """filter_top_k_top_p (one sort, what ``sample`` runs) must keep
+    exactly the token set of the sequential apply_top_k -> apply_top_p
+    application — randomized logits WITH exact ties (quantized values make
+    threshold collisions common), across k/p combinations including the
+    disabled sentinels."""
+    rng = np.random.default_rng(7)
+    V = 24
+    # quantize to force exact ties at top-k thresholds and nucleus cutoffs
+    logits = np.round(rng.normal(size=(64, V)) * 4) / 4
+    logits = jnp.asarray(logits.astype(np.float32))
+    for k in (0, 1, 3, V, V + 5):
+        for p in (0.05, 0.3, 0.7, 0.95, 1.0):
+            ks = jnp.full(logits.shape[0], k, jnp.int32)
+            ps = jnp.full(logits.shape[0], p, jnp.float32)
+            fused = np.asarray(sampling.filter_top_k_top_p(logits, ks, ps))
+            seq = np.asarray(
+                sampling.apply_top_p(sampling.apply_top_k(logits, ks), ps))
+            np.testing.assert_array_equal(fused > -1e29, seq > -1e29,
+                                          err_msg=f"k={k} p={p}")
+            # surviving logits pass through unchanged
+            np.testing.assert_array_equal(
+                np.where(fused > -1e29, fused, 0),
+                np.where(seq > -1e29, np.asarray(logits), 0))
+
+
+def test_top_p_zero_pins_top1():
+    """p <= 0 would mask every column (exclusive prefix mass 0 < 0 is
+    False); both filters must pin the top-1 token instead of degenerating
+    into a constant token-0 emitter."""
+    logits = jnp.asarray(
+        np.random.default_rng(3).normal(size=(4, 16)).astype(np.float32))
+    best = np.argmax(np.asarray(logits), axis=-1)
+    for p in (0.0, -1.0):
+        ps = jnp.full(4, p, jnp.float32)
+        for out in (sampling.apply_top_p(logits, ps),
+                    sampling.filter_top_k_top_p(
+                        logits, jnp.zeros(4, jnp.int32), ps)):
+            kept = np.asarray(out) > -1e29
+            np.testing.assert_array_equal(np.sum(kept, axis=-1),
+                                          np.ones(4))
+            assert all(kept[i, best[i]] for i in range(4))
+        # and sampling at any temperature draws exactly the argmax
+        got = sampling.sample(logits, jax.random.PRNGKey(0),
+                              jnp.ones(4), jnp.zeros(4, jnp.int32), ps)
+        np.testing.assert_array_equal(np.asarray(got), best)
+
+
 def test_sample_distribution_matches_softmax():
     """Temperature-1 sampling frequencies converge to softmax; with top_k
     the support restricts to the k best and renormalizes."""

@@ -8,8 +8,13 @@ handful of geometries the equivalence matrices use.
 """
 
 import numpy as np
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+# environment, not code: hypothesis is an optional dev dependency — absent,
+# the whole module skips at collection instead of erroring
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from picotron_tpu.models.llama import pp_layer_layout
 from picotron_tpu.parallel.cp import (

@@ -37,6 +37,13 @@ from picotron_tpu.utils import shard_map as shard_map_compat
 B, S, H, D = 2, 256, 2, 64  # two 128-token chunks
 SCALE = 0.125
 
+# environment, not code: the flash-block tests run the Pallas kernels under
+# the Mosaic TPU interpreter, whose context manager older jax lacks — skip
+# (pass/skip signal), the einsum-path tests below still run
+needs_interpret = pytest.mark.skipif(
+    not hasattr(pltpu, "force_tpu_interpret_mode"),
+    reason=f"jax {jax.__version__} lacks pltpu.force_tpu_interpret_mode")
+
 
 def _qkv(seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
@@ -49,6 +56,7 @@ def _merge(o0, l0, o1, l1):
     return o0 - w * (o0 - o1), jnp.logaddexp(l0, l1)
 
 
+@needs_interpret
 def test_two_chunk_flash_decomposition_matches_full():
     """Chunk-1 queries: merge(full-attend chunk-0 block, causal diagonal
     chunk-1 block) must equal rows [C:] of full causal attention, and the
@@ -125,7 +133,8 @@ def _simulate_rank_fwd(r, q, k, v, use_flash):
     return out, lse
 
 
-@pytest.mark.parametrize("use_flash", [False, True])
+@pytest.mark.parametrize(
+    "use_flash", [False, pytest.param(True, marks=needs_interpret)])
 def test_zigzag_blocks_match_full_attention(use_flash):
     q, k, v = _qkv()
     ref = np.asarray(sdpa(q, k, v, SCALE, causal=True))
@@ -139,6 +148,7 @@ def test_zigzag_blocks_match_full_attention(use_flash):
 
 
 @pytest.mark.slow
+@needs_interpret
 def test_zigzag_flash_bwd_matches_einsum_bwd():
     q, k, v = _qkv()
     with pltpu.force_tpu_interpret_mode():
@@ -171,6 +181,7 @@ def test_zigzag_perm_inverse():
             np.asarray(chunk_positions(r, sl, N, True)))
 
 
+@needs_interpret
 def test_block_fwd_custom_tiles_match_default():
     """flash_block_q/k plumb through the ring's _block_fwd: a custom tiling
     must not change the block math (single device, interpret mode)."""
