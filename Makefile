@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench decode-smoke chaos-smoke clean
+.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke chaos-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -40,6 +40,15 @@ decode-smoke:
 	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
 	  --kv-cache-dtype int8 --decode-block-len 4
 	JAX_PLATFORMS=cpu python bench_decode.py --block-len 8
+
+# Speculative-decoding smoke: draft-verify generation (prompt-lookup
+# drafter, one verify dispatch per accepted run) through the CLI, then
+# the spec bench on repetitive prompts — dispatches-per-token under the
+# spec-off baseline of 1 with a nonzero accept rate in the JSON line.
+spec-smoke:
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
+	  --spec-len 4
+	JAX_PLATFORMS=cpu python bench_decode.py --spec-len 4
 
 # Fault-injection suite on a CPU mesh (picotron_tpu/resilience/): chaos
 # SIGTERM/crash/NaN/truncation at fixed steps, kill->resume bit-for-bit

@@ -7,7 +7,7 @@ warmup call absorbs compilation, then the timed window runs end-to-end
 (including the host round-trip that feeds sampled tokens back — that
 latency is part of serving).
 
-Two modes, selected by ``--block-len``:
+Three modes, selected by ``--block-len`` / ``--spec-len``:
 
 - ``--block-len 1`` (default): the classic per-token loop — one
   ``decode_step`` dispatch, one host sync, per generated token
@@ -17,12 +17,21 @@ Two modes, selected by ``--block-len``:
   state, so the host syncs once per N tokens (dispatches/token = 1/N).
   The tokens/s delta between the two modes IS the host-dispatch overhead
   the block amortizes.
+- ``--spec-len G``: speculative decoding — prompts are REPETITIVE (the
+  regime prompt-lookup drafting serves: boilerplate, code, loops), the
+  n-gram drafter proposes G tokens per slot per round, and one
+  ``engine.verify`` dispatch accepts the matching prefix. Same protocol
+  and normalization as the other modes (prefill outside the timed
+  window, dispatches per PER-SLOT decode token), so zero acceptance
+  reads exactly 1.0 — the per-token baseline — and every accepted draft
+  pushes dispatches/token strictly below it (~1/(1 + r*G) at
+  accept-rate r).
 
 Prints ONE JSON line starting ``{"metric"`` (the bench_record contract, so
 the tunnel watcher / orchestrator can find and classify it in step logs):
 tokens/s/chip on SmolLM-1.7B on TPU, a tiny-model smoke metric on CPU,
-with ``dispatches_per_token`` riding along so the host-sync win is visible
-in the bench trajectory.
+with ``dispatches_per_token`` (and ``accept_rate`` when speculating)
+riding along so the host-sync win is visible in the bench trajectory.
 """
 
 from __future__ import annotations
@@ -33,6 +42,10 @@ import sys
 import time
 
 from picotron_tpu.bench_record import BENCH_METRICS
+
+# verify-dispatch rounds absorbed before the spec mode's timed window —
+# shared by run_spec and main's cache-budget sizing
+SPEC_WARMUP_ROUNDS = 4
 
 
 def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
@@ -110,13 +123,102 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     return slots * steps / dt, dispatches / steps, engine
 
 
+def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
+             steps: int, warmup_rounds: int = SPEC_WARMUP_ROUNDS,
+             spec_len: int = 4):
+    """Time ``steps`` speculative decode tokens per slot: the same
+    protocol as ``run`` — prefill fills every slot OUTSIDE the timed
+    window, warmup rounds absorb compilation, then the timed window runs
+    draft (host-side n-gram lookup) + one ``engine.verify`` dispatch per
+    round until every slot has produced ``steps`` tokens. Prompts are
+    REPETITIVE (one shared pattern — the regime prompt-lookup speculation
+    serves: greedy decode falls into token loops the drafter rides).
+
+    dispatches-per-token is dispatches / per-slot decode tokens, exactly
+    ``run``'s normalization: with nothing accepted every round yields one
+    token per slot and dpt == 1.0 (the spec-off per-token baseline);
+    every accepted draft pushes it strictly below. Returns (tokens/s,
+    dispatches_per_token, accept_rate, engine)."""
+    import jax
+    import numpy as np
+
+    from picotron_tpu.inference import InferenceEngine, NgramDrafter
+    from picotron_tpu.models import llama
+
+    engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
+                             spec_len=spec_len)
+    params = engine.shard_params(jax.jit(
+        lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
+    drafter = NgramDrafter(engine.spec_ngram)
+    rng = np.random.default_rng(0)
+    prompt = np.resize(rng.integers(1, cfg.model.vocab_size, 4), prompt_len)
+    assert (prompt_len + 1 + warmup_rounds * (spec_len + 1) + steps
+            <= max_seq_len), "cache would overflow"
+
+    cache = engine.init_cache()
+    toks = np.zeros(slots, np.int32)
+    hist = []
+    for s in range(slots):
+        kv, logits = engine.prefill(params, prompt)
+        cache = engine.insert(cache, kv, s, prompt_len)
+        toks[s] = np.argmax(np.asarray(logits)[0])  # greedy first token
+        hist.append(list(prompt) + [int(toks[s])])
+
+    eos = np.full(slots, -1, np.int32)  # bench streams never stop early
+    temp = np.zeros(slots, np.float32)
+    top_k = np.zeros(slots, np.int32)
+    top_p = np.ones(slots, np.float32)
+    key = jax.random.PRNGKey(0)
+    produced = np.zeros(slots, np.int64)
+    stats = np.zeros(2, np.int64)  # proposed, accepted
+
+    def spec_round(cache, key, budget):
+        tokens = np.zeros((slots, spec_len + 1), np.int32)
+        active = budget > 0
+        for s in np.flatnonzero(active):
+            tokens[s, 0] = toks[s]
+            tokens[s, 1:] = drafter.propose(hist[s], spec_len)
+        key, sub = jax.random.split(key)
+        cache, emitted, counts, accepted = engine.verify(
+            params, cache, tokens, sub, eos, budget, temp, top_k, top_p)
+        emitted = np.asarray(emitted)  # ONE host sync per dispatch
+        counts = np.asarray(counts)
+        for s in np.flatnonzero(counts):
+            hist[s].extend(int(t) for t in emitted[s, : counts[s]])
+            toks[s] = emitted[s, counts[s] - 1]
+        stats[0] += spec_len * int(active.sum())
+        stats[1] += int(np.asarray(accepted).sum())
+        return cache, key, counts
+
+    for _ in range(warmup_rounds):
+        cache, key, _ = spec_round(
+            cache, key, np.full(slots, spec_len + 1, np.int32))
+    stats[:] = 0
+    dispatches = 0
+    t0 = time.perf_counter()
+    while np.any(produced < steps):
+        cache, key, counts = spec_round(
+            cache, key, (steps - produced).astype(np.int32))
+        produced += counts
+        dispatches += 1
+    dt = time.perf_counter() - t0
+    accept = stats[1] / max(stats[0], 1)
+    return slots * steps / dt, dispatches / steps, accept, engine
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="decode throughput bench")
     ap.add_argument("--block-len", type=int, default=1,
                     help="decode steps fused per dispatch (1 = per-token "
                          "loop; N = blocked fast path, 1/N dispatches per "
                          "token)")
+    ap.add_argument("--spec-len", type=int, default=0,
+                    help="speculative decoding: draft tokens per verify "
+                         "dispatch on repetitive prompts (0 = off; "
+                         "mutually exclusive with --block-len > 1)")
     args = ap.parse_args(argv)
+    if args.spec_len > 0 and args.block_len != 1:
+        ap.error("--spec-len replaces blocked decode; drop --block-len")
 
     from picotron_tpu.utils import honor_cpu_env_pin
 
@@ -136,14 +238,33 @@ def main(argv=None) -> None:
             vocab_size=4096, max_position_embeddings=2048, dtype="float32",
             attention_impl="sdpa")
         sizes = dict(slots=4, max_seq_len=128, prompt_len=16, steps=32)
+    if args.spec_len > 0:
+        # longer streams give greedy generation room to fall into the
+        # repetitive attractors prompt-lookup drafting feeds on — the
+        # regime this mode exists to measure (capped so prefill + warmup
+        # rounds + the timed window fit the cache)
+        sizes["steps"] = min(
+            3 * sizes["steps"],
+            sizes["max_seq_len"] - sizes["prompt_len"] - 1
+            - SPEC_WARMUP_ROUNDS * (args.spec_len + 1))
+        if sizes["steps"] < 1:
+            ap.error(
+                f"--spec-len {args.spec_len} leaves no timed decode window "
+                f"inside max_seq_len {sizes['max_seq_len']} (prompt + "
+                f"warmup rounds consume it); use a smaller draft length")
     cfg = Config.from_dict({
         "distributed": {"tp_size": 1},
         "model": model,
         "training": {"seq_length": sizes["max_seq_len"]},
         "dataset": {"name": "synthetic"},
     })
+    accept = None
     try:
-        tok_s, dpt, engine = run(cfg, block_len=args.block_len, **sizes)
+        if args.spec_len > 0:
+            tok_s, dpt, accept, engine = run_spec(
+                cfg, spec_len=args.spec_len, **sizes)
+        else:
+            tok_s, dpt, engine = run(cfg, block_len=args.block_len, **sizes)
     except Exception as e:  # noqa: BLE001 - the record IS the error channel
         print(json.dumps({
             "metric": BENCH_METRICS["bench_decode"], "value": None,
@@ -155,11 +276,18 @@ def main(argv=None) -> None:
               else "decode_tokens_per_sec_cpu_smoke")
     print(f"# slots={sizes['slots']} prompt={sizes['prompt_len']} "
           f"steps={sizes['steps']} chips={chips} block_len={args.block_len} "
-          f"dispatches/token={dpt:.3f} tokens/s={tok_s:.1f}", file=sys.stderr)
-    print(json.dumps({"metric": metric, "value": round(tok_s / chips, 1),
-                      "unit": "tokens/s/chip", "vs_baseline": None,
-                      "block_len": args.block_len,
-                      "dispatches_per_token": round(dpt, 4)}))
+          f"spec_len={args.spec_len} "
+          + (f"accept_rate={accept:.3f} " if accept is not None else "")
+          + f"dispatches/token={dpt:.3f} tokens/s={tok_s:.1f}",
+          file=sys.stderr)
+    record = {"metric": metric, "value": round(tok_s / chips, 1),
+              "unit": "tokens/s/chip", "vs_baseline": None,
+              "block_len": args.block_len,
+              "dispatches_per_token": round(dpt, 4)}
+    if args.spec_len > 0:
+        record["spec_len"] = args.spec_len
+        record["accept_rate"] = round(accept, 4)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -80,7 +80,10 @@ class DistributedConfig:
     # 1e-4..1e-2 level on zero1/fsdp) and deadlocks inside lax.cond-gated
     # stage branches. Incompatible with pp_engine='afab' (jax's scan
     # transpose does not yet type vma — upstream limitation) and with
-    # cond stage gating (collectives inside single-stage branches).
+    # cond stage gating (collectives inside single-stage branches) — on a
+    # CPU-only box set use_cpu=true so the default stage_gating='auto'
+    # resolves to where-masking and the checker can run (validate()'s
+    # rejection error names the same fix).
     check_vma: bool = False
     # How per-stage embed/loss work is gated to its owning pipeline stage
     # (models/llama.py::_stage_gating): "cond" = lax.cond, the branch only
@@ -279,6 +282,17 @@ class InferenceConfig:
     # flat peak activation memory. Prompts at or under it keep the
     # pow-2-bucketed one-shot prefill.
     prefill_chunk: int = 512
+    # Speculative decoding (inference/speculative.py, engine.verify): number
+    # of tokens the drafter proposes per slot per dispatch. One jitted
+    # verify pass scores all spec_len+1 positions, accepts the matching
+    # draft prefix (exact match for greedy, distribution-preserving
+    # rejection sampling otherwise) and emits 1..spec_len+1 tokens per
+    # dispatch. 0 (default) = off: the batcher drives decode_block instead.
+    spec_len: int = 0
+    # Longest suffix n-gram the built-in prompt-lookup drafter matches
+    # against the slot's own token history (tried spec_ngram down to 1) to
+    # propose continuations. Only consulted when spec_len > 0.
+    spec_ngram: int = 3
 
 
 @dataclass
@@ -539,6 +553,10 @@ class Config:
             raise ValueError(
                 f"unknown inference.kv_cache_dtype {inf.kv_cache_dtype!r} "
                 "(auto|int8)")
+        if inf.spec_len < 0:
+            raise ValueError("inference.spec_len must be >= 0 (0 = off)")
+        if inf.spec_ngram < 1:
+            raise ValueError("inference.spec_ngram must be >= 1")
         chaos_on = False
         for name in ("chaos_raise_step", "chaos_nan_step",
                      "chaos_sigterm_step", "chaos_truncate_step"):

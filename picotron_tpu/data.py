@@ -64,8 +64,10 @@ class _ArrowSamples:
     """Packed rows backed by the datasets arrow cache (disk-mapped): a
     corpus above dataset.max_in_memory_tokens never materializes in host
     RAM — __next__ gathers only the current batch's rows. The reference
-    serves its grouped dataset the same way, arrow-backed through the
-    torch DataLoader (picotron/data.py:57-100)."""
+    also keeps its grouped dataset arrow-backed (through the torch
+    DataLoader, picotron/data.py:57-100) — the parity is the storage
+    strategy only; the packing stride itself deviates (see
+    ``_load_hf_samples``'s group comment)."""
 
     def __init__(self, ds):
         self._ds = ds.with_format("numpy", columns=["ids"])
@@ -169,13 +171,15 @@ class MicroBatchDataLoader:
 
         # Group into fixed-length rows INSIDE the arrow cache: each map
         # batch concatenates its documents and emits len//chunk rows,
-        # dropping the per-batch remainder. Packing stride deviates from
-        # the reference ON PURPOSE: tokenizer_group_text packs OVERLAPPING
-        # windows (stride seq_length over seq_length+1-token rows, so
-        # adjacent rows share one boundary token, reference data.py:70-75);
-        # here rows are non-overlapping seq_length+1 chunks — row counts
-        # and token alignment therefore differ from upstream for the same
-        # corpus, and no token is trained on twice per epoch.
+        # dropping the per-batch remainder. NOT the reference's grouping
+        # contract: this packs NON-OVERLAPPING seq_length+1 chunks, while
+        # the reference's tokenizer_group_text packs OVERLAPPING windows
+        # (stride seq_length over seq_length+1-token rows, adjacent rows
+        # sharing one boundary token, reference data.py:70-75). Row
+        # counts, token alignment, and per-epoch sample identity therefore
+        # ALL differ from upstream for the same corpus/num_samples — a
+        # deliberate deviation (no token is trained on twice per epoch),
+        # not a parity claim (ADVICE.md round 5).
         def group(batch):
             parts = [np.asarray(x, np.int32) for x in batch["ids"]]
             ids = (np.concatenate(parts) if parts

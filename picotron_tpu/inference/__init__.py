@@ -14,7 +14,14 @@ into a batched generation engine:
   incremental-decode hooks; ``decode_block`` fuses ``decode_block_len``
   steps with on-device EOS/budget stop state — one host sync per block;
 - ``batcher``: continuous batching — admit/retire variable-length requests
-  into the engine's fixed slots, consuming whole decode blocks.
+  into the engine's fixed slots, consuming whole decode blocks (or
+  draft-verify dispatches on a speculative engine);
+- ``speculative``: host-side drafters for speculative decoding — the
+  ``Drafter`` interface plus the model-free prompt-lookup ``NgramDrafter``;
+  ``engine.verify`` scores ``spec_len + 1`` positions per slot in one
+  dispatch and ``sampling.speculative_accept`` keeps the matching prefix
+  (exact for greedy, rejection-sampled for stochastic) — one model pass
+  per ACCEPTED RUN instead of per token.
 
 Design notes and CLI usage: docs/INFERENCE.md.
 """
@@ -27,4 +34,8 @@ from picotron_tpu.inference.batcher import (  # noqa: F401
 from picotron_tpu.inference.engine import (  # noqa: F401
     InferenceEngine,
     inference_config,
+)
+from picotron_tpu.inference.speculative import (  # noqa: F401
+    Drafter,
+    NgramDrafter,
 )

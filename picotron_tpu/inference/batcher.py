@@ -18,7 +18,13 @@ the host-side loop that does exactly that:
           EOS ids, and token budgets ride along as arrays, and the
           EOS/budget stop state lives ON DEVICE, so the host syncs once
           per block instead of once per token (``decode_block_len == 1``
-          is the classic per-token loop);
+          is the classic per-token loop). On a SPECULATIVE engine
+          (``engine.spec_len > 0``) the decode phase is draft-verify
+          instead: the drafter proposes ``spec_len`` continuation tokens
+          per occupied slot from the slot's own history (host-side,
+          between dispatches — free), and one ``engine.verify`` dispatch
+          scores, accepts, and rewinds, emitting a VARIABLE 1..spec_len+1
+          tokens per slot per dispatch;
   retire: slots that hit EOS or their token budget — decided on device,
           confirmed host-side from the block's produced counts — release
           (a 1-element length write; stale K/V rows become unreachable)
@@ -37,7 +43,9 @@ per-token loop at ``decode_block_len == 1``).
 count engine calls and output tokens across the batcher's lifetime —
 ``decode_dispatches / generated_tokens`` is the dispatches-per-token
 metric bench_decode.py tracks (1 for the per-token loop, ~1/block_len
-when every slot stays busy).
+when every slot stays busy). Speculative runs add ``draft_proposed`` /
+``draft_accepted`` (``accept_rate`` = their ratio): an accept rate of r
+means the average verify dispatch emitted ~1 + r*spec_len tokens.
 """
 
 from __future__ import annotations
@@ -99,11 +107,20 @@ class ContinuousBatcher:
     not (the decode programs consume it).
     """
 
-    def __init__(self, engine, params, seed: int = 0, clock=time.monotonic):
+    def __init__(self, engine, params, seed: int = 0, clock=time.monotonic,
+                 drafter=None):
         self.engine = engine
         self.params = params
         self._clock = clock  # injectable so deadline tests are deterministic
         self._key = jax.random.PRNGKey(seed)
+        # speculative engines get a drafter (the prompt-lookup default, or
+        # an injected one — e.g. a scripted drafter in tests, a draft
+        # model later); spec-off engines ignore it
+        if drafter is None and engine.spec_len > 0:
+            from picotron_tpu.inference.speculative import NgramDrafter
+
+            drafter = NgramDrafter(engine.spec_ngram)
+        self.drafter = drafter
         self._cache = engine.init_cache()
         self._slots: list = [None] * engine.slots
         self._pending: deque = deque()
@@ -119,6 +136,16 @@ class ContinuousBatcher:
         self.decode_dispatches = 0
         self.prefill_dispatches = 0
         self.generated_tokens = 0
+        self.draft_proposed = 0
+        self.draft_accepted = 0
+
+    @property
+    def accept_rate(self) -> Optional[float]:
+        """Fraction of proposed draft tokens that entered an emitted
+        stream (None before any speculative dispatch)."""
+        if not self.draft_proposed:
+            return None
+        return self.draft_accepted / self.draft_proposed
 
     # ---- queue surface ----------------------------------------------------
 
@@ -239,21 +266,28 @@ class ContinuousBatcher:
     def step(self) -> None:
         """Expire overdue slots, admit waiting requests into free slots,
         then advance every occupied slot by one decode block (up to
-        ``engine.decode_block_len`` tokens per slot, one dispatch)."""
+        ``engine.decode_block_len`` tokens per slot, one dispatch) — or,
+        on a speculative engine, by one draft-verify dispatch (1 to
+        ``engine.spec_len + 1`` tokens per slot)."""
         self._expire_deadlines()
         self._admit()
         if not any(s is not None for s in self._slots):
             return
         for i, s in enumerate(self._slots):
             self._budget[i] = self._remaining(i) if s is not None else 0
-        block = self.engine.decode_block_len
-        keys = np.stack([np.asarray(self._split()) for _ in range(block)])
-        self._cache, toks, counts = self.engine.decode_block(
-            self.params, self._cache, self._last_tok, keys,
-            self._eos, self._budget, self._temp, self._top_k, self._top_p)
-        self.decode_dispatches += 1
-        toks = np.asarray(toks)
-        counts = np.asarray(counts)
+        if self.engine.spec_len > 0:
+            toks, counts = self._spec_round()
+        else:
+            block = self.engine.decode_block_len
+            keys = np.stack([np.asarray(self._split())
+                             for _ in range(block)])
+            self._cache, toks, counts = self.engine.decode_block(
+                self.params, self._cache, self._last_tok, keys,
+                self._eos, self._budget, self._temp, self._top_k,
+                self._top_p)
+            self.decode_dispatches += 1
+            toks = np.asarray(toks)
+            counts = np.asarray(counts)
         for i in range(len(self._slots)):
             if self._slots[i] is None:
                 continue
@@ -264,3 +298,31 @@ class ContinuousBatcher:
                 if self._slots[i] is None:  # device/host rule mismatch guard
                     break
                 self._token_done(i, int(t))
+
+    def _spec_round(self) -> tuple:
+        """One draft-verify round: propose ``spec_len`` tokens per occupied
+        slot from its own history (prompt + generated — the drafter runs
+        host-side while the device is free), dispatch ONE ``engine.verify``
+        pass, and return its (emitted tokens, per-slot counts). Acceptance
+        stats accumulate here; the shared step() tail walks the emitted
+        prefixes through ``_token_done`` exactly like a decode block's."""
+        g = self.engine.spec_len
+        n = len(self._slots)
+        tokens = np.zeros((n, g + 1), np.int32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tokens[i, 0] = self._last_tok[i]
+            hist = np.asarray(list(s.req.prompt) + s.generated, np.int32)
+            tokens[i, 1:] = self.drafter.propose(hist, g)
+        self._cache, emitted, counts, accepted = self.engine.verify(
+            self.params, self._cache, tokens, self._split(), self._eos,
+            self._budget, self._temp, self._top_k, self._top_p)
+        self.decode_dispatches += 1
+        counts = np.asarray(counts)
+        accepted = np.asarray(accepted)
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self.draft_proposed += g
+                self.draft_accepted += int(accepted[i])
+        return np.asarray(emitted), counts

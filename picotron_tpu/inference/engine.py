@@ -26,6 +26,16 @@ cover a request's whole life:
   loop.
 - ``decode_step(...)``: the single-token program (kept for callers that
   want per-token logits; the batcher drives ``decode_block``).
+- ``verify(params, cache, tokens, key, ...)`` (``spec_len > 0``): the
+  speculative-decoding verify pass — ONE dispatch scores ``spec_len + 1``
+  positions per slot (each slot's last token plus ``spec_len`` drafted
+  continuation tokens), writing the drafted K/V into the slot
+  OPTIMISTICALLY (int8 caches quantize on write as always), then applies
+  the distribution-preserving acceptance rule on device
+  (sampling.speculative_accept) and rewinds each slot's length pointer to
+  its accepted prefix — the rejected rows become stale K/V beyond the
+  length mask, exactly like a freed slot's. Each dispatch emits 1 to
+  ``spec_len + 1`` tokens per slot.
 
 Sharding: the engine builds (or is handed) a ``('dp','pp','cp','tp')`` mesh
 with dp=pp=cp=1 and runs the programs under shard_map with the model's
@@ -89,7 +99,9 @@ class InferenceEngine:
                  slots: int = 8, max_seq_len: Optional[int] = None,
                  cache_dtype=None, min_prefill_bucket: int = 16,
                  decode_block_len: Optional[int] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 spec_len: Optional[int] = None,
+                 spec_ngram: Optional[int] = None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
         inf = self.cfg.inference
@@ -116,6 +128,14 @@ class InferenceEngine:
                                  else inf.prefill_chunk)
         if self.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        self.spec_len = int(spec_len if spec_len is not None
+                            else inf.spec_len)
+        if self.spec_len < 0:
+            raise ValueError("spec_len must be >= 0 (0 = off)")
+        self.spec_ngram = int(spec_ngram if spec_ngram is not None
+                              else inf.spec_ngram)
+        if self.spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
         # a chunk wider than the cache window could never be written
         # (mirrors prefill_bucket's min(bucket, max_seq_len) cap)
         self.prefill_chunk = min(self.prefill_chunk, self.max_seq_len)
@@ -162,6 +182,14 @@ class InferenceEngine:
                       P(), P(), P(), P(), P(), P(), P()),
             out_specs=(self._cspecs, P(), P())),
             donate_argnums=(1,))
+        self._verify_jit = None
+        if self.spec_len > 0:
+            self._verify_jit = jax.jit(shard_map(
+                self._verify_impl, mesh,
+                in_specs=(self._pspecs, self._cspecs,
+                          P(), P(), P(), P(), P(), P(), P()),
+                out_specs=(self._cspecs, P(), P(), P())),
+                donate_argnums=(1,))
         self._insert_jit = jax.jit(kv_cache.insert_prefill,
                                    donate_argnums=(0,))
         self._release_jit = jax.jit(kv_cache.release, donate_argnums=(0,))
@@ -211,28 +239,37 @@ class InferenceEngine:
         return ({n: a for n, a in cache.items() if n != "lengths"},
                 cache["lengths"])
 
-    def _decode_core(self, params, cache, tokens):
-        """One model step for all slots: embed ``tokens`` [B], scan the
-        layer stack with per-slot cache writes at ``cache['lengths']``,
-        return (updated per-layer leaves, logits [B, V] fp32). Lengths are
-        NOT advanced here — single-step and blocked callers apply their own
-        activity rule."""
-        cfg = self.cfg
-        pos = cache["lengths"]  # [B] write index of the incoming token
-        cos_b, sin_b = rope_at_positions(self._cos, self._sin, pos)
-        h = llama.embed_lookup(params["embed"],
-                               tokens[:, None]).astype(self._dt)
+    def _model_block(self, params, cache, tokens, rows, pos):
+        """The shared incremental-decode model body: embed ``tokens``
+        [B, S] at RoPE positions ``rows`` [B, S], scan the layer stack
+        writing each slot's S new K/V rows from ``pos`` [B]
+        (kv_cache.cache_write), attend causally over cache prefix + block,
+        and return (updated per-layer leaves, logits [B, S, V] fp32).
+        S == 1 is the decode step; S > 1 the speculative verify block.
+        Lengths are NOT advanced here — callers apply their own activity
+        rule."""
+        cos_b, sin_b = rope_at_positions(self._cos, self._sin, rows)
+        h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
         leaves, _ = self._split_cache(cache)
 
         def body(hc, xs):
             lp, lc = xs
-            hc, lc = llama.decoder_layer(lp, hc, cos_b, sin_b, cfg,
+            hc, lc = llama.decoder_layer(lp, hc, cos_b, sin_b, self.cfg,
                                          cache=lc, pos=pos)
             return hc, lc
 
         h, new_leaves = lax.scan(body, h, (params["layers"], leaves))
-        logits = tp_gather(llama.head_logits(params, h, cfg))[:, 0]
+        logits = tp_gather(llama.head_logits(params, h, self.cfg))
         return new_leaves, logits.astype(jnp.float32)
+
+    def _decode_core(self, params, cache, tokens):
+        """One model step for all slots: ``tokens`` [B] at each slot's own
+        ``cache['lengths']`` position -> (updated per-layer leaves,
+        logits [B, V] fp32)."""
+        pos = cache["lengths"]  # [B] write index of the incoming token
+        new_leaves, logits = self._model_block(
+            params, cache, tokens[:, None], pos[:, None], pos)
+        return new_leaves, logits[:, 0]
 
     def _decode_impl(self, params, cache, tokens, key, temperature,
                      top_k, top_p):
@@ -285,6 +322,59 @@ class InferenceEngine:
             step, (cache, tokens, budget), keys)
         return (cache, jnp.swapaxes(toks, 0, 1),
                 jnp.sum(actives.astype(jnp.int32), axis=0))
+
+    def _verify_impl(self, params, cache, tokens, key, eos_id, budget,
+                     temperature, top_k, top_p):
+        """The speculative verify pass: tokens [B, S] (S = spec_len + 1 —
+        each slot's current last token followed by its spec_len drafted
+        continuation tokens), scored in ONE model dispatch.
+
+        All S positions embed at each slot's own offsets
+        (``cache['lengths'] + 0..S-1``), their K/V are written into the
+        slot OPTIMISTICALLY (the batched-write branch of
+        kv_cache.cache_write; int8 caches quantize on write), and
+        attention runs causally over the cache prefix plus the fed block —
+        the same masked kernel the chunked prefill uses, batched over
+        slots. The resulting logits[b, i] score the token FOLLOWING fed
+        token i, so ``sampling.speculative_accept`` can accept the
+        matching draft prefix and draw the one fresh token, all on device.
+
+        Rollback is the length pointer: ``lengths`` advances by the
+        emitted count only (accepted prefix + the fresh token's slot-feed
+        position), so rejected draft rows — already written — sit beyond
+        the mask, stale and unreachable, and the next dispatch overwrites
+        them. EOS truncates the emitted run on device (the stream ends AT
+        the first emitted EOS); ``budget`` [B] caps it exactly like
+        decode_block's budget. Free slots (length 0) ride along inactive:
+        they emit count 0 and their length stays 0.
+
+        Returns (cache, emitted [B, S], counts [B], accepted [B]) where
+        ``accepted`` is the number of DRAFT tokens that made it into the
+        emitted stream (the accept-rate numerator).
+        """
+        B, S = tokens.shape
+        pos0 = cache["lengths"]
+        rows = pos0[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+        new_leaves, logits = self._model_block(
+            params, cache, tokens, rows, pos0)  # logits [B, S, V]
+        emitted, counts = sampling.speculative_accept(
+            logits, tokens[:, 1:], key, temperature, top_k, top_p)
+        raw = counts  # pre-clip: accepted drafts + 1 fresh token
+        active = (pos0 > 0) & (budget > 0)
+        counts = jnp.where(active, jnp.minimum(counts, budget), 0)
+        cols = jnp.arange(S, dtype=jnp.int32)[None, :]
+        is_eos = ((eos_id >= 0)[:, None] & (emitted == eos_id[:, None])
+                  & (cols < counts[:, None]))
+        counts = jnp.where(jnp.any(is_eos, axis=1),
+                           jnp.argmax(is_eos, axis=1) + 1, counts)
+        emitted = jnp.where(cols < counts[:, None], emitted, 0)
+        # of the emitted run, all but (possibly) the last token are drafts:
+        # when nothing clipped, raw - 1 drafts + 1 fresh; when EOS/budget
+        # clipped below that, every emitted token was a draft
+        accepted = jnp.minimum(raw - 1, counts)
+        new_cache = {**new_leaves,
+                     "lengths": jnp.where(active, pos0 + counts, pos0)}
+        return new_cache, emitted, counts, accepted
 
     def _prefill_chunk_impl(self, params, cache, tokens, slot, start, valid):
         """One fixed-width prefill chunk for one slot: tokens [1, C] (pad
@@ -432,6 +522,36 @@ class InferenceEngine:
         return self._decode_block_jit(
             params, cache,
             jnp.asarray(np.asarray(tokens, np.int32)), keys,
+            jnp.asarray(np.asarray(eos_id, np.int32)),
+            jnp.asarray(np.asarray(budget, np.int32)),
+            jnp.asarray(np.asarray(temperature, np.float32)),
+            jnp.asarray(np.asarray(top_k, np.int32)),
+            jnp.asarray(np.asarray(top_p, np.float32)))
+
+    def verify(self, params, cache, tokens, key, eos_id, budget,
+               temperature, top_k, top_p) -> tuple:
+        """One speculative draft-verify dispatch for every slot
+        (``spec_len > 0`` engines only). ``tokens`` is
+        [slots, spec_len + 1] int32 — column 0 is each slot's current last
+        token, columns 1..spec_len its drafted continuation; the remaining
+        arguments are [slots] arrays exactly as ``decode_block`` takes
+        them. Returns (cache, emitted [slots, spec_len + 1], counts
+        [slots], accepted-draft counts [slots]) — ``counts[b]`` leading
+        entries of emitted row b are the tokens slot b produced this
+        dispatch (1..spec_len + 1 per active slot). Consumes ``cache``."""
+        if self._verify_jit is None:
+            raise ValueError(
+                "speculative decoding is off for this engine (spec_len == "
+                "0); construct it with spec_len > 0 or set "
+                "inference.spec_len")
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.shape != (self.slots, self.spec_len + 1):
+            raise ValueError(
+                f"verify tokens must be [slots, spec_len + 1] = "
+                f"[{self.slots}, {self.spec_len + 1}]; got "
+                f"{tokens.shape}")
+        return self._verify_jit(
+            params, cache, jnp.asarray(tokens), key,
             jnp.asarray(np.asarray(eos_id, np.int32)),
             jnp.asarray(np.asarray(budget, np.int32)),
             jnp.asarray(np.asarray(temperature, np.float32)),

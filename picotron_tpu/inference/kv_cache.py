@@ -127,13 +127,23 @@ def quantized(cache: dict) -> bool:
 def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
                 pos: jnp.ndarray) -> dict:
     """Write fresh K/V rows into one layer's cache block and return the
-    updated block. Two shapes of write:
+    updated block. Three shapes of write:
 
     - decode (``S == 1``): ``k_new``/``v_new`` [B, 1, H, D] with ``pos``
       [B] — every slot writes one row at its own position (a per-row
       scatter; free slots write their invisible row 0);
-    - chunked prefill (``S > 1``): [1, S, H, D] with ``pos`` [1] — one
-      slot writes a contiguous block of rows starting at ``pos[0]``.
+    - chunked prefill (``S > 1``, ``B == 1``): [1, S, H, D] with ``pos``
+      [1] — one slot writes a contiguous block of rows starting at
+      ``pos[0]``;
+    - speculative verify (``S > 1``, ``B > 1``): [B, S, H, D] with ``pos``
+      [B] — EVERY slot writes S contiguous rows starting at its own
+      position (engine._verify_impl's optimistic draft write). Rows past
+      the cache window drop (jax scatter out-of-bounds semantics — no
+      clamping onto earlier rows), and rows past the post-acceptance
+      length are stale: the length pointer is the rewind, ``attend``'s
+      mask makes them unreachable (tests/test_speculative.py pins that a
+      rejected draft leaves attention output identical to never having
+      written it).
 
     int8 caches quantize on write; the scale rows land at the same
     positions in ``k_scale``/``v_scale``.
@@ -152,8 +162,7 @@ def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
             if scales is not None:
                 out[sname] = layer_cache[sname].at[rows, pos].set(
                     scales[:, 0].astype(SCALE_DTYPE))
-        else:
-            assert B == 1, f"block writes are single-slot (got batch {B})"
+        elif B == 1:
             start = jnp.asarray(pos[0], jnp.int32)
             out[name] = lax.dynamic_update_slice(
                 layer_cache[name], vals, (0, start, 0, 0))
@@ -161,6 +170,13 @@ def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
                 out[sname] = lax.dynamic_update_slice(
                     layer_cache[sname], scales.astype(SCALE_DTYPE),
                     (0, start, 0))
+        else:
+            rows = pos[:, None] + jnp.arange(S, dtype=pos.dtype)[None, :]
+            bidx = jnp.arange(B)[:, None]
+            out[name] = layer_cache[name].at[bidx, rows].set(vals)
+            if scales is not None:
+                out[sname] = layer_cache[sname].at[bidx, rows].set(
+                    scales.astype(SCALE_DTYPE))
 
     store("k", "k_scale", k_new)
     store("v", "v_scale", v_new)
@@ -194,8 +210,9 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     masking convention as ops/attention.py, output cast back to q.dtype.
 
     S == 1 is the autoregressive decode step; S > 1 is chunked continuation
-    — prefill chunks attending over the already-written prefix plus
-    themselves (each query i masks keys past its own position).
+    — prefill chunks (B == 1) or speculative verify batches (B > 1)
+    attending over the already-written prefix plus themselves (each query i
+    masks keys past its own position).
     """
     B, S, nh, D = q.shape
     T, nkv = k.shape[1], k.shape[2]

@@ -10,6 +10,12 @@ then top-k, then top-p on the rescaled distribution. ``temperature == 0``
 means greedy (argmax) for that row; ``top_k <= 0`` and ``top_p >= 1``
 disable their filters. Masked logits use the same large-negative fill as
 ops/attention.py so fully-filtered rows stay finite.
+
+``speculative_accept`` is the draft-acceptance rule for speculative
+decoding (engine.verify): exact-match for greedy rows, rejection sampling
+with residual-distribution resampling for stochastic rows — the emitted
+stream is distributionally identical to drawing token-by-token from
+``sample`` over the same filtered logits.
 """
 
 from __future__ import annotations
@@ -105,3 +111,97 @@ def sample(logits: jnp.ndarray, key, temperature: jnp.ndarray,
     # no collectives in either branch, so the cond is shard_map-safe
     return jax.lax.cond(jnp.all(temperature <= 0.0),
                         lambda: greedy_tok, stochastic)
+
+
+def filtered_probs(logits: jnp.ndarray, temperature: jnp.ndarray,
+                   top_k: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """The distribution ``sample`` draws its stochastic rows from:
+    softmax over temperature-scaled, top-k/top-p-filtered logits.
+    logits [N, V] fp32 with [N] per-row params -> probs [N, V] fp32."""
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    return jax.nn.softmax(
+        filter_top_k_top_p(logits.astype(jnp.float32) / t, top_k, top_p),
+        axis=-1)
+
+
+def _leading_true(ok: jnp.ndarray) -> jnp.ndarray:
+    """Length of each row's leading all-True prefix: [B, G] bool -> [B]."""
+    return jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+
+
+def speculative_accept(logits: jnp.ndarray, draft: jnp.ndarray, key,
+                       temperature: jnp.ndarray, top_k: jnp.ndarray,
+                       top_p: jnp.ndarray) -> tuple:
+    """Distribution-preserving draft acceptance (Leviathan et al. 2023 /
+    Chen et al. 2023 speculative sampling, specialized to a DETERMINISTIC
+    drafter: the proposal q is a point mass at the drafted token, so the
+    accept probability min(1, p/q) reduces to p(draft) and a rejection
+    resamples from the residual norm(max(p - q, 0)) = p with the rejected
+    token zeroed, renormalized).
+
+    ``logits`` [B, S, V] fp32 — the verify pass's scores, where
+    ``logits[:, i]`` is the target distribution for the token FOLLOWING fed
+    token i (S = gamma + 1: the slot's last token plus gamma drafts);
+    ``draft`` [B, gamma] int32; ``temperature``/``top_k``/``top_p`` [B]
+    per-slot sampling params (the same arrays ``sample`` takes, so the
+    target p is exactly the non-speculative sampler's distribution).
+
+    Returns ``(emitted [B, S] int32, counts [B] int32)``: row b's leading
+    ``counts[b]`` entries (1 <= counts <= gamma + 1) are the tokens the
+    slot emits this dispatch — the accepted draft prefix plus one fresh
+    token (the residual resample on rejection, a draw from the bonus
+    position when every draft accepted). Positions past ``counts`` are
+    pad 0. Greedy rows (temperature <= 0) take the exact-match fast path:
+    accept while draft == argmax and emit the argmax correction/bonus — the
+    emitted chain IS the greedy chain, so greedy speculative output is
+    bit-identical to non-speculative greedy decode. An all-greedy batch
+    (the serving default) short-circuits past the filter/softmax/draw
+    pipeline entirely.
+    """
+    B, S, V = logits.shape
+    G = S - 1
+    preds = greedy(logits.reshape(B * S, V)).reshape(B, S)  # [B, S] argmax
+    acc_greedy = _leading_true(draft == preds[:, :G])
+    last_greedy = jnp.take_along_axis(
+        preds, acc_greedy[:, None], axis=1)[:, 0]
+
+    def greedy_case():
+        return acc_greedy, last_greedy
+
+    def stochastic_case():
+        probs = filtered_probs(
+            logits.reshape(B * S, V), jnp.repeat(temperature, S),
+            jnp.repeat(top_k, S), jnp.repeat(top_p, S)).reshape(B, S, V)
+        key_u, key_r = jax.random.split(key)
+        # accept draft i with probability p_i(draft_i); acceptance is a
+        # leading prefix — the first rejection discards the rest
+        p_draft = jnp.take_along_axis(
+            probs[:, :G], draft[:, :, None], axis=-1)[..., 0]  # [B, G]
+        u = jax.random.uniform(key_u, (B, G))
+        acc = _leading_true(u < p_draft)
+        # the fresh token's distribution: the residual at the rejection
+        # position (p with the rejected draft token removed, renormalized),
+        # or the untouched bonus-position p when every draft accepted
+        p_next = jnp.take_along_axis(probs, acc[:, None, None],
+                                     axis=1)[:, 0]  # [B, V]
+        rej = jnp.take_along_axis(
+            draft, jnp.minimum(acc, G - 1)[:, None], axis=1)[:, 0]
+        strip = ((jnp.arange(V)[None, :] == rej[:, None])
+                 & (acc < G)[:, None])
+        res = jnp.where(strip, 0.0, p_next)
+        res = res / jnp.maximum(jnp.sum(res, axis=-1, keepdims=True), 1e-20)
+        fresh = jax.random.categorical(
+            key_r, jnp.log(jnp.maximum(res, 1e-20)), axis=-1).astype(
+            jnp.int32)
+        # per-row greedy override inside a mixed batch
+        a = jnp.where(temperature <= 0.0, acc_greedy, acc)
+        return a, jnp.where(temperature <= 0.0, last_greedy, fresh)
+
+    # no collectives in either branch, so the cond is shard_map-safe
+    acc, last = jax.lax.cond(jnp.all(temperature <= 0.0),
+                             greedy_case, stochastic_case)
+    cols = jnp.arange(S, dtype=jnp.int32)[None, :]
+    emitted = jnp.where(cols < acc[:, None],
+                        jnp.pad(draft, ((0, 0), (0, 1))), 0)
+    emitted = jnp.where(cols == acc[:, None], last[:, None], emitted)
+    return emitted, acc + 1

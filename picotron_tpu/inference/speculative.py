@@ -1,0 +1,94 @@
+"""Speculative decoding: cheap host-side drafters for the verify pass.
+
+Classic speculative decoding (Leviathan et al. 2023, "Fast Inference from
+Transformers via Speculative Decoding"; Chen et al. 2023, "Accelerating
+Large Language Model Decoding with Speculative Sampling") converts decode
+from one model pass per token to one pass per ACCEPTED RUN: a cheap
+drafter proposes ``gamma`` tokens, one jitted verify dispatch
+(engine.verify — the blocked decode program generalized to gamma+1 query
+positions per slot) scores them all, and the distribution-preserving
+acceptance rule (sampling.speculative_accept) keeps the matching prefix
+plus one fresh token. Every dispatch emits between 1 and gamma+1 tokens,
+so dispatches-per-token — the host-sync metric bench_decode.py tracks —
+drops below 1 whenever anything accepts, and the output distribution is
+untouched (bit-identical for greedy, distributionally identical for
+sampled; both test-pinned).
+
+This module holds the DRAFT side: a ``Drafter`` needs no device state and
+no second model — it proposes from the slot's own token history on the
+host, between dispatches. The built-in ``NgramDrafter`` is prompt-lookup
+decoding (match the last k tokens against the history, propose what
+followed last time): free, and strong exactly where speculation pays —
+repetitive continuations, code, retrieval-grounded generation, and the
+token loops greedy decoding falls into. The interface is deliberately
+tiny so a small draft MODEL can slot in later: wrap its own decode loop in
+``propose`` and return gamma tokens.
+
+Acceptance accounting rides in the batcher (``draft_proposed`` /
+``draft_accepted`` / ``accept_rate``): an accept-rate of r means the
+average dispatch emitted ~1 + r*gamma tokens. Rates near 0 mean the
+drafter is guessing blind (speculation costs nothing but the wider verify
+dispatch); rates near 1 mean dispatches-per-token approaches
+1/(gamma+1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Drafter:
+    """Proposes draft tokens for one slot from its token history.
+
+    Implementations must be DETERMINISTIC functions of ``history`` — the
+    acceptance rule (sampling.speculative_accept) treats the proposal as a
+    point-mass distribution, which is what makes rejection resampling
+    exact. A stochastic drafter (e.g. a sampled draft model) would need
+    its per-token proposal probabilities threaded into the accept rule.
+    """
+
+    def propose(self, history: np.ndarray, n: int) -> np.ndarray:
+        """Return exactly ``n`` proposed continuation tokens (int32) for a
+        slot whose tokens so far (prompt + generated, the yet-unwritten
+        last token included) are ``history``. Proposals are speculative by
+        definition — a bad guess costs nothing but the rejected verify
+        columns — so there is no "no proposal" escape hatch; return a
+        best-effort guess."""
+        raise NotImplementedError
+
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup drafting: match the longest suffix n-gram (``ngram``
+    down to 1 tokens) of the history against its earlier occurrences and
+    propose the ``n`` tokens that followed the MOST RECENT match. A match
+    near the end of the history cycles its continuation (the region from
+    the match to the end is exactly the pattern being repeated), which is
+    what catches greedy token loops and boilerplate. No match at any
+    length falls back to repeating the last token."""
+
+    def __init__(self, ngram: int = 3):
+        if ngram < 1:
+            raise ValueError("ngram must be >= 1")
+        self.ngram = int(ngram)
+
+    def propose(self, history: np.ndarray, n: int) -> np.ndarray:
+        h = np.asarray(history, np.int32).reshape(-1)
+        if n < 1:
+            return np.zeros(0, np.int32)
+        if h.size < 2:
+            fill = h[-1] if h.size else 0
+            return np.full(n, fill, np.int32)
+        for k in range(min(self.ngram, h.size - 1), 0, -1):
+            suffix = h[-k:]
+            # candidate starts i with i + k <= len - 1: the match must have
+            # at least one continuation token (the final occurrence — the
+            # suffix itself — is excluded by construction)
+            windows = np.lib.stride_tricks.sliding_window_view(
+                h[: h.size - 1], k)
+            hits = np.flatnonzero((windows == suffix).all(axis=1))
+            if hits.size:
+                cont = h[hits[-1] + k:]
+                # cycle the continuation out to n tokens: after a match at
+                # the end, the tail IS the expected future of the loop
+                return np.resize(cont, n).astype(np.int32)
+        return np.full(n, h[-1], np.int32)

@@ -269,8 +269,9 @@ def _attention(q, k, v, cfg: Config, cache=None, pos=None):
         from picotron_tpu.inference.kv_cache import attend
 
         # S queries starting at per-sequence write index ``pos``: the valid
-        # key count is pos + S (S == 1 decode, S > 1 chunked prefill);
-        # ``attend`` dequantizes int8 cache blocks on the fly
+        # key count is pos + S (S == 1 decode, S > 1 chunked prefill or
+        # speculative verify); ``attend`` dequantizes int8 cache blocks on
+        # the fly
         return attend(q, cache, pos + q.shape[1], scale)
     impl = cfg.model.attention_impl
     if impl == "auto":
@@ -327,14 +328,18 @@ def decoder_layer(lp, h, cos, sin, cfg: Config, cache=None, pos=None,
       [B, S, n_kv_local, head_dim] for the caller to park in a KV cache —
       return value becomes ``(h, (k, v))``.
     - ``cache={"k","v"[,"k_scale","v_scale"]}`` + ``pos`` [B] (decode /
-      chunked prefill): the new tokens' K/V are written into the per-layer
-      cache block starting at each sequence's ``pos`` (int8 caches
-      quantize on write — kv_cache.cache_write) and attention runs as a
-      masked dot product over the cache (``_attention``'s decode path);
-      ``cos``/``sin`` must then be the per-sequence [B, S, head_dim]
-      tables from ``ops.rope.rope_at_positions``. S == 1 is the per-slot
-      decode step; S > 1 is a single-slot prefill chunk. Return value is
-      ``(h, updated_cache_dict)``. Both assume cp == 1 (the serving mesh
+      chunked prefill / speculative verify): the new tokens' K/V are
+      written into the per-layer cache block starting at each sequence's
+      ``pos`` (int8 caches quantize on write — kv_cache.cache_write) and
+      attention runs as a masked dot product over the cache
+      (``_attention``'s decode path); ``cos``/``sin`` must then be the
+      per-sequence [B, S, head_dim] tables from
+      ``ops.rope.rope_at_positions``. S == 1 is the per-slot decode step;
+      S > 1 with B == 1 is a single-slot prefill chunk; S > 1 with B > 1
+      is the multi-token decode hook — EVERY slot scores S contiguous
+      positions from its own offset in one pass (speculative decoding's
+      verify dispatch, engine._verify_impl). Return value is
+      ``(h, updated_cache_dict)``. All assume cp == 1 (the serving mesh
       is tp-only; inference/engine.py enforces it)."""
     m, tp = cfg.model, cfg.distributed.tp_size
     nh, nkv, D = m.num_attention_heads // tp, m.num_key_value_heads // tp, m.head_dim
@@ -369,10 +374,11 @@ def decoder_layer(lp, h, cos, sin, cfg: Config, cache=None, pos=None,
 
     new_cache = None
     if cache is not None:
-        # incremental decode (S == 1, one row per slot) or chunked prefill
-        # (S > 1, one slot's contiguous block): write the fresh K/V at each
-        # sequence's position (quantizing for int8 caches), attend over the
-        # whole cache block
+        # incremental decode (S == 1, one row per slot), chunked prefill
+        # (S > 1, one slot's contiguous block), or speculative verify
+        # (S > 1, every slot's contiguous block): write the fresh K/V at
+        # each sequence's position (quantizing for int8 caches), attend
+        # over the whole cache block
         from picotron_tpu.inference.kv_cache import cache_write
 
         new_cache = cache_write(cache, k, v, pos)

@@ -124,6 +124,13 @@ def main(argv=None) -> int:
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="chunked-prefill width for prompts longer than "
                          "this (default: config inference.prefill_chunk)")
+    ap.add_argument("--spec-len", type=int, default=None,
+                    help="speculative decoding: draft tokens per verify "
+                         "dispatch (default: config inference.spec_len; "
+                         "0 = off)")
+    ap.add_argument("--spec-ngram", type=int, default=None,
+                    help="longest suffix n-gram the prompt-lookup drafter "
+                         "matches (default: config inference.spec_ngram)")
     ap.add_argument("--smoke", action="store_true",
                     help="built-in tiny CPU model + random init + fixed "
                     "prompts (the `make decode-smoke` target)")
@@ -161,7 +168,9 @@ def main(argv=None) -> int:
     engine = InferenceEngine(cfg, slots=args.slots,
                              max_seq_len=args.max_seq_len,
                              decode_block_len=args.decode_block_len,
-                             prefill_chunk=args.prefill_chunk)
+                             prefill_chunk=args.prefill_chunk,
+                             spec_len=args.spec_len,
+                             spec_ngram=args.spec_ngram)
     params = _load_weights(args, cfg, engine)
     requests = _build_requests(args, tokenizer)
     setup_s = time.perf_counter() - t0
@@ -185,12 +194,15 @@ def main(argv=None) -> int:
             line += f"\n  text: {tokenizer.decode(r.prompt + r.tokens)!r}"
         print(line)
     dpt = batcher.decode_dispatches / max(batcher.generated_tokens, 1)
+    spec = (f"spec={engine.spec_len} "
+            f"accept={batcher.accept_rate:.2f} " if engine.spec_len > 0
+            and batcher.accept_rate is not None else "")
     print(f"{n_tokens} tokens in {gen_s:.2f}s "
           f"({n_tokens / max(gen_s, 1e-9):.1f} tok/s, "
           f"setup {setup_s:.1f}s, slots={engine.slots}, "
           f"tp={engine.topo.tp_size}, block={engine.decode_block_len}, "
           f"kv={'int8' if engine.quantized else str(engine.cache_dtype)}, "
-          f"{batcher.decode_dispatches} decode dispatches = "
+          f"{spec}{batcher.decode_dispatches} decode dispatches = "
           f"{dpt:.3f}/token)")
     if failed:
         print("FAILED: some request produced no/invalid tokens",

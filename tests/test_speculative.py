@@ -1,0 +1,425 @@
+"""Speculative decoding (ISSUE-4): draft-verify pipeline on top of blocked
+decode.
+
+Acceptance surface:
+- greedy speculative decode (prompt-lookup drafter, any draft quality) is
+  BIT-IDENTICAL to the spec-off batcher streams on tp=1 and a tp=2 dryrun
+  mesh, EOS mid-verify included;
+- a drafter that guesses right turns dispatches-per-token into
+  1/(spec_len+1): a scripted oracle drafter pins the dispatch count and a
+  100% accept rate;
+- the acceptance rule is distribution-preserving: greedy rows take the
+  exact-match fast path (unit-pinned emitted prefixes), stochastic rows
+  rejection-sample with residual resampling — a seeded statistical test
+  pins the emitted-token frequencies against the non-speculative
+  sampler's filtered softmax, at the pure-function level AND through the
+  real verify dispatch;
+- rollback is the length pointer: a rejected draft's optimistically
+  written K/V rows leave ``attend`` output bit-identical to never having
+  written them (bf16 and int8 caches);
+- the n-gram drafter proposes cycle continuations from the slot's own
+  history (longest suffix first) and always returns exactly n tokens.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_config
+from picotron_tpu.config import Config
+from picotron_tpu.inference import (
+    ContinuousBatcher,
+    InferenceEngine,
+    NgramDrafter,
+    Request,
+    kv_cache,
+    sampling,
+)
+from picotron_tpu.inference.speculative import Drafter
+from picotron_tpu.models import llama
+
+MAX_LEN = 96
+
+
+def _engine(tiny_model_kwargs, tp=1, slots=2, **kw):
+    cfg = make_config(tiny_model_kwargs, tp=tp, seq=MAX_LEN)
+    return cfg, InferenceEngine(cfg, slots=slots, max_seq_len=MAX_LEN, **kw)
+
+
+def _params(cfg, engine, seed=0):
+    p = jax.jit(lambda k: llama.init_params(k, cfg.model))(
+        jax.random.PRNGKey(seed))
+    return engine.shard_params(p)
+
+
+class ScriptedDrafter(Drafter):
+    """Oracle drafter for tests: proposes the known future of one scripted
+    sequence (prompt + expected tokens) by matching the history length."""
+
+    def __init__(self, script):
+        self.script = list(script)
+
+    def propose(self, history, n):
+        start = len(np.asarray(history).reshape(-1))
+        out = np.zeros(n, np.int32)
+        tail = self.script[start: start + n]
+        out[: len(tail)] = tail
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# greedy speculation == spec-off, bit for bit
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("tp,spec_len", [(1, 2), (1, 4), (2, 3)])
+def test_greedy_spec_matches_spec_off(tiny_model_kwargs, tp, spec_len):
+    """Mixed-length greedy requests through the speculative batcher (the
+    real NgramDrafter — accepts and rejections both occur) must produce
+    the spec-off engine's streams token for token."""
+    cfg, eng_off = _engine(tiny_model_kwargs, tp=tp)
+    _, eng_on = _engine(tiny_model_kwargs, tp=tp, spec_len=spec_len)
+    params = _params(cfg, eng_off)
+    reqs = [Request("a", [1, 2, 3, 1, 2, 3, 1, 2], max_new_tokens=17),
+            Request("b", [9, 8, 7], max_new_tokens=6)]
+    want = ContinuousBatcher(eng_off, params).run(reqs)
+    got = ContinuousBatcher(eng_on, params).run(reqs)
+    for r in reqs:
+        assert got[r.uid].tokens == want[r.uid].tokens, (r.uid, tp, spec_len)
+        assert got[r.uid].finish_reason == "length"
+
+
+def test_greedy_spec_eos_mid_verify(tiny_model_kwargs):
+    """A stream whose EOS lands mid-verify (inside an accepted draft run
+    or at the fresh token) must end AT the EOS — identical to spec-off —
+    and the queued request behind it still completes."""
+    cfg, eng_off = _engine(tiny_model_kwargs, slots=1)
+    _, eng_on = _engine(tiny_model_kwargs, slots=1, spec_len=4)
+    params = _params(cfg, eng_off)
+    prompt = [5, 6, 7, 8]
+    free = ContinuousBatcher(eng_off, params).run(
+        [Request("f", prompt, max_new_tokens=12)])["f"]
+    eos = free.tokens[5]
+    assert eos not in free.tokens[:5], "pick a different seed/prompt"
+    res = ContinuousBatcher(eng_on, params).run([
+        Request("x", prompt, max_new_tokens=12, eos_id=eos),
+        Request("y", [3, 1, 4], max_new_tokens=5),
+    ])
+    assert res["x"].finish_reason == "eos"
+    assert res["x"].tokens == free.tokens[:6]
+    assert res["y"].finish_reason == "length"
+    assert len(res["y"].tokens) == 5
+
+
+def test_scripted_drafter_dispatch_savings(tiny_model_kwargs):
+    """An oracle drafter (knows the greedy future) must drive acceptance
+    to 100% and the decode dispatch count to ceil((n-1)/(spec_len+1)) —
+    the one-pass-per-accepted-run win speculation exists for."""
+    cfg, eng_off = _engine(tiny_model_kwargs)
+    _, eng_on = _engine(tiny_model_kwargs, spec_len=3)
+    params = _params(cfg, eng_off)
+    prompt = [1, 2, 3, 4, 5]
+    n_new = 13
+    want = ContinuousBatcher(eng_off, params).run(
+        [Request("r", prompt, max_new_tokens=n_new)])["r"].tokens
+    drafter = ScriptedDrafter(prompt + want)
+    b = ContinuousBatcher(eng_on, params, drafter=drafter)
+    got = b.run([Request("r", prompt, max_new_tokens=n_new)])["r"].tokens
+    assert got == want
+    assert b.accept_rate == 1.0
+    # token 1 comes from the prefill sample; each verify emits spec_len+1
+    assert b.decode_dispatches == math.ceil((n_new - 1) / 4)
+    assert b.decode_dispatches < n_new - 1  # strictly beats per-token
+
+
+def test_spec_respects_budget_and_window(tiny_model_kwargs):
+    """Budgets that are not multiples of spec_len+1 (and a prompt close to
+    the window) stop at exactly max_new_tokens — the device budget clip on
+    the variable-length emit."""
+    cfg, eng = _engine(tiny_model_kwargs, slots=2, spec_len=4)
+    params = _params(cfg, eng)
+    reqs = [Request("a", [1, 2, 3], max_new_tokens=7),
+            Request("b", list(range(1, 90)), max_new_tokens=64)]
+    res = ContinuousBatcher(eng, params).run(reqs)
+    assert len(res["a"].tokens) == 7 and res["a"].finish_reason == "length"
+    # 89 prompt tokens under MAX_LEN 96 leave exactly 7
+    assert len(res["b"].tokens) == 7 and res["b"].finish_reason == "length"
+
+
+# --------------------------------------------------------------------------- #
+# acceptance rule: greedy fast path + distribution preservation
+# --------------------------------------------------------------------------- #
+
+
+def _logits_for_chain(chain, V, boost=8.0):
+    """[S, V] logits whose argmax at position i is chain[i], with enough
+    margin that the argmax is unambiguous."""
+    rng = np.random.default_rng(0)
+    out = rng.normal(size=(len(chain), V)).astype(np.float32)
+    out[np.arange(len(chain)), chain] += boost
+    return out
+
+
+def test_accept_greedy_prefix():
+    """Greedy rows accept exactly the matching draft prefix and emit the
+    argmax correction (or the bonus token when everything matched)."""
+    V = 11
+    chain = [3, 7, 1, 4, 9]  # argmax at the 5 verify positions
+    logits = jnp.asarray(_logits_for_chain(chain, V)[None])  # [1, 5, V]
+    zero, one = jnp.zeros(1), jnp.ones(1)
+    for n_match in range(5):
+        draft = list(chain[:4])
+        if n_match < 4:
+            draft[n_match] = (draft[n_match] + 1) % V  # first mismatch
+        emitted, counts = sampling.speculative_accept(
+            logits, jnp.asarray([draft], jnp.int32), jax.random.PRNGKey(0),
+            zero, jnp.zeros(1, jnp.int32), one)
+        want = chain[: n_match + 1]  # accepted prefix == greedy chain
+        assert int(counts[0]) == n_match + 1
+        assert list(np.asarray(emitted)[0, : n_match + 1]) == want
+        assert np.all(np.asarray(emitted)[0, n_match + 1:] == 0)
+
+
+def test_accept_distribution_matches_sampler():
+    """Seeded statistical test of the rejection/residual rule: over many
+    keys, the FIRST emitted token's frequencies must converge to the
+    non-speculative sampler's distribution (filtered softmax) — whether
+    the draft token is likely or unlikely — and the draft must accept at
+    ~its target probability. Also exercised with top-k filtering."""
+    rng = np.random.default_rng(2)
+    V = 8
+    logits = jnp.asarray(rng.normal(size=(1, 2, V)).astype(np.float32))
+    n = 4000
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    one = jnp.ones(1)
+
+    probs0 = np.asarray(jax.nn.softmax(logits[0, 0]))
+    for draft_tok in (int(np.argmax(probs0)), int(np.argmin(probs0))):
+        for top_k in (0, 3):
+            draft = jnp.asarray([[draft_tok]], jnp.int32)
+            ks = jnp.full(1, top_k, jnp.int32)
+
+            def first_tok(key):
+                emitted, _ = sampling.speculative_accept(
+                    logits, draft, key, one, ks, one)
+                return emitted[0, 0]
+
+            toks = np.asarray(jax.vmap(first_tok)(keys))
+            freq = np.bincount(toks, minlength=V) / n
+            want = np.asarray(sampling.filtered_probs(
+                logits[0, :1], one, ks, one))[0]
+            np.testing.assert_allclose(freq, want, atol=0.04,
+                                       err_msg=f"d={draft_tok} k={top_k}")
+            # acceptance fires at the draft token's target probability
+            def count(key):
+                _, c = sampling.speculative_accept(
+                    logits, draft, key, one, ks, one)
+                return c[0]
+
+            acc = np.mean(np.asarray(jax.vmap(count)(keys)) == 2)
+            np.testing.assert_allclose(acc, want[draft_tok], atol=0.04)
+
+
+def test_accept_second_position_distribution():
+    """Given an accepted draft, the NEXT emitted token draws from the
+    bonus position's own filtered softmax — the chain rule that makes the
+    whole emitted run distributionally exact."""
+    rng = np.random.default_rng(3)
+    V = 8
+    logits_np = rng.normal(size=(1, 2, V)).astype(np.float32)
+    probs0 = np.asarray(jax.nn.softmax(jnp.asarray(logits_np[0, 0])))
+    draft_tok = int(np.argmax(probs0))  # likely -> plenty of accepts
+    logits = jnp.asarray(logits_np)
+    draft = jnp.asarray([[draft_tok]], jnp.int32)
+    one, zk = jnp.ones(1), jnp.zeros(1, jnp.int32)
+    n = 4000
+    keys = jax.random.split(jax.random.PRNGKey(1), n)
+
+    def run(key):
+        emitted, counts = sampling.speculative_accept(
+            logits, draft, key, one, zk, one)
+        return emitted[0, 1], counts[0]
+
+    second, counts = jax.vmap(run)(keys)
+    second, counts = np.asarray(second), np.asarray(counts)
+    sel = counts == 2  # draft accepted: position 1 is the bonus draw
+    assert sel.mean() > 0.25
+    freq = np.bincount(second[sel], minlength=V) / sel.sum()
+    want = np.asarray(jax.nn.softmax(logits[0, 1]))
+    np.testing.assert_allclose(freq, want, atol=0.05)
+
+
+def test_spec_sampled_e2e_distribution(tiny_model_kwargs):
+    """The real verify dispatch preserves the sampler's distribution:
+    park a prompt, feed a fixed last token + drafts, and over many keys
+    the first emitted token's frequencies must match the filtered softmax
+    of the full-forward oracle logits at that position (top-k 4
+    concentrates the support so a few hundred draws resolve it)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from picotron_tpu.utils import shard_map as shard_map_compat
+
+    cfg, engine = _engine(tiny_model_kwargs, slots=1, spec_len=2)
+    params = _params(cfg, engine)
+    prompt = [7, 3, 5, 2, 7, 3]
+    t0, top_k, temp = 9, 4, 1.0
+
+    fwd = jax.jit(shard_map_compat(
+        lambda p, t: llama.forward_logits(p, t, cfg), engine.topo.mesh,
+        in_specs=(llama.param_pspecs(cfg.model), P()), out_specs=P()))
+    oracle = np.asarray(fwd(params, jnp.asarray(
+        np.asarray(prompt + [t0], np.int32)[None])))[0, -1]
+    want = np.asarray(sampling.filtered_probs(
+        jnp.asarray(oracle[None]), jnp.full(1, temp),
+        jnp.full(1, top_k, jnp.int32), jnp.ones(1)))[0]
+    draft_tok = int(np.argmax(want))  # exercises accept AND reject paths
+
+    kv, _ = engine.prefill(params, prompt)
+    cache0 = engine.insert(engine.init_cache(), kv, 0, len(prompt))
+    cache0 = jax.tree.map(np.asarray, cache0)  # host copy: verify donates
+    tokens = np.asarray([[t0, draft_tok, draft_tok]], np.int32)
+    args = (np.full(1, -1, np.int32), np.full(1, 50, np.int32),
+            np.full(1, temp, np.float32), np.full(1, top_k, np.int32),
+            np.ones(1, np.float32))
+    n = 400
+    first = np.zeros(n, np.int32)
+    for i in range(n):
+        cache = jax.tree.map(jnp.asarray, cache0)
+        _, emitted, counts, _ = engine.verify(
+            params, cache, tokens, jax.random.PRNGKey(i), *args)
+        assert int(np.asarray(counts)[0]) >= 1
+        first[i] = np.asarray(emitted)[0, 0]
+    freq = np.bincount(first, minlength=cfg.model.vocab_size) / n
+    kept = np.flatnonzero(want)
+    assert set(np.flatnonzero(freq)) <= set(kept)
+    np.testing.assert_allclose(freq[kept], want[kept], atol=0.09)
+
+
+# --------------------------------------------------------------------------- #
+# rollback: the length pointer IS the rewind
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_rejected_draft_rows_invisible_to_attend(quantized):
+    """Optimistically written draft rows beyond the post-acceptance length
+    must leave ``attend`` output BIT-IDENTICAL to never having written
+    them — for bf16 and int8 (scales included) caches. This is the whole
+    rollback mechanism: rewinding is one length-pointer write."""
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 16, 4, 8
+    dt = jnp.bfloat16
+
+    def block():
+        base = {
+            "k": jnp.asarray(rng.normal(size=(B, T, H, D)), dt),
+            "v": jnp.asarray(rng.normal(size=(B, T, H, D)), dt),
+        }
+        if quantized:
+            qk, ks = kv_cache.quantize_kv(base["k"])
+            qv, vs = kv_cache.quantize_kv(base["v"])
+            base = {"k": qk, "v": qv, "k_scale": ks, "v_scale": vs}
+        return base
+
+    base = block()
+    pos = jnp.asarray([6, 3], jnp.int32)  # per-slot write offsets
+    S = 4  # 1 fed token + 3 drafts
+    k_new = jnp.asarray(rng.normal(size=(B, S, H, D)), dt)
+    v_new = jnp.asarray(rng.normal(size=(B, S, H, D)), dt)
+    # speculative write: all S rows land; suppose 0 drafts accepted, so the
+    # post-acceptance lengths advance past the fed token only
+    spec = kv_cache.cache_write(base, k_new, v_new, pos)
+    clean = kv_cache.cache_write(base, k_new[:, :1], v_new[:, :1], pos)
+    lengths = pos + 1
+
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), dt)
+    out_spec = kv_cache.attend(q, spec, lengths, 0.3)
+    out_clean = kv_cache.attend(q, clean, lengths, 0.3)
+    np.testing.assert_array_equal(np.asarray(out_spec, np.float32),
+                                  np.asarray(out_clean, np.float32))
+    # and the next decode step's write simply overwrites a stale row
+    k2 = jnp.asarray(rng.normal(size=(B, 1, H, D)), dt)
+    v2 = jnp.asarray(rng.normal(size=(B, 1, H, D)), dt)
+    again_spec = kv_cache.cache_write(spec, k2, v2, lengths)
+    again_clean = kv_cache.cache_write(clean, k2, v2, lengths)
+    out2s = kv_cache.attend(q, again_spec, lengths + 1, 0.3)
+    out2c = kv_cache.attend(q, again_clean, lengths + 1, 0.3)
+    np.testing.assert_array_equal(np.asarray(out2s, np.float32),
+                                  np.asarray(out2c, np.float32))
+
+
+def test_batched_write_drops_out_of_window_rows():
+    """A speculative write window crossing the cache edge drops the
+    out-of-range rows instead of clamping them onto earlier positions
+    (the chunked-prefill bug class, pinned for the batched write)."""
+    B, T, H, D = 2, 8, 2, 4
+    base = {"k": jnp.zeros((B, T, H, D)), "v": jnp.zeros((B, T, H, D))}
+    k_new = jnp.ones((B, 3, H, D))
+    out = kv_cache.cache_write(base, k_new, k_new,
+                               jnp.asarray([6, 2], jnp.int32))
+    got = np.asarray(out["k"][:, :, 0, 0])
+    want = np.zeros((B, T))
+    want[0, 6:8] = 1  # row at pos 8 dropped
+    want[1, 2:5] = 1
+    np.testing.assert_array_equal(got, want)
+
+
+# --------------------------------------------------------------------------- #
+# the n-gram drafter
+# --------------------------------------------------------------------------- #
+
+
+def test_ngram_drafter_cycle_continuation():
+    d = NgramDrafter(3)
+    hist = [1, 2, 3, 1, 2, 3, 1, 2]
+    # suffix [3, 1, 2] matched at position 2 -> continuation cycles 3,1,2
+    np.testing.assert_array_equal(d.propose(np.asarray(hist), 4),
+                                  [3, 1, 2, 3])
+    # proposals always have exactly n tokens
+    assert d.propose(np.asarray(hist), 7).shape == (7,)
+
+
+def test_ngram_drafter_longest_suffix_wins():
+    # 1-gram match for 9 exists at position 0 (-> 5), but the 2-gram
+    # suffix [2, 9] matches at 2 (-> 7): the longer context must win
+    d = NgramDrafter(3)
+    hist = [9, 5, 2, 9, 7, 2, 9]
+    assert d.propose(np.asarray(hist), 1)[0] == 7
+
+
+def test_ngram_drafter_fallback_repeats_last():
+    d = NgramDrafter(3)
+    np.testing.assert_array_equal(
+        d.propose(np.asarray([4, 5, 6]), 3), [6, 6, 6])
+    np.testing.assert_array_equal(d.propose(np.asarray([2]), 2), [2, 2])
+    np.testing.assert_array_equal(d.propose(np.asarray([], np.int32), 2),
+                                  [0, 0])
+
+
+# --------------------------------------------------------------------------- #
+# config / engine validation
+# --------------------------------------------------------------------------- #
+
+
+def test_spec_config_validation(tiny_model_kwargs):
+    with pytest.raises(ValueError, match="spec_len"):
+        Config.from_dict({"inference": {"spec_len": -1}})
+    with pytest.raises(ValueError, match="spec_ngram"):
+        Config.from_dict({"inference": {"spec_ngram": 0}})
+    cfg = make_config(tiny_model_kwargs, seq=MAX_LEN)
+    eng = InferenceEngine(cfg, max_seq_len=MAX_LEN)  # spec off by default
+    assert eng.spec_len == 0
+    with pytest.raises(ValueError, match="spec_len"):
+        eng.verify(None, None, np.zeros((2, 3), np.int32), None,
+                   None, None, None, None, None)
+    # config knob flows through; keyword override wins
+    cfg.inference.spec_len = 3
+    assert InferenceEngine(cfg, max_seq_len=MAX_LEN).spec_len == 3
+    assert InferenceEngine(cfg, max_seq_len=MAX_LEN,
+                           spec_len=0).spec_len == 0
