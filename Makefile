@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke chaos-smoke clean
+.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke kernel-smoke chaos-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -49,6 +49,17 @@ spec-smoke:
 	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
 	  --spec-len 4
 	JAX_PLATFORMS=cpu python bench_decode.py --spec-len 4
+
+# Flash-decode kernel parity (ops/pallas/decode_attention.py) in Pallas
+# interpret mode on CPU: flash vs dense allclose across S=1 decode,
+# speculative verify, chunked prefill; bf16/fp32 AND int8 caches; ragged
+# lengths, stale rows, GQA down to nkv=1, non-dividing KV blocks — plus
+# the engine-level wiring proof for inference.attend_impl. The serving
+# default stays dense, so decode-smoke/spec-smoke GENERATION output is
+# unchanged (their bench JSON gains the attend_impl/kv_bytes_per_token
+# fields).
+kernel-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode_kernel.py -q
 
 # Fault-injection suite on a CPU mesh (picotron_tpu/resilience/): chaos
 # SIGTERM/crash/NaN/truncation at fixed steps, kill->resume bit-for-bit

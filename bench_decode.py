@@ -48,10 +48,42 @@ from picotron_tpu.bench_record import BENCH_METRICS
 SPEC_WARMUP_ROUNDS = 4
 
 
+def kv_bytes_per_token(engine, lengths) -> int:
+    """Estimated KV HBM bytes the attend moves per cache walk: layers x
+    K+V x (attention window rows) x kv_heads x head_dim x storage bytes,
+    plus the per-row fp32 scale vectors for int8 caches. The window is what
+    distinguishes the kernels — the dense attend walks the full
+    ``max_seq_len`` cache block, the flash kernel only the live rows
+    (``lengths``, averaged over slots at the end of the timed window). The
+    dense int8 path additionally materializes whole-window dequantized
+    fp32 copies of K and V (kv_cache.attend) — that write+read traffic is
+    counted, since hiding it would make dense-int8 look CHEAPER than
+    dense-bf16, the opposite of what the flash path exists to fix. One
+    walk serves one decode token (decode/blocked modes); speculative
+    callers scale by dispatches-per-token (one walk per verify dispatch
+    emits ~1/dpt tokens)."""
+    import numpy as np
+
+    m = engine.cfg.model
+    live = float(np.mean(np.asarray(lengths)))
+    window = live if engine.attend_impl == "flash" else float(
+        engine.max_seq_len)
+    per_row = 2 * m.num_key_value_heads * m.head_dim * \
+        engine.cache_dtype.itemsize
+    if engine.quantized:
+        per_row += 2 * m.num_key_value_heads * 4  # k_scale/v_scale rows
+        if engine.attend_impl == "dense":
+            # whole-window fp32 K/V materialization: 4 bytes written then
+            # read back per element, on top of the int8 cache read
+            per_row += 2 * m.num_key_value_heads * m.head_dim * 4 * 2
+    return int(round(m.num_hidden_layers * window * per_row))
+
+
 def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
-        steps: int, warmup: int = 8, block_len: int = 1):
+        steps: int, warmup: int = 8, block_len: int = 1,
+        attend_impl: str = "dense"):
     """Time ``steps`` decode rounds (tokens per slot). Returns
-    (tokens/s, dispatches_per_token, engine)."""
+    (tokens/s, dispatches_per_token, kv_bytes/token, engine)."""
     import jax
     import numpy as np
 
@@ -59,7 +91,8 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     from picotron_tpu.models import llama
 
     engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
-                             decode_block_len=block_len)
+                             decode_block_len=block_len,
+                             attend_impl=attend_impl)
     params = engine.shard_params(jax.jit(
         lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
     cache = engine.init_cache()
@@ -120,12 +153,13 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
         last = toks
 
     assert np.all((last >= 0) & (last < cfg.model.vocab_size))
-    return slots * steps / dt, dispatches / steps, engine
+    kv_bytes = kv_bytes_per_token(engine, cache["lengths"])
+    return slots * steps / dt, dispatches / steps, kv_bytes, engine
 
 
 def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
              steps: int, warmup_rounds: int = SPEC_WARMUP_ROUNDS,
-             spec_len: int = 4):
+             spec_len: int = 4, attend_impl: str = "dense"):
     """Time ``steps`` speculative decode tokens per slot: the same
     protocol as ``run`` — prefill fills every slot OUTSIDE the timed
     window, warmup rounds absorb compilation, then the timed window runs
@@ -138,7 +172,7 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     ``run``'s normalization: with nothing accepted every round yields one
     token per slot and dpt == 1.0 (the spec-off per-token baseline);
     every accepted draft pushes it strictly below. Returns (tokens/s,
-    dispatches_per_token, accept_rate, engine)."""
+    dispatches_per_token, accept_rate, kv_bytes/token, engine)."""
     import jax
     import numpy as np
 
@@ -146,7 +180,7 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     from picotron_tpu.models import llama
 
     engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
-                             spec_len=spec_len)
+                             spec_len=spec_len, attend_impl=attend_impl)
     params = engine.shard_params(jax.jit(
         lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
     drafter = NgramDrafter(engine.spec_ngram)
@@ -203,7 +237,12 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
         dispatches += 1
     dt = time.perf_counter() - t0
     accept = stats[1] / max(stats[0], 1)
-    return slots * steps / dt, dispatches / steps, accept, engine
+    # one cache walk per verify dispatch emits ~1/dpt tokens, so per-TOKEN
+    # bytes scale by dispatches-per-token (keeps spec rows comparable to
+    # the decode modes' one-walk-per-token accounting)
+    dpt = dispatches / steps
+    kv_bytes = int(round(kv_bytes_per_token(engine, cache["lengths"]) * dpt))
+    return slots * steps / dt, dpt, accept, kv_bytes, engine
 
 
 def main(argv=None) -> None:
@@ -216,6 +255,13 @@ def main(argv=None) -> None:
                     help="speculative decoding: draft tokens per verify "
                          "dispatch on repetitive prompts (0 = off; "
                          "mutually exclusive with --block-len > 1)")
+    ap.add_argument("--attend-impl", choices=("dense", "flash"),
+                    default="dense",
+                    help="KV-cache attention kernel: the dense "
+                         "whole-window einsum (default) or the "
+                         "length-aware Pallas flash decode (interpret "
+                         "mode off TPU — a parity surface, not a CPU "
+                         "perf one)")
     args = ap.parse_args(argv)
     if args.spec_len > 0 and args.block_len != 1:
         ap.error("--spec-len replaces blocked decode; drop --block-len")
@@ -261,10 +307,13 @@ def main(argv=None) -> None:
     accept = None
     try:
         if args.spec_len > 0:
-            tok_s, dpt, accept, engine = run_spec(
-                cfg, spec_len=args.spec_len, **sizes)
+            tok_s, dpt, accept, kv_bytes, engine = run_spec(
+                cfg, spec_len=args.spec_len,
+                attend_impl=args.attend_impl, **sizes)
         else:
-            tok_s, dpt, engine = run(cfg, block_len=args.block_len, **sizes)
+            tok_s, dpt, kv_bytes, engine = run(
+                cfg, block_len=args.block_len,
+                attend_impl=args.attend_impl, **sizes)
     except Exception as e:  # noqa: BLE001 - the record IS the error channel
         print(json.dumps({
             "metric": BENCH_METRICS["bench_decode"], "value": None,
@@ -276,14 +325,17 @@ def main(argv=None) -> None:
               else "decode_tokens_per_sec_cpu_smoke")
     print(f"# slots={sizes['slots']} prompt={sizes['prompt_len']} "
           f"steps={sizes['steps']} chips={chips} block_len={args.block_len} "
-          f"spec_len={args.spec_len} "
+          f"spec_len={args.spec_len} attend_impl={args.attend_impl} "
           + (f"accept_rate={accept:.3f} " if accept is not None else "")
-          + f"dispatches/token={dpt:.3f} tokens/s={tok_s:.1f}",
+          + f"dispatches/token={dpt:.3f} kv_bytes/token={kv_bytes} "
+          f"tokens/s={tok_s:.1f}",
           file=sys.stderr)
     record = {"metric": metric, "value": round(tok_s / chips, 1),
               "unit": "tokens/s/chip", "vs_baseline": None,
               "block_len": args.block_len,
-              "dispatches_per_token": round(dpt, 4)}
+              "dispatches_per_token": round(dpt, 4),
+              "attend_impl": args.attend_impl,
+              "kv_bytes_per_token": kv_bytes}
     if args.spec_len > 0:
         record["spec_len"] = args.spec_len
         record["accept_rate"] = round(accept, 4)

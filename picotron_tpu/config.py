@@ -282,6 +282,17 @@ class InferenceConfig:
     # flat peak activation memory. Prompts at or under it keep the
     # pow-2-bucketed one-shot prefill.
     prefill_chunk: int = 512
+    # Which kernel serves KV-cache attention on the decode/verify/chunked-
+    # prefill hot path: "dense" = the masked einsum+softmax over the whole
+    # cache window (kv_cache.decode_attention — the bit-pinned reference,
+    # always the default); "flash" = the Pallas flash-decode kernel
+    # (ops/pallas/decode_attention.py) — online softmax over KV blocks
+    # bounded by each slot's LIVE length, int8 K/V dequantized inside the
+    # kernel (no whole-cache fp32 materialization), GQA-native. On CPU the
+    # flash kernel runs in Pallas interpret mode (slow — a parity/test
+    # surface, not a serving one); allclose-pinned against dense in
+    # tests/test_decode_kernel.py.
+    attend_impl: str = "dense"
     # Speculative decoding (inference/speculative.py, engine.verify): number
     # of tokens the drafter proposes per slot per dispatch. One jitted
     # verify pass scores all spec_len+1 positions, accepts the matching
@@ -414,8 +425,9 @@ class Config:
                     "gating (the checker's auto-inserted pvary transposes "
                     "put real psums inside single-stage branches, which "
                     "deadlocks); set stage_gating='where' — or, on a CPU "
-                    "box, set use_cpu=true, which resolves the 'auto' "
-                    "gating to where-masking")
+                    "box, set use_cpu: true in the distributed config "
+                    "section, which resolves the 'auto' gating to "
+                    "where-masking")
         if d.stage_gating == "cond" and d.use_cpu and d.tp_size > 1:
             # the gated branches carry tp collectives, and the XLA CPU
             # runtime's rendezvous intermittently aborts when a collective
@@ -553,6 +565,10 @@ class Config:
             raise ValueError(
                 f"unknown inference.kv_cache_dtype {inf.kv_cache_dtype!r} "
                 "(auto|int8)")
+        if inf.attend_impl not in ("dense", "flash"):
+            raise ValueError(
+                f"unknown inference.attend_impl {inf.attend_impl!r} "
+                "(dense|flash)")
         if inf.spec_len < 0:
             raise ValueError("inference.spec_len must be >= 0 (0 = off)")
         if inf.spec_ngram < 1:

@@ -91,8 +91,11 @@ class InferenceEngine:
     retires requests into these fixed positions so the compiled decode
     program never changes shape. ``max_seq_len`` bounds prompt + generated
     tokens per slot (default: the model's max_position_embeddings).
-    ``decode_block_len`` / ``kv_cache_dtype`` / ``prefill_chunk`` default
-    from ``cfg.inference`` (config.InferenceConfig); keyword overrides win.
+    ``decode_block_len`` / ``kv_cache_dtype`` / ``prefill_chunk`` /
+    ``attend_impl`` default from ``cfg.inference`` (config.InferenceConfig);
+    keyword overrides win. ``attend_impl="flash"`` routes every cache
+    attend (decode, verify, chunked prefill) through the length-aware
+    Pallas flash-decode kernel instead of the dense whole-window einsum.
     """
 
     def __init__(self, cfg: Config, topo: Optional[Topology] = None, *,
@@ -101,7 +104,8 @@ class InferenceEngine:
                  decode_block_len: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  spec_len: Optional[int] = None,
-                 spec_ngram: Optional[int] = None):
+                 spec_ngram: Optional[int] = None,
+                 attend_impl: Optional[str] = None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
         inf = self.cfg.inference
@@ -136,6 +140,18 @@ class InferenceEngine:
                               else inf.spec_ngram)
         if self.spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
+        # KV-cache attention kernel for decode/verify/chunked prefill:
+        # "dense" (whole-window reference) or "flash" (length-aware Pallas
+        # flash decode). A Python-level choice, so every jitted program
+        # below traces the selected kernel statically — no runtime branch,
+        # one executable per impl. The override lands in self.cfg BEFORE
+        # the jit wrappers close over it.
+        if attend_impl is not None:
+            if attend_impl not in ("dense", "flash"):
+                raise ValueError(
+                    f"unknown attend_impl {attend_impl!r} (dense|flash)")
+            inf.attend_impl = attend_impl
+        self.attend_impl = inf.attend_impl
         # a chunk wider than the cache window could never be written
         # (mirrors prefill_bucket's min(bucket, max_seq_len) cap)
         self.prefill_chunk = min(self.prefill_chunk, self.max_seq_len)

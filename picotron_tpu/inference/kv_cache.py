@@ -184,10 +184,35 @@ def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
 
 
 def attend(q: jnp.ndarray, layer_cache: dict, lengths: jnp.ndarray,
-           scale: float) -> jnp.ndarray:
-    """Masked attention of S fresh queries against one layer's cache block,
-    dequantizing int8 storage on the fly (fp32, matching the fp32 softmax
-    statistics the kernel already computes)."""
+           scale: float, impl: str = "dense") -> jnp.ndarray:
+    """Masked attention of S fresh queries against one layer's cache block.
+
+    ``impl`` picks the kernel (config ``inference.attend_impl``):
+
+    - "dense" (default): ``decode_attention`` over the whole cache window,
+      int8 storage first dequantized to a whole-block fp32 copy (the
+      bit-pinned reference path);
+    - "flash": the Pallas flash-decode kernel
+      (ops/pallas/decode_attention.py) — KV blocks are read only up to
+      each slot's live length, int8 bytes + per-row scales travel to the
+      kernel as stored and dequantize in registers: no whole-cache fp32
+      materialization ever exists on this path. Runs in interpret mode off
+      TPU; allclose-pinned against dense (tests/test_decode_kernel.py).
+    """
+    if impl == "flash":
+        from picotron_tpu.ops.pallas.decode_attention import (
+            flash_decode_attention,
+        )
+        from picotron_tpu.utils import on_tpu
+
+        return flash_decode_attention(
+            q, layer_cache["k"], layer_cache["v"], lengths, scale,
+            k_scale=layer_cache.get("k_scale"),
+            v_scale=layer_cache.get("v_scale"),
+            interpret=not on_tpu())
+    if impl != "dense":
+        # a typo'd impl must not silently measure the wrong kernel
+        raise ValueError(f"unknown attend impl {impl!r} (dense|flash)")
     if quantized(layer_cache):
         k = dequantize_kv(layer_cache["k"], layer_cache["k_scale"],
                           jnp.float32)

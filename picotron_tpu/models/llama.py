@@ -270,9 +270,13 @@ def _attention(q, k, v, cfg: Config, cache=None, pos=None):
 
         # S queries starting at per-sequence write index ``pos``: the valid
         # key count is pos + S (S == 1 decode, S > 1 chunked prefill or
-        # speculative verify); ``attend`` dequantizes int8 cache blocks on
-        # the fly
-        return attend(q, cache, pos + q.shape[1], scale)
+        # speculative verify). ``inference.attend_impl`` picks the kernel —
+        # the dense whole-window reference or the length-aware Pallas flash
+        # decode (which reads int8 blocks as stored; the dense path
+        # dequantizes whole blocks on the fly). The impl string is a Python
+        # value, so each choice traces its own program under jit.
+        return attend(q, cache, pos + q.shape[1], scale,
+                      impl=cfg.inference.attend_impl)
     impl = cfg.model.attention_impl
     if impl == "auto":
         impl = "flash" if on_tpu() else "sdpa"

@@ -1,0 +1,245 @@
+"""Flash decode: fused KV-cache attention as a Pallas TPU kernel.
+
+The serving counterpart of ``flash_attention.py``. The dense decode path
+(``inference/kv_cache.py::decode_attention``) attends every fresh query
+against the **whole** ``[B, max_seq_len, Hkv, D]`` cache block — an einsum
+whose HBM traffic is O(max_seq_len) per decoded token no matter how short
+the live sequences are, and whose int8 mode first materializes a
+dequantized fp32 copy of the entire block (4x the bytes the cache stores).
+This kernel removes both costs:
+
+- **Length-aware**: the grid is ``(slots, kv_heads)`` and each instance
+  walks KV blocks with a ``fori_loop`` bounded by
+  ``ceil(lengths[b] / block_t)`` — its OWN slot's live token count, an
+  even tighter bound than ``max(lengths)`` — so HBM reads track parked
+  tokens, not the cache window. Keys inside the last partial block are
+  masked per query row against the slot's ``lengths`` (the stale rows a
+  speculative rollback or a freed slot leaves beyond the length pointer
+  are never visible). Nothing beyond ``ceil(lengths[b]/block_t)*block_t``
+  rows is ever DMA'd.
+- **int8 dequant in registers**: K/V stay int8 on the wire — each block is
+  DMA'd from HBM in its storage dtype together with its per-row fp32
+  scales (``[block_t]`` vectors) and dequantized in VMEM right before the
+  matmul, so the quantized cache's ~2x byte saving reaches the attend
+  itself, not just storage.
+- **GQA native**: queries fold to ``[B, Hkv, S*g, D]`` (``g = Hq/Hkv``
+  grouped rows per compact kv head — the same trick the training flash
+  kernel's folded layout uses) and each grid instance serves one kv head's
+  whole query group; the cache stays compact, nothing is repeated.
+- **S >= 1 queries per slot**: query row ``r`` sits at global position
+  ``pos_q = lengths[b] - S + r // g`` (key ``t`` visible iff
+  ``t <= pos_q``) — the exact masking convention of the dense kernel — so
+  ONE kernel serves all three call sites: blocked decode (S = 1),
+  speculative verify (S = spec_len + 1, B = slots), and chunked prefill
+  (B = 1, S = chunk width).
+
+Softmax is the standard online (flash) recurrence in fp32: running max
+``m``, normalizer ``l``, and accumulator ``acc`` per query row, masked
+probabilities zeroed exactly so a fully-masked row (``lengths == 0`` — a
+fresh slot attended directly) comes out as **zeros**, a defined value,
+where the dense kernel emits an (equally unconsumed) uniform average.
+Every other row is allclose to the dense path for bf16/fp32 AND int8
+caches (tests/test_decode_kernel.py pins all three call shapes in
+interpret mode).
+
+Hardware notes: K/V (+ scales) are handed to the kernel in ``pl.ANY``
+memory space (they stay in HBM) and each block is pulled with
+``pltpu.make_async_copy`` into VMEM scratch; query rows pad to a multiple
+of 8 sublanes. Blocks are fetched serially (no double buffering yet —
+decode is a bandwidth-bound dot per block, and the DMA engine overlaps
+across grid instances); on CPU the kernel runs in Pallas interpret mode
+(``interpret=True``), which is how the parity suite and the tier-1 gate
+exercise it. Dense remains the serving default (``inference.attend_impl``)
+until the kernel is A/B'd on a chip, the same staging discipline the
+``bshd`` flash layout went through.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from picotron_tpu.ops.attention import NEG_INF
+from picotron_tpu.ops.pallas.flash_attention import _pick_block
+
+# KV rows fetched per DMA; halved automatically until the block divides the
+# cache window AND the [S*g, block_t] fp32 score tile stays under
+# _MAX_SCORE_TILE elements (see _pick_block_t).
+DEFAULT_BLOCK_T = 256
+# score-tile budget: 256K fp32 elements = 1 MB, the same tile scale the
+# training flash kernel's 512x512 default occupies — decode shapes
+# (S*g <= 8 rows) keep the full DEFAULT_BLOCK_T, wide chunked-prefill query
+# groups (S*g in the thousands) trade KV-block depth for row count so VMEM
+# never blows up with the chunk width
+_MAX_SCORE_TILE = 256 * 1024
+_SUBLANE = 8  # fp32 sublane quantum the padded query-row count respects
+
+
+def _pick_block_t(seq: int, want: int, rows: int = _SUBLANE) -> int:
+    """KV block size: at or under ``want``, shrunk (a) so the
+    ``[rows, block]`` fp32 score tile fits the VMEM budget and (b) by
+    halving until it divides ``seq`` (flash_attention._pick_block — the
+    DMA slice size must be static, so the block must tile the cache window
+    exactly; this is what keeps windows that are NOT a multiple of the
+    preferred block correct instead of reading past the buffer)."""
+    while want > _SUBLANE and rows * want > _MAX_SCORE_TILE:
+        want //= 2
+    return _pick_block(seq, want)
+
+
+def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized):
+    """One (slot, kv head) grid instance: all S*g query rows of slot ``b``
+    under kv head ``h`` against the slot's live KV blocks."""
+    if quantized:
+        (len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         kbuf, vbuf, ksbuf, vsbuf, sems) = refs
+    else:
+        (len_ref, q_ref, k_ref, v_ref, o_ref, kbuf, vbuf, sems) = refs
+        ks_ref = vs_ref = ksbuf = vsbuf = None
+    # program ids are read ONCE here: the 0.4.37 interpreter cannot resolve
+    # pl.program_id inside the fori_loop body's sub-jaxpr
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    L = len_ref[0]  # this slot's live token count
+    q = q_ref[0, 0].astype(jnp.float32)  # [Sgp, D]
+    sgp = q.shape[0]
+    # query row r = s*g + g_idx sits at global position L - S + s
+    pos_q = (L - S
+             + lax.broadcasted_iota(jnp.int32, (sgp, block_t), 0) // g)
+    kiota = lax.broadcasted_iota(jnp.int32, (sgp, block_t), 1)
+
+    def body(j, carry):
+        acc, m, l = carry
+        rows = pl.ds(j * block_t, block_t)
+        kdma = pltpu.make_async_copy(k_ref.at[b, rows, h, :], kbuf,
+                                     sems.at[0])
+        vdma = pltpu.make_async_copy(v_ref.at[b, rows, h, :], vbuf,
+                                     sems.at[1])
+        kdma.start()
+        vdma.start()
+        if quantized:
+            ksdma = pltpu.make_async_copy(ks_ref.at[b, rows, h], ksbuf,
+                                          sems.at[2])
+            vsdma = pltpu.make_async_copy(vs_ref.at[b, rows, h], vsbuf,
+                                          sems.at[3])
+            ksdma.start()
+            vsdma.start()
+        kdma.wait()
+        kb = kbuf[...].astype(jnp.float32)  # [bt, D]
+        if quantized:
+            ksdma.wait()
+            kb = kb * ksbuf[...][:, None]  # dequant in registers
+        s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        mask = (j * block_t + kiota) <= pos_q
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        # zero masked probabilities EXACTLY (not just exp(-inf)): a row
+        # whose every key so far is masked keeps l == 0 and lands on the
+        # defined all-zeros output below instead of a uniform average
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        vdma.wait()
+        vb = vbuf[...].astype(jnp.float32)
+        if quantized:
+            vsdma.wait()
+            vb = vb * vsbuf[...][:, None]
+        acc = acc * alpha + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    d = q.shape[1]
+    acc0 = jnp.zeros((sgp, d), jnp.float32)
+    m0 = jnp.full((sgp, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((sgp, 1), jnp.float32)
+    # the whole point: the block walk is bounded by THIS slot's live
+    # length, never by max_seq_len — a fresh slot (L == 0) runs no
+    # iterations and costs no HBM reads at all. Clamped to the window's
+    # block count: at the window edge the engine's write-then-attend
+    # convention can pass lengths = pos + S > T (the scatter dropped the
+    # out-of-bounds rows), and the walk must not DMA past the cache
+    # (the dense kernel's mask absorbs the same case for free).
+    nb = jnp.minimum(lax.div(L + block_t - 1, block_t),
+                     k_ref.shape[1] // block_t)
+    acc, _, l = lax.fori_loop(0, nb, body, (acc0, m0, l0))
+    out = acc / jnp.where(l > 0, l, 1.0)
+    o_ref[0, 0] = jnp.where(l > 0, out, 0.0).astype(o_ref.dtype)
+
+
+def flash_decode_attention(q, k, v, lengths, scale, *,
+                           k_scale=None, v_scale=None,
+                           block_t: int | None = None,
+                           interpret: bool = False):
+    """Fused masked attention of S fresh queries per slot against a KV
+    cache block, reading only live rows.
+
+    q: [B, S, n_heads, D] — the new tokens, the LAST of which sits at
+    global position ``lengths[b] - 1``; k/v: [B, T, n_kv_heads, D] cache
+    blocks, int8 when ``k_scale``/``v_scale`` ([B, T, n_kv_heads] fp32
+    per-row scales) are given; lengths: [B] int32 valid-key counts.
+    Returns [B, S, n_heads, D] in q.dtype — allclose to
+    ``kv_cache.decode_attention`` on every query row with at least one
+    visible key (``pos_q = lengths[b] - S + s >= 0``; inside the engine
+    that is every row of every occupied slot). Fully-masked rows —
+    ``lengths == 0``, or the leading rows of a direct call with
+    ``lengths < S`` — return ZEROS, where the dense kernel emits an
+    equally-unconsumed uniform average over the whole window.
+    ``interpret=True`` runs the Pallas interpreter (the CPU path)."""
+    B, S, nh, D = q.shape
+    T, nkv = k.shape[1], k.shape[2]
+    if nh % nkv:
+        raise ValueError(f"n_heads {nh} not a multiple of n_kv_heads {nkv}")
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if (k.dtype == jnp.int8) != quantized:
+        raise ValueError(
+            f"int8 cache blocks need per-row scales (and vice versa); got "
+            f"k.dtype={k.dtype} with scales={'set' if quantized else 'unset'}")
+    g = nh // nkv
+    sg = S * g
+    sgp = -(-sg // _SUBLANE) * _SUBLANE  # pad query rows to the sublane tile
+    bt = _pick_block_t(T, block_t or DEFAULT_BLOCK_T, rows=sgp)
+    # fold [B, S, nkv, g, D] -> [B, nkv, S*g, D]: one kv head's whole query
+    # group per grid instance (tiny copy — S is 1..chunk, never the cache)
+    qf = q.reshape(B, S, nkv, g, D).swapaxes(1, 2).reshape(B, nkv, sg, D)
+    if sgp != sg:
+        qf = jnp.pad(qf, ((0, 0), (0, 0), (0, sgp - sg), (0, 0)))
+
+    kernel = functools.partial(
+        _flash_decode_kernel, scale=float(scale), block_t=bt, S=S, g=g,
+        quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1,), lambda b, h: (b,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, sgp, D), lambda b, h: (b, h, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),  # K stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),  # V stays in HBM
+    ]
+    operands = [lengths.astype(jnp.int32), qf, k, v]
+    scratch = [pltpu.VMEM((bt, D), k.dtype), pltpu.VMEM((bt, D), v.dtype)]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        operands += [k_scale, v_scale]
+        scratch += [pltpu.VMEM((bt,), jnp.float32),
+                    pltpu.VMEM((bt,), jnp.float32)]
+    scratch.append(pltpu.SemaphoreType.DMA((4,)))
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, nkv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, sgp, D), lambda b, h: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, nkv, sgp, D), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*operands)
+    return (out[:, :, :sg]
+            .reshape(B, nkv, S, g, D).swapaxes(1, 2)
+            .reshape(B, S, nh, D))

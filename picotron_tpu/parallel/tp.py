@@ -113,12 +113,15 @@ def all_gather_dim_invariant(x, axis: str, dim: int):
             # here means a jax upgrade moved/removed the private symbol.
             from jax._src.lax.parallel import all_gather_invariant
         except ImportError as e:
+            import jax
+
             raise ImportError(
                 "check_vma=True needs jax._src.lax.parallel."
                 "all_gather_invariant (present in jax >= 0.6 releases with "
-                "jax.shard_map's vma checker); this jax build does not "
-                "provide it — upgrade/downgrade jax or run with "
-                "distributed.check_vma=false") from e
+                f"jax.shard_map's vma checker); this jax build "
+                f"({jax.__version__}) does not provide it — upgrade/"
+                "downgrade jax or run with distributed.check_vma=false"
+            ) from e
 
         _trace("all_gather", axis, x, extra=f"dim={dim} invariant")
         return all_gather_invariant(x, axis, axis=dim, tiled=True)

@@ -1,0 +1,314 @@
+"""Flash-decode kernel parity: Pallas fused KV-cache attention vs dense.
+
+The flash path (``ops/pallas/decode_attention.py``, selected by
+``inference.attend_impl: "flash"``) must be allclose to the dense
+whole-window reference (``kv_cache.decode_attention``) everywhere the
+engine can reach it — S = 1 blocked decode, S > 1 speculative verify,
+B = 1 chunked prefill — for bf16/fp32 AND int8 caches, across ragged
+lengths, stale rows beyond the length mask, GQA head groupings down to
+nkv = 1, and cache windows that are not a multiple of the KV block. The
+kernel runs in Pallas interpret mode here (the CPU tier-1 gate;
+``make kernel-smoke`` runs just this file); the same program lowers to
+Mosaic on a chip.
+
+Unit tests drive the kernel directly; the engine tests run the full jitted
+dispatch (shard_map + layer scan) under both impls and pin identical
+generations — the wiring proof that ``attend_impl`` reaches all three call
+sites.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_config
+from picotron_tpu.inference import InferenceEngine, kv_cache
+from picotron_tpu.inference.kv_cache import (
+    decode_attention,
+    dequantize_kv,
+    quantize_kv,
+)
+from picotron_tpu.models import llama
+from picotron_tpu.ops.pallas.decode_attention import (
+    _pick_block_t,
+    flash_decode_attention,
+)
+
+MAX_LEN = 96
+
+
+# --------------------------------------------------------------------------- #
+# kernel-level parity (direct calls, interpret mode)
+# --------------------------------------------------------------------------- #
+
+
+def _blocks(rng, B, T, nh, nkv, D, S, dtype, quantized):
+    """Random q + cache blocks (+ scales when quantized) and the dense
+    reference inputs (the dequantized fp32 view for int8)."""
+    q = jnp.asarray(rng.normal(size=(B, S, nh, D)).astype(np.float32))
+    k = rng.normal(size=(B, T, nkv, D)).astype(np.float32)
+    v = rng.normal(size=(B, T, nkv, D)).astype(np.float32)
+    if quantized:
+        qk, ks = quantize_kv(jnp.asarray(k))
+        qv, vs = quantize_kv(jnp.asarray(v))
+        dense_k = dequantize_kv(qk, ks, jnp.float32)
+        dense_v = dequantize_kv(qv, vs, jnp.float32)
+        return q, (qk, qv, ks, vs), (dense_k, dense_v)
+    dt = jnp.dtype(dtype)
+    kj, vj = jnp.asarray(k, dt), jnp.asarray(v, dt)
+    return q.astype(dt), (kj, vj, None, None), (kj, vj)
+
+
+def _assert_parity(q, stored, dense_kv, lengths, block_t, tol):
+    k, v, ks, vs = stored
+    scale = q.shape[-1] ** -0.5
+    want = np.asarray(
+        decode_attention(q, dense_kv[0], dense_kv[1], lengths, scale),
+        np.float32)
+    got = np.asarray(
+        flash_decode_attention(q, k, v, lengths, scale, k_scale=ks,
+                               v_scale=vs, block_t=block_t, interpret=True),
+        np.float32)
+    live = np.asarray(lengths) > 0
+    np.testing.assert_allclose(got[live], want[live], rtol=tol, atol=tol)
+    # fully-masked rows are DEFINED as zeros on the flash path (the dense
+    # kernel emits an equally-unconsumed uniform average there)
+    assert np.all(got[~live] == 0.0)
+    return got
+
+
+@pytest.mark.parametrize("cache_dtype,tol", [
+    ("float32", 1e-5), ("bfloat16", 2e-2), ("int8", 1e-5)])
+@pytest.mark.parametrize("S", [1, 4])
+def test_flash_matches_dense_decode_and_verify(cache_dtype, S, tol):
+    """S=1 decode and S=4 (spec_len+1) verify shapes: ragged lengths
+    including a fresh slot (0), an S-length slot, and a full window, on
+    the GQA 8q/4kv grouping, for all three cache dtypes."""
+    rng = np.random.default_rng(0)
+    B, T, nh, nkv, D = 4, 64, 8, 4, 16
+    q, stored, dense_kv = _blocks(rng, B, T, nh, nkv, D, S,
+                                  cache_dtype, cache_dtype == "int8")
+    if cache_dtype == "bfloat16":
+        q = q.astype(jnp.bfloat16)
+    lengths = jnp.asarray([0, S, 29, T], jnp.int32)
+    _assert_parity(q, stored, dense_kv, lengths, 16, tol)
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_flash_matches_dense_chunked_prefill(quantized):
+    """The B=1, S=chunk call shape: queries attend over the cache prefix
+    plus their own freshly-written block (lengths = start + chunk)."""
+    rng = np.random.default_rng(1)
+    B, T, nh, nkv, D, S = 1, MAX_LEN, 8, 4, 16, 16
+    q, stored, dense_kv = _blocks(rng, B, T, nh, nkv, D, S,
+                                  "float32", quantized)
+    for length in (S, 40, MAX_LEN):  # first chunk, mid-prompt, full window
+        _assert_parity(q, stored, dense_kv,
+                       jnp.asarray([length], jnp.int32), 32, 1e-5)
+
+
+def test_gqa_single_kv_head():
+    """nkv=1 (every q head in one group) — the widest grouping the fold
+    must handle."""
+    rng = np.random.default_rng(2)
+    q, stored, dense_kv = _blocks(rng, 2, 32, 4, 1, 8, 1, "float32", True)
+    _assert_parity(q, stored, dense_kv, jnp.asarray([5, 32], jnp.int32),
+                   8, 1e-5)
+
+
+def test_window_not_multiple_of_block():
+    """T=40 with a requested block of 16 halves to 8 (the static DMA slice
+    must tile the window); ragged lengths hit the partial-live block."""
+    assert _pick_block_t(40, 16) == 8
+    # wide chunked-prefill query groups trade KV-block depth for rows so
+    # the fp32 score tile stays inside the VMEM budget
+    assert _pick_block_t(4096, 256, rows=4096) == 64
+    rng = np.random.default_rng(3)
+    q, stored, dense_kv = _blocks(rng, 3, 40, 8, 4, 16, 1, "float32", False)
+    _assert_parity(q, stored, dense_kv, jnp.asarray([1, 23, 40], jnp.int32),
+                   16, 1e-5)
+
+
+def test_lengths_past_window_clamped():
+    """At the cache-window edge the engine's write-then-attend convention
+    can pass lengths = pos + S > T (the scatter dropped the OOB rows); the
+    block walk must clamp to the window instead of DMA'ing past it, and
+    still match dense (whose mask absorbs the same case)."""
+    rng = np.random.default_rng(6)
+    q, stored, dense_kv = _blocks(rng, 2, 32, 8, 4, 16, 2, "float32", False)
+    _assert_parity(q, stored, dense_kv, jnp.asarray([33, 34], jnp.int32),
+                   8, 1e-5)
+
+
+def test_stale_rows_beyond_mask_invisible():
+    """Rows past ``lengths`` (a speculative rollback's rejected drafts, a
+    freed slot's leftovers) are poisoned with huge values; the flash output
+    must not move — the mask, not luck, keeps them out."""
+    rng = np.random.default_rng(4)
+    B, T, nh, nkv, D = 2, 48, 8, 4, 16
+    q, (k, v, _, _), _ = _blocks(rng, B, T, nh, nkv, D, 1, "float32", False)
+    lengths = jnp.asarray([7, 31], jnp.int32)
+    scale = D ** -0.5
+    clean = flash_decode_attention(q, k, v, lengths, scale, block_t=16,
+                                   interpret=True)
+    rows = np.arange(T)[None, :, None, None] >= np.asarray(lengths)[
+        :, None, None, None]
+    poison = jnp.where(rows, 1e4, 0.0).astype(k.dtype)
+    dirty = flash_decode_attention(q, k + poison, v + poison, lengths,
+                                   scale, block_t=16, interpret=True)
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(dirty))
+
+
+def test_flash_path_never_materializes_dequantized_cache(monkeypatch):
+    """The int8 flash attend must read int8 bytes + scales inside the
+    kernel — if it ever routed through ``dequantize_kv`` (the dense path's
+    whole-block fp32 materialization) this raises."""
+    rng = np.random.default_rng(5)
+    q, (k, v, ks, vs), (dk, dv) = _blocks(rng, 2, 32, 8, 4, 16, 1,
+                                          "float32", True)
+    cache = {"k": k, "v": v, "k_scale": ks, "v_scale": vs}
+    lengths = jnp.asarray([9, 20], jnp.int32)
+    want = np.asarray(kv_cache.attend(q, cache, lengths, 0.25, impl="dense"))
+
+    def boom(*a, **kw):
+        raise AssertionError("flash attend materialized a dequantized copy")
+
+    monkeypatch.setattr(kv_cache, "dequantize_kv", boom)
+    got = np.asarray(kv_cache.attend(q, cache, lengths, 0.25, impl="flash"))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# engine-level wiring: attend_impl reaches all three jitted call sites
+# --------------------------------------------------------------------------- #
+
+
+def _engine(tiny_model_kwargs, impl, **kw):
+    cfg = make_config(tiny_model_kwargs, tp=1, seq=MAX_LEN)
+    return cfg, InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN,
+                                attend_impl=impl, **kw)
+
+
+def _params(cfg, engine):
+    p = jax.jit(lambda k: llama.init_params(k, cfg.model))(
+        jax.random.PRNGKey(0))
+    return engine.shard_params(p)
+
+
+@pytest.mark.parametrize("cache_dtype", [None, "int8"])
+def test_engine_flash_decode_block_matches_dense(tiny_model_kwargs,
+                                                 cache_dtype):
+    """The blocked decode dispatch (S=1 site) generates the same greedy
+    tokens under both impls, fp32 and int8 caches."""
+    outs = {}
+    for impl in ("dense", "flash"):
+        cfg, eng = _engine(tiny_model_kwargs, impl, decode_block_len=4,
+                           cache_dtype=cache_dtype)
+        params = _params(cfg, eng)
+        cache = eng.init_cache()
+        kv, logits = eng.prefill(params, list(range(1, 9)))
+        cache = eng.insert(cache, kv, 0, 8)
+        toks = np.array([int(np.argmax(np.asarray(logits)[0])), 0], np.int32)
+        keys = jnp.stack([jax.random.PRNGKey(7)] * 4)
+        cache, blk, counts = eng.decode_block(
+            params, cache, toks, keys, np.full(2, -1, np.int32),
+            np.array([8, 0], np.int32), np.zeros(2, np.float32),
+            np.zeros(2, np.int32), np.ones(2, np.float32))
+        outs[impl] = (np.asarray(blk), np.asarray(counts),
+                      np.asarray(cache["lengths"]))
+    for a, b in zip(outs["dense"], outs["flash"]):
+        np.testing.assert_array_equal(a, b)
+    assert outs["flash"][1].tolist() == [4, 0]  # free slot stayed inert
+
+
+def test_engine_flash_verify_matches_dense(tiny_model_kwargs):
+    """The speculative verify dispatch (S>1, B>1 site): same emitted
+    tokens, counts, accepted-draft counts, and length pointers."""
+    outs = {}
+    for impl in ("dense", "flash"):
+        cfg, eng = _engine(tiny_model_kwargs, impl, spec_len=3)
+        params = _params(cfg, eng)
+        cache = eng.init_cache()
+        for slot in (0, 1):
+            kv, logits = eng.prefill(params, list(range(1 + slot, 9 + slot)))
+            cache = eng.insert(cache, kv, slot, 8)
+        tokens = np.array([[3, 5, 7, 9], [4, 6, 8, 10]], np.int32)
+        cache, emitted, counts, accepted = eng.verify(
+            params, cache, tokens, jax.random.PRNGKey(3),
+            np.full(2, -1, np.int32), np.full(2, 8, np.int32),
+            np.zeros(2, np.float32), np.zeros(2, np.int32),
+            np.ones(2, np.float32))
+        outs[impl] = tuple(np.asarray(x) for x in
+                           (emitted, counts, accepted, cache["lengths"]))
+    for a, b in zip(outs["dense"], outs["flash"]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_flash_chunked_prefill_matches_dense(tiny_model_kwargs):
+    """The chunked-prefill dispatch (B=1, S=chunk site): final-chunk logits
+    agree across impls AND with the one-shot prefill oracle (ragged final
+    chunk included: 20 tokens over width-8 chunks)."""
+    prompt = [(5 * i + 2) % 199 + 1 for i in range(20)]
+    logits = {}
+    for impl in ("dense", "flash"):
+        cfg, eng = _engine(tiny_model_kwargs, impl, prefill_chunk=8)
+        params = _params(cfg, eng)
+        cache, last = eng.prefill_chunked(params, eng.init_cache(),
+                                          prompt, slot=1)
+        assert int(np.asarray(cache["lengths"])[1]) == len(prompt)
+        logits[impl] = np.asarray(last)[0]
+        if impl == "dense":
+            _, oneshot = eng.prefill(params, prompt)
+    np.testing.assert_allclose(logits["flash"], logits["dense"],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(logits["dense"], np.asarray(oneshot)[0],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_engine_flash_matches_dense_tp2(tiny_model_kwargs):
+    """On a tp=2 dryrun mesh the cache's kv-head axis is sharded, so each
+    shard's kernel instance sees the LOCAL head count — greedy decode must
+    still match dense exactly."""
+    tokens = {}
+    for impl in ("dense", "flash"):
+        cfg = make_config(dict(tiny_model_kwargs, num_hidden_layers=2),
+                          tp=2, seq=MAX_LEN)
+        eng = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN,
+                              attend_impl=impl)
+        params = _params(cfg, eng)
+        cache = eng.init_cache()
+        kv, logits = eng.prefill(params, list(range(1, 9)))
+        cache = eng.insert(cache, kv, 0, 8)
+        toks = np.array([int(np.argmax(np.asarray(logits)[0])), 0],
+                        np.int32)
+        got, key = [], jax.random.PRNGKey(1)
+        for _ in range(4):
+            key, sub = jax.random.split(key)
+            cache, toks, _ = eng.decode_step(
+                params, cache, toks, sub, np.zeros(2, np.float32),
+                np.zeros(2, np.int32), np.ones(2, np.float32))
+            toks = np.asarray(toks)
+            got.append(int(toks[0]))
+        tokens[impl] = got
+    assert tokens["dense"] == tokens["flash"]
+
+
+def test_attend_impl_validated(tiny_model_kwargs):
+    """Bad impl strings fail loudly at engine build and config load."""
+    cfg = make_config(tiny_model_kwargs, tp=1, seq=MAX_LEN)
+    with pytest.raises(ValueError, match="attend_impl"):
+        InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN,
+                        attend_impl="paged")
+    raw = cfg.to_dict()
+    raw["inference"]["attend_impl"] = "paged"
+    from picotron_tpu.config import Config
+
+    with pytest.raises(ValueError, match="attend_impl"):
+        Config.from_dict(raw)
+    # the attend helper itself must not silently fall through to dense
+    q = jnp.zeros((1, 1, 2, 4))
+    cache = {"k": jnp.zeros((1, 8, 2, 4)), "v": jnp.zeros((1, 8, 2, 4))}
+    with pytest.raises(ValueError, match="attend impl"):
+        kv_cache.attend(q, cache, jnp.ones(1, jnp.int32), 0.5, impl="Flash")
