@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke kernel-smoke chaos-smoke clean
+.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke kernel-smoke chaos-smoke serve-smoke serve-chaos-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -66,6 +66,23 @@ kernel-smoke:
 # equivalence, corrupt-checkpoint fallback, supervisor restart bounds.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+
+# HTTP serving front end smoke (tools/serve.py, docs/SERVING.md): start
+# the server on an ephemeral port with the tiny CPU model, check
+# /healthz //readyz, POST one request, stream a second, then SIGTERM —
+# the in-flight request finishes, the drain is clean, and every counter
+# accounts. Exits nonzero on any malfunction.
+serve-smoke:
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.serve --smoke
+
+# Serving chaos suite (tests/test_serving.py): dispatch-exception,
+# latency-spike, and poisoned-logits faults through the engine hooks —
+# no hangs, every submitted request terminates with an accounted
+# finish_reason (eos|length|timeout|shed|error), unaffected requests are
+# bit-identical to a chaos-off run; plus slot-failure isolation, the
+# flash->dense degradation ladder, admission control, and drain.
+serve-chaos-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 
 clean:
 	rm -rf picotron_tpu/native/_build

@@ -127,11 +127,25 @@ class CheckpointManager:
 
     def __init__(self, save_dir: str, max_to_keep: int = 3,
                  async_save: bool = True, io_attempts: int = 3,
-                 io_backoff: float = 0.5, io_jitter: float = 0.25):
+                 io_backoff: float = 0.5, io_jitter: float = 0.25,
+                 mirror_dir: str = ""):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.path.abspath(save_dir)
+        # Checkpoint replication (resilience.ckpt_mirror_dir): after a save
+        # commits, its step directory is copied here (retried, atomic
+        # rename), and restores fall back to the mirror when every primary
+        # step is unreadable — a second storage tier, so one sick mount
+        # cannot strand the run. "" = off. Replication runs on a background
+        # thread (it must first wait out the async primary write, and the
+        # copy itself can be GBs over a network mount — neither belongs on
+        # the training hot path); readers join it first. The mirror keeps
+        # the same max_to_keep window as the primary.
+        self.mirror_dir = os.path.abspath(mirror_dir) if mirror_dir else ""
+        self._mirror_mgr = None
+        self._mirror_thread = None
+        self._max_to_keep = max_to_keep
         # retrying I/O (resilience): transient NFS/GCS flakes on save/restore
         # are retried with exponential backoff before surfacing
         self._retry = partial(retry, attempts=io_attempts, backoff=io_backoff,
@@ -187,13 +201,87 @@ class CheckpointManager:
         # background; readers go through load()/close(), which both wait.
         # The retry covers the synchronous enqueue (D2H copy + directory
         # setup); a failed background write surfaces at the next wait.
+        if self.mirror_dir:
+            self._spawn_mirror(step)
+
+    def _spawn_mirror(self, step: int) -> None:
+        """Replicate ``step`` on a background thread: wait out the async
+        primary write first (mirroring an in-flight write would just copy
+        the corruption it exists to survive), then copy + atomic rename,
+        retried. One replication in flight at a time; its failure warns at
+        the next join instead of killing the step that enqueued it."""
+        import threading
+
+        self._join_mirror()
+        state: dict = {}
+
+        def run():
+            try:
+                self.manager.wait_until_finished()
+                self._retry(partial(self._replicate_step, step),
+                            desc=f"mirror step {step}")
+            except Exception as e:  # noqa: BLE001 - surfaced at join
+                state["err"] = e
+
+        t = threading.Thread(target=run, name="ckpt-mirror", daemon=True)
+        t._mirror_state = state
+        t.start()
+        self._mirror_thread = t
+
+    def _join_mirror(self) -> None:
+        t, self._mirror_thread = self._mirror_thread, None
+        if t is None:
+            return
+        t.join()
+        err = t._mirror_state.get("err")
+        if err is not None:
+            warnings.warn(
+                f"checkpoint mirror replication failed "
+                f"({type(err).__name__}: {err}); the mirror tier is stale",
+                RuntimeWarning)
+
+    def _replicate_step(self, step: int) -> None:
+        """Copy one committed step directory to the mirror tier. The copy
+        lands under a temp name and is committed by ``os.rename`` — a
+        reader (or a crash mid-copy) never sees a partial mirror step.
+        Mirror steps beyond the primary's ``max_to_keep`` window are
+        pruned, so the second tier cannot grow without bound."""
+        import shutil
+
+        src = os.path.join(self.directory, str(step))
+        if not os.path.isdir(src):
+            raise FileNotFoundError(f"no committed step dir at {src}")
+        os.makedirs(self.mirror_dir, exist_ok=True)
+        dst = os.path.join(self.mirror_dir, str(step))
+        tmp = os.path.join(self.mirror_dir, f".tmp-{step}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.copytree(src, tmp)
+        shutil.rmtree(dst, ignore_errors=True)
+        os.rename(tmp, dst)
+        steps = sorted((int(d) for d in os.listdir(self.mirror_dir)
+                        if d.isdigit()), reverse=True)
+        for old in steps[self._max_to_keep:]:
+            shutil.rmtree(os.path.join(self.mirror_dir, str(old)),
+                          ignore_errors=True)
+        self._mirror_mgr = None  # step listing changed: rebuild on demand
+
+    def _mirror_manager(self):
+        """An orbax manager over the mirror tier, or None when replication
+        is off / the mirror holds nothing yet. Joins any in-flight
+        replication first — a fallback restore must see complete steps."""
+        self._join_mirror()
+        if not self.mirror_dir or not os.path.isdir(self.mirror_dir):
+            return None
+        if self._mirror_mgr is None:
+            self._mirror_mgr = self._ocp.CheckpointManager(self.mirror_dir)
+        return self._mirror_mgr
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 
-    def _read_meta(self, step: int) -> dict:
+    def _read_meta(self, mgr, step: int) -> dict:
         ocp = self._ocp
-        return self.manager.restore(
+        return mgr.restore(
             step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
 
     def load(self, params_like, opt_state_like, step: Optional[int] = None,
@@ -250,9 +338,9 @@ class CheckpointManager:
                     "chunks; restore under the saving run's "
                     "(num_hidden_layers, pp_size)")
 
-        def restore(s, meta):
+        def restore(mgr, s, meta):
             remap = state["remap"]
-            return self.manager.restore(
+            return mgr.restore(
                 s,
                 args=ocp.args.Composite(
                     params=ocp.args.StandardRestore(
@@ -275,24 +363,23 @@ class CheckpointManager:
             int(meta["trained_tokens"]),
         )
 
-    def _candidate_steps(self, step: Optional[int]) -> list[int]:
-        """Steps to try restoring, newest first; waits out any in-flight
-        async save. An explicit ``step`` is tried alone (the caller asked for
-        exactly that state; silently substituting another would be worse
-        than failing)."""
-        self.manager.wait_until_finished()
+    def _candidate_steps(self, mgr, step: Optional[int]) -> list[int]:
+        """Steps to try restoring from ``mgr``, newest first; waits out any
+        in-flight async save. An explicit ``step`` is tried alone (the
+        caller asked for exactly that state; silently substituting another
+        would be worse than failing)."""
+        mgr.wait_until_finished()
         if step is not None:
             return [step]
-        steps = sorted(self.manager.all_steps(), reverse=True)
-        if not steps:
-            raise FileNotFoundError(f"no checkpoint found in {self.directory}")
-        return steps
+        return sorted(mgr.all_steps(), reverse=True)
 
     def _fallback_restore(self, step: Optional[int], guards, restore):
         """Try each candidate step newest-first: read meta (retried), run
         ``guards(meta)`` (config-level errors — a wrong topology — propagate;
-        an older step cannot fix them), then ``restore(s, meta)`` (retried; a
-        failure here means corrupt/partial data, so warn and fall back).
+        an older step cannot fix them), then ``restore(mgr, s, meta)``
+        (retried; a failure here means corrupt/partial data, so warn and
+        fall back). When every primary step fails, the MIRROR tier
+        (``mirror_dir``) gets the same newest-first walk before giving up.
         Returns (restore result, meta).
 
         A deterministically-corrupt step burns its io_attempts before the
@@ -301,35 +388,49 @@ class CheckpointManager:
         work to an unretried network flake costs far more than the seconds
         of re-deserialization here (once per restart, not per step). Tests
         with known-corrupt steps pass io_attempts=1."""
-        candidates = self._candidate_steps(step)
         last_err = None
-        for s in candidates:
-            try:
-                meta = self._retry(partial(self._read_meta, s),
-                                   desc=f"read meta @{s}")
-            except Exception as e:
-                last_err = e
+        tried: list = []
+        sources = [("primary", self.manager, self.directory)]
+        mirror = self._mirror_manager()
+        if mirror is not None:
+            sources.append(("mirror", mirror, self.mirror_dir))
+        for which, mgr, where in sources:
+            candidates = self._candidate_steps(mgr, step)
+            if which == "mirror" and candidates:
                 warnings.warn(
-                    f"checkpoint step {s} in {self.directory} has unreadable "
-                    f"metadata ({type(e).__name__}: {e}); falling back to "
-                    f"the previous step", RuntimeWarning)
-                continue
-            guards(meta)
-            try:
-                out = self._retry(partial(restore, s, meta),
-                                  desc=f"restore @{s}")
-            except Exception as e:
-                last_err = e
-                warnings.warn(
-                    f"checkpoint step {s} in {self.directory} is corrupt or "
-                    f"partially written ({type(e).__name__}); falling back "
-                    f"to the previous step", RuntimeWarning)
-                continue
-            self.last_restored_step, self.last_restored_meta = s, meta
-            return out, meta
+                    f"no readable checkpoint in {self.directory}; falling "
+                    f"back to the mirror {where}", RuntimeWarning)
+            for s in candidates:
+                tried.append(f"{which}@{s}")
+                try:
+                    meta = self._retry(partial(self._read_meta, mgr, s),
+                                       desc=f"read meta {which}@{s}")
+                except Exception as e:
+                    last_err = e
+                    warnings.warn(
+                        f"checkpoint step {s} in {where} has unreadable "
+                        f"metadata ({type(e).__name__}: {e}); falling back "
+                        f"to the previous step", RuntimeWarning)
+                    continue
+                guards(meta)
+                try:
+                    out = self._retry(partial(restore, mgr, s, meta),
+                                      desc=f"restore {which}@{s}")
+                except Exception as e:
+                    last_err = e
+                    warnings.warn(
+                        f"checkpoint step {s} in {where} is corrupt or "
+                        f"partially written ({type(e).__name__}); falling "
+                        f"back to the previous step", RuntimeWarning)
+                    continue
+                self.last_restored_step, self.last_restored_meta = s, meta
+                return out, meta
+        if not tried:
+            raise FileNotFoundError(
+                f"no checkpoint found in {self.directory}") from last_err
         raise FileNotFoundError(
-            f"no readable checkpoint in {self.directory} (tried steps "
-            f"{candidates})") from last_err
+            f"no readable checkpoint in {self.directory} (tried "
+            f"{tried})") from last_err
 
     @staticmethod
     def _resolve_remap(meta, layout):
@@ -364,8 +465,8 @@ class CheckpointManager:
         def guards(meta):
             state["remap"] = self._resolve_remap(meta, layout)
 
-        def restore(s, meta):
-            return self.manager.restore(
+        def restore(mgr, s, meta):
+            return mgr.restore(
                 s,
                 args=ocp.args.Composite(
                     params=ocp.args.StandardRestore(
@@ -380,10 +481,15 @@ class CheckpointManager:
 
     def wait_until_finished(self) -> None:
         self.manager.wait_until_finished()
+        self._join_mirror()
 
     def close(self) -> None:
-        # drains any in-flight async save before releasing the manager
+        # drains any in-flight async save (and replication) first
+        self._join_mirror()
         self.manager.close()
+        if self._mirror_mgr is not None:
+            self._mirror_mgr.close()
+            self._mirror_mgr = None
 
 
 # --------------------------------------------------------------------------- #

@@ -250,6 +250,26 @@ class ResilienceConfig:
     io_attempts: int = 3
     io_backoff: float = 0.5  # seconds; doubles per attempt
     io_jitter: float = 0.25  # uniform [1, 1+jitter] delay scale
+    # -- checkpoint replication --
+    # After each primary save commits, the step directory is copied here
+    # (retried, committed by atomic rename); restores fall back to the
+    # mirror when every primary step is corrupt/unreadable. Point it at a
+    # SECOND storage tier (different mount/bucket) or the replica is
+    # decorative. "" = off.
+    ckpt_mirror_dir: str = ""
+    # -- emergency saves (preemption path) --
+    # The preemption flush runs on a background thread (the signal path
+    # stays fast) and the exit joins it with this deadline: a save wedged
+    # on a dead mount delays the exit by at most this many seconds instead
+    # of eating the whole preemption grace window. 0 = wait forever.
+    emergency_save_timeout_s: float = 600.0
+    # -- serving dispatch retry (inference/batcher.py) --
+    # Each jitted serving dispatch (prefill, decode block, verify) is
+    # retried this many times with exponential backoff before the batcher
+    # isolates the failure to the implicated slots (finish_reason "error")
+    # and keeps serving the rest.
+    dispatch_attempts: int = 2
+    dispatch_backoff: float = 0.05  # seconds; doubles per attempt
     # -- supervisor heartbeat (tools/supervise.py); also via $PICOTRON_HEARTBEAT --
     heartbeat_path: str = ""
     # -- chaos injection (resilience/chaos.py; each fires once per process) --
@@ -257,6 +277,15 @@ class ResilienceConfig:
     chaos_nan_step: int = 0
     chaos_sigterm_step: int = 0
     chaos_truncate_step: int = 0
+    # -- serving chaos (resilience.chaos.ServingChaos, engine dispatch hooks;
+    #    rounds are 1-indexed decode/verify dispatch invocations; 0 = off) --
+    chaos_dispatch_raise_round: int = 0  # transient: raise once on round N
+    # persistent: EVERY dispatch with this slot active raises — the
+    # batcher's isolation path must fail exactly this slot (-1 = off)
+    chaos_dispatch_fail_slot: int = -1
+    chaos_latency_round: int = 0  # sleep chaos_latency_s before round N
+    chaos_latency_s: float = 0.25
+    chaos_poison_logits_round: int = 0  # round N's logits come back NaN
 
 
 @dataclass
@@ -304,6 +333,12 @@ class InferenceConfig:
     # against the slot's own token history (tried spec_ngram down to 1) to
     # propose continuations. Only consulted when spec_len > 0.
     spec_ngram: int = 3
+    # Graceful degradation for the flash attend path: when a
+    # attend_impl="flash" dispatch fails, log once, rebuild the engine's
+    # compiled programs on "dense", and keep serving — for the REST OF THE
+    # PROCESS (new engines start dense too; a kernel that broke once is
+    # not re-trusted mid-serve). False = the failure propagates.
+    attend_fallback: bool = True
 
 
 @dataclass
@@ -552,6 +587,13 @@ class Config:
             raise ValueError("io_attempts must be >= 1")
         if r.io_backoff < 0 or r.io_jitter < 0:
             raise ValueError("io_backoff and io_jitter must be >= 0")
+        if r.dispatch_attempts < 1:
+            raise ValueError("dispatch_attempts must be >= 1")
+        if r.dispatch_backoff < 0:
+            raise ValueError("dispatch_backoff must be >= 0")
+        if r.emergency_save_timeout_s < 0:
+            raise ValueError(
+                "emergency_save_timeout_s must be >= 0 (0 = wait forever)")
         if r.rollback_after < 1:
             raise ValueError("rollback_after must be >= 1")
         if r.max_rollbacks < 0:
@@ -580,6 +622,15 @@ class Config:
             if v < 0:
                 raise ValueError(f"{name} must be >= 0 (0 = off)")
             chaos_on = chaos_on or v > 0
+        for name in ("chaos_dispatch_raise_round", "chaos_latency_round",
+                     "chaos_poison_logits_round"):
+            if getattr(r, name) < 0:
+                raise ValueError(f"{name} must be >= 0 (0 = off)")
+        if r.chaos_dispatch_fail_slot < -1:
+            raise ValueError(
+                "chaos_dispatch_fail_slot must be >= -1 (-1 = off)")
+        if r.chaos_latency_s < 0:
+            raise ValueError("chaos_latency_s must be >= 0")
         if chaos_on and t.steps_per_call != 1:
             # chaos fires at exact host-visible step boundaries (and NaN
             # injection swaps in a poisoned single-step program for exactly

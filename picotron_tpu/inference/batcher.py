@@ -46,6 +46,23 @@ metric bench_decode.py tracks (1 for the per-token loop, ~1/block_len
 when every slot stays busy). Speculative runs add ``draft_proposed`` /
 ``draft_accepted`` (``accept_rate`` = their ratio): an accept rate of r
 means the average verify dispatch emitted ~1 + r*spec_len tokens.
+
+**Fault handling** (docs/SERVING.md): every jitted dispatch runs under
+``resilience.retry`` with bounded backoff (``resilience.dispatch_attempts``
+/ ``dispatch_backoff``). A prefill that still fails costs only the request
+being admitted (finish_reason ``"error"``); a decode/verify dispatch that
+still fails triggers SLOT ISOLATION — the same round is re-dispatched once
+per occupied slot with everyone else's budget masked to 0, so only the
+slots that fail alone finish ``"error"`` while the survivors' tokens are
+bit-identical to a fault-free round (same shapes, same keys: row b's draw
+depends only on row b's logits and the shared key). A failure that
+consumed the donated cache (buffers deleted mid-execution) cannot be
+isolated: every occupied slot fails ``"error"`` and the cache is rebuilt,
+so the PROCESS keeps serving either way — an exception in one dispatch is
+never a server death. ``finish()`` accounting is tracked in ``counters``
+(admitted/completed/expired/errored/shed) with queue-wait and
+time-to-first-token samples surfaced by ``stats()`` — the ``/statz``
+payload of tools/serve.py.
 """
 
 from __future__ import annotations
@@ -53,12 +70,19 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import numpy as np
 
 from picotron_tpu.inference import sampling
+from picotron_tpu.resilience.retry import retry
+from picotron_tpu.utils import log0
+
+
+def _log_dispatch_failure(kind: str, ident, e: BaseException) -> None:
+    log0(f"serving: {kind} dispatch failed for {ident} "
+         f"({type(e).__name__}: {e})", flush=True)
 
 
 @dataclass
@@ -84,7 +108,11 @@ class GenerationResult:
     uid: str
     prompt: list
     tokens: list  # generated ids, EOS included when hit
-    finish_reason: str  # "eos" | "length" | "timeout"
+    # "eos" | "length" | "timeout" | "shed" (dropped unstarted at drain) |
+    # "error" (dispatch failure isolated to this request)
+    finish_reason: str
+    queue_wait_s: Optional[float] = None  # submit -> admit (None: never admitted)
+    ttft_s: Optional[float] = None  # submit -> first token
 
 
 @dataclass
@@ -92,6 +120,19 @@ class _Slot:
     req: Request
     generated: list = field(default_factory=list)
     deadline: Optional[float] = None  # clock() time after which we retire
+    submit_t: Optional[float] = None  # clock() at submit (stats)
+    queue_wait_s: Optional[float] = None
+    ttft_s: Optional[float] = None
+
+
+def _percentiles(samples: list) -> Optional[dict]:
+    """{p50, p95, p99, n} of a sample list (seconds), or None when empty."""
+    if not samples:
+        return None
+    a = np.asarray(samples, np.float64)
+    p50, p95, p99 = np.percentile(a, [50, 95, 99])
+    return {"p50": float(p50), "p95": float(p95), "p99": float(p99),
+            "n": int(a.size)}
 
 
 class ContinuousBatcher:
@@ -108,11 +149,15 @@ class ContinuousBatcher:
     """
 
     def __init__(self, engine, params, seed: int = 0, clock=time.monotonic,
-                 drafter=None):
+                 drafter=None, on_token: Optional[Callable] = None):
         self.engine = engine
         self.params = params
         self._clock = clock  # injectable so deadline tests are deterministic
         self._key = jax.random.PRNGKey(seed)
+        # streaming hook: called as on_token(uid, token) for every token a
+        # request emits, from inside step()/run() — the serve front end
+        # pushes these straight into the response stream
+        self.on_token = on_token
         # speculative engines get a drafter (the prompt-lookup default, or
         # an injected one — e.g. a scripted drafter in tests, a draft
         # model later); spec-off engines ignore it
@@ -138,6 +183,19 @@ class ContinuousBatcher:
         self.generated_tokens = 0
         self.draft_proposed = 0
         self.draft_accepted = 0
+        # request accounting: every submitted request lands in exactly one
+        # terminal counter (completed = eos|length, expired = timeout,
+        # errored = dispatch failure, shed = dropped unstarted) — the
+        # serve-chaos acceptance sums these against submissions
+        self.counters = {"admitted": 0, "completed": 0, "expired": 0,
+                         "errored": 0, "shed": 0}
+        self._submit_t: dict = {}  # uid -> clock() at submit
+        self._queue_waits: list = []  # submit -> admit samples (seconds)
+        self._ttfts: list = []  # submit -> first-token samples (seconds)
+        self._retry = dict(
+            attempts=engine.cfg.resilience.dispatch_attempts,
+            backoff=engine.cfg.resilience.dispatch_backoff,
+            desc="serving dispatch")
 
     @property
     def accept_rate(self) -> Optional[float]:
@@ -160,11 +218,48 @@ class ContinuousBatcher:
                 f"request {req.uid!r}: prompt of {len(req.prompt)} tokens "
                 f"leaves no room to generate under max_seq_len "
                 f"{self.engine.max_seq_len}")
+        self._submit_t[req.uid] = self._clock()
         self._pending.append(req)
 
     @property
     def busy(self) -> bool:
         return bool(self._pending) or any(s is not None for s in self._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (the bounded-queue admission gate)."""
+        return len(self._pending)
+
+    def token_load(self) -> int:
+        """Worst-case token commitment of every queued and in-flight
+        request (prompt + full ``max_new_tokens`` budget) — the
+        token-budget admission-control metric: what the cache/compute would
+        owe if every live request ran to its cap."""
+        load = sum(len(r.prompt) + r.max_new_tokens for r in self._pending)
+        for s in self._slots:
+            if s is not None:
+                load += len(s.req.prompt) + s.req.max_new_tokens
+        return load
+
+    def take_results(self) -> dict:
+        """Drain finished results accumulated since the last call:
+        {uid: GenerationResult}. The serve loop calls this after each
+        step(); run() uses it for its final return."""
+        out, self._results = self._results, {}
+        return out
+
+    def shed_pending(self) -> None:
+        """Finish every QUEUED (never admitted) request with reason
+        ``"shed"`` — the graceful-drain path: in-flight slots run to
+        completion, but work that never started is handed back so the
+        client can retry against another replica instead of waiting on a
+        server that is exiting."""
+        while self._pending:
+            req = self._pending.popleft()
+            self._submit_t.pop(req.uid, None)
+            self.counters["shed"] += 1
+            self._results[req.uid] = GenerationResult(
+                req.uid, list(req.prompt), [], "shed")
 
     def run(self, requests=None) -> dict:
         """Submit ``requests`` (optional) and step until every submitted
@@ -173,8 +268,27 @@ class ContinuousBatcher:
             self.submit(r)
         while self.busy:
             self.step()
-        out, self._results = self._results, {}
-        return out
+        return self.take_results()
+
+    def stats(self) -> dict:
+        """Serving counters + latency percentiles (the ``/statz`` payload):
+        request accounting (admitted/completed/expired/errored/shed),
+        dispatch/throughput counters, live occupancy, and queue-wait /
+        time-to-first-token percentiles over the retained samples."""
+        d = dict(self.counters)
+        d.update(
+            decode_dispatches=self.decode_dispatches,
+            prefill_dispatches=self.prefill_dispatches,
+            generated_tokens=self.generated_tokens,
+            queued=len(self._pending),
+            active_slots=sum(s is not None for s in self._slots),
+            slots=len(self._slots),
+            queue_wait_s=_percentiles(self._queue_waits),
+            ttft_s=_percentiles(self._ttfts),
+        )
+        if self.draft_proposed:
+            d["accept_rate"] = self.accept_rate
+        return d
 
     # ---- one scheduler round ----------------------------------------------
 
@@ -182,10 +296,16 @@ class ContinuousBatcher:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    _REASON_COUNTER = {"eos": "completed", "length": "completed",
+                       "timeout": "expired", "error": "errored",
+                       "shed": "shed"}
+
     def _finish(self, i: int, reason: str) -> None:
         s = self._slots[i]
+        self.counters[self._REASON_COUNTER[reason]] += 1
         self._results[s.req.uid] = GenerationResult(
-            s.req.uid, list(s.req.prompt), list(s.generated), reason)
+            s.req.uid, list(s.req.prompt), list(s.generated), reason,
+            queue_wait_s=s.queue_wait_s, ttft_s=s.ttft_s)
         self._slots[i] = None
         self._cache = self.engine.release(self._cache, i)
         self._last_tok[i] = 0
@@ -210,6 +330,11 @@ class ContinuousBatcher:
         s = self._slots[i]
         s.generated.append(tok)
         self.generated_tokens += 1
+        if s.ttft_s is None and s.submit_t is not None:
+            s.ttft_s = self._clock() - s.submit_t
+            self._sample(self._ttfts, s.ttft_s)
+        if self.on_token is not None:
+            self.on_token(s.req.uid, tok)
         r = s.req
         if r.eos_id is not None and tok == r.eos_id:
             self._finish(i, "eos")
@@ -219,6 +344,31 @@ class ContinuousBatcher:
         else:
             self._last_tok[i] = tok
 
+    @staticmethod
+    def _sample(samples: list, v: float, cap: int = 4096) -> None:
+        """Retain a latency sample, dropping the oldest past ``cap`` (the
+        percentile window stays recent and the list stays bounded)."""
+        samples.append(v)
+        if len(samples) > cap:
+            del samples[: len(samples) - cap]
+
+    def _prefill_into(self, req: Request, i: int):
+        """Prefill ``req`` into slot ``i`` (one-shot or chunked) and return
+        its last-token logits. Mutates the cache/dispatch counters."""
+        if len(req.prompt) > self.engine.prefill_chunk:
+            # long prompt: fixed-width chunks straight into the slot —
+            # O(1) compiled shapes in prompt length
+            n_chunks = -(-len(req.prompt) // self.engine.prefill_chunk)
+            self._cache, logits = self.engine.prefill_chunked(
+                self.params, self._cache, req.prompt, i)
+            self.prefill_dispatches += n_chunks
+        else:
+            kv, logits = self.engine.prefill(self.params, req.prompt)
+            self._cache = self.engine.insert(
+                self._cache, kv, i, len(req.prompt))
+            self.prefill_dispatches += 1
+        return logits
+
     def _admit(self) -> None:
         for i in range(len(self._slots)):
             if not self._pending:
@@ -226,21 +376,34 @@ class ContinuousBatcher:
             if self._slots[i] is not None:
                 continue
             req = self._pending.popleft()
-            if len(req.prompt) > self.engine.prefill_chunk:
-                # long prompt: fixed-width chunks straight into the slot —
-                # O(1) compiled shapes in prompt length
-                n_chunks = -(-len(req.prompt) // self.engine.prefill_chunk)
-                self._cache, logits = self.engine.prefill_chunked(
-                    self.params, self._cache, req.prompt, i)
-                self.prefill_dispatches += n_chunks
-            else:
-                kv, logits = self.engine.prefill(self.params, req.prompt)
-                self._cache = self.engine.insert(
-                    self._cache, kv, i, len(req.prompt))
-                self.prefill_dispatches += 1
-            deadline = (self._clock() + req.timeout_s
+            submit_t = self._submit_t.pop(req.uid, None)
+            try:
+                logits = retry(lambda: self._prefill_into(req, i),
+                               **self._retry)
+            except Exception as e:  # noqa: BLE001 - isolated to this request
+                # the failure costs only THIS request: it never held a slot,
+                # so release frees whatever partial prefill state landed and
+                # everyone already admitted keeps decoding
+                self.counters["admitted"] += 1
+                self.counters["errored"] += 1
+                self._results[req.uid] = GenerationResult(
+                    req.uid, list(req.prompt), [], "error")
+                _log_dispatch_failure("prefill", req.uid, e)
+                if self._cache_ok():
+                    # free whatever partial prefill state landed in the slot
+                    self._cache = self.engine.release(self._cache, i)
+                else:
+                    self._cache_lost()
+                continue
+            self.counters["admitted"] += 1
+            now = self._clock()
+            deadline = (now + req.timeout_s
                         if req.timeout_s is not None else None)
-            self._slots[i] = _Slot(req, deadline=deadline)
+            slot = _Slot(req, deadline=deadline, submit_t=submit_t)
+            if submit_t is not None:
+                slot.queue_wait_s = now - submit_t
+                self._sample(self._queue_waits, slot.queue_wait_s)
+            self._slots[i] = slot
             self._temp[i] = req.temperature
             self._top_k[i] = req.top_k
             self._top_p[i] = req.top_p
@@ -268,26 +431,35 @@ class ContinuousBatcher:
         then advance every occupied slot by one decode block (up to
         ``engine.decode_block_len`` tokens per slot, one dispatch) — or,
         on a speculative engine, by one draft-verify dispatch (1 to
-        ``engine.spec_len + 1`` tokens per slot)."""
+        ``engine.spec_len + 1`` tokens per slot). A dispatch failure that
+        survives the retry budget is isolated to the slots that fail
+        alone (see module docstring) — step() itself never raises for an
+        engine-side fault."""
         self._expire_deadlines()
         self._admit()
         if not any(s is not None for s in self._slots):
             return
         for i, s in enumerate(self._slots):
             self._budget[i] = self._remaining(i) if s is not None else 0
+        budget = self._budget.copy()
         if self.engine.spec_len > 0:
-            toks, counts = self._spec_round()
+            toks, counts, failed = self._spec_round(budget)
         else:
             block = self.engine.decode_block_len
             keys = np.stack([np.asarray(self._split())
                              for _ in range(block)])
-            self._cache, toks, counts = self.engine.decode_block(
-                self.params, self._cache, self._last_tok, keys,
-                self._eos, self._budget, self._temp, self._top_k,
-                self._top_p)
-            self.decode_dispatches += 1
-            toks = np.asarray(toks)
-            counts = np.asarray(counts)
+
+            def dispatch(b):
+                self._cache, toks, counts = self.engine.decode_block(
+                    self.params, self._cache, self._last_tok, keys,
+                    self._eos, b, self._temp, self._top_k, self._top_p)
+                self.decode_dispatches += 1
+                return np.asarray(toks), np.asarray(counts), None
+
+            toks, counts, _, failed = self._guarded_round(dispatch, budget)
+        for i in failed:
+            if self._slots[i] is not None:
+                self._finish(i, "error")
         for i in range(len(self._slots)):
             if self._slots[i] is None:
                 continue
@@ -299,13 +471,91 @@ class ContinuousBatcher:
                     break
                 self._token_done(i, int(t))
 
-    def _spec_round(self) -> tuple:
+    # ---- dispatch fault recovery ------------------------------------------
+
+    def _cache_ok(self) -> bool:
+        """Whether the cache's buffers are still live (a dispatch that
+        failed DURING execution consumed the donated cache; one that failed
+        before — hook faults, trace/compile errors — did not)."""
+        lengths = self._cache["lengths"]
+        return not (hasattr(lengths, "is_deleted") and lengths.is_deleted())
+
+    def _cache_lost(self) -> None:
+        """The donated cache was consumed by a failed dispatch: every
+        parked sequence's K/V is gone, so every occupied slot finishes
+        ``"error"`` and a fresh cache is built — the batcher (and its
+        queue) outlives the fault even when isolation is impossible."""
+        self._cache = self.engine.init_cache()
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._finish(i, "error")
+
+    def _guarded_round(self, dispatch, budget) -> tuple:
+        """Run one decode/verify round with fault recovery.
+
+        ``dispatch(budget) -> (toks [n, S], counts [n], aux [n] | None)``
+        performs the jitted round restricted to the slots whose budget row
+        is nonzero (free slots always carry 0). The happy path is ONE
+        retried call. On persistent failure, the round is ISOLATED: each
+        occupied slot is re-dispatched alone (everyone else's budget masked
+        to 0) with the SAME keys/tokens, which reproduces the group round's
+        per-row results exactly — row b's logits see only slot b's cache,
+        and the samplers draw per-row from the shared key — so surviving
+        slots emit bit-identical tokens to a fault-free round. Slots that
+        still fail alone are returned in ``failed`` (the caller retires
+        them as ``"error"``). A failure that consumed the donated cache
+        ends the round via ``_cache_lost``.
+
+        Returns (toks, counts, aux, failed_slot_indices); counts rows of
+        failed/finished slots are 0, so the step() walk skips them."""
+        try:
+            toks, counts, aux = retry(lambda: dispatch(budget),
+                                      **self._retry)
+            return toks, counts, aux, []
+        except Exception as e:  # noqa: BLE001 - recovery, rethrown never
+            _log_dispatch_failure("round", "active slots", e)
+        n = len(self._slots)
+        counts_out = np.zeros(n, np.int64)
+        toks_out = aux_out = None
+        failed: list = []
+        if not self._cache_ok():
+            self._cache_lost()
+            return np.zeros((n, 1), np.int32), counts_out, None, []
+        for i in range(n):
+            if self._slots[i] is None or budget[i] <= 0:
+                continue
+            solo = np.zeros_like(budget)
+            solo[i] = budget[i]
+            try:
+                t, c, a = retry(lambda: dispatch(solo), **self._retry)
+            except Exception as e:  # noqa: BLE001 - isolated to slot i
+                _log_dispatch_failure("solo", f"slot {i}", e)
+                if not self._cache_ok():
+                    # mid-isolation cache loss: everyone still parked fails
+                    self._cache_lost()
+                    return (np.zeros((n, 1), np.int32),
+                            np.zeros(n, np.int64), None, [])
+                failed.append(i)
+                continue
+            if toks_out is None:
+                toks_out = np.zeros_like(t)
+                aux_out = None if a is None else np.zeros_like(a)
+            toks_out[i] = t[i]
+            counts_out[i] = c[i]
+            if a is not None:
+                aux_out[i] = a[i]
+        if toks_out is None:  # every occupied slot failed alone
+            toks_out = np.zeros((n, 1), np.int32)
+        return toks_out, counts_out, aux_out, failed
+
+    def _spec_round(self, budget) -> tuple:
         """One draft-verify round: propose ``spec_len`` tokens per occupied
         slot from its own history (prompt + generated — the drafter runs
         host-side while the device is free), dispatch ONE ``engine.verify``
-        pass, and return its (emitted tokens, per-slot counts). Acceptance
-        stats accumulate here; the shared step() tail walks the emitted
-        prefixes through ``_token_done`` exactly like a decode block's."""
+        pass (fault-isolated like the decode round), and return its
+        (emitted tokens, per-slot counts, failed slots). Acceptance stats
+        accumulate here; the shared step() tail walks the emitted prefixes
+        through ``_token_done`` exactly like a decode block's."""
         g = self.engine.spec_len
         n = len(self._slots)
         tokens = np.zeros((n, g + 1), np.int32)
@@ -315,14 +565,21 @@ class ContinuousBatcher:
             tokens[i, 0] = self._last_tok[i]
             hist = np.asarray(list(s.req.prompt) + s.generated, np.int32)
             tokens[i, 1:] = self.drafter.propose(hist, g)
-        self._cache, emitted, counts, accepted = self.engine.verify(
-            self.params, self._cache, tokens, self._split(), self._eos,
-            self._budget, self._temp, self._top_k, self._top_p)
-        self.decode_dispatches += 1
-        counts = np.asarray(counts)
-        accepted = np.asarray(accepted)
+        key = self._split()
+
+        def dispatch(b):
+            self._cache, emitted, counts, accepted = self.engine.verify(
+                self.params, self._cache, tokens, key, self._eos,
+                b, self._temp, self._top_k, self._top_p)
+            self.decode_dispatches += 1
+            return (np.asarray(emitted), np.asarray(counts),
+                    np.asarray(accepted))
+
+        emitted, counts, accepted, failed = self._guarded_round(
+            dispatch, budget)
         for i, s in enumerate(self._slots):
-            if s is not None:
+            if s is not None and i not in failed and budget[i] > 0:
                 self.draft_proposed += g
-                self.draft_accepted += int(accepted[i])
-        return np.asarray(emitted), counts
+                if accepted is not None:
+                    self.draft_accepted += int(accepted[i])
+        return emitted, counts, failed

@@ -68,7 +68,13 @@ from picotron_tpu.models import llama
 from picotron_tpu.ops.rope import precompute_rope, rope_at_positions
 from picotron_tpu.parallel.tp import tp_gather
 from picotron_tpu.topology import Topology, build_topology, named_shardings
-from picotron_tpu.utils import shard_map
+from picotron_tpu.utils import log0, shard_map
+
+# Process-wide graceful-degradation latch (inference.attend_fallback): once
+# a flash dispatch has failed, every engine in this process — current and
+# future — serves on "dense". A kernel that broke once is not re-trusted
+# mid-serve; restarting the process is the way to re-arm flash.
+_FLASH_BROKEN = False
 
 
 def inference_config(cfg: Config) -> Config:
@@ -105,7 +111,8 @@ class InferenceEngine:
                  prefill_chunk: Optional[int] = None,
                  spec_len: Optional[int] = None,
                  spec_ngram: Optional[int] = None,
-                 attend_impl: Optional[str] = None):
+                 attend_impl: Optional[str] = None,
+                 hooks=None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
         inf = self.cfg.inference
@@ -151,7 +158,19 @@ class InferenceEngine:
                 raise ValueError(
                     f"unknown attend_impl {attend_impl!r} (dense|flash)")
             inf.attend_impl = attend_impl
+        if (inf.attend_impl == "flash" and inf.attend_fallback
+                and _FLASH_BROKEN):
+            # the process-wide degradation latch: flash already failed here
+            log0("attend_impl 'flash' already failed in this process; "
+                 "this engine starts on 'dense' (inference.attend_fallback)")
+            inf.attend_impl = "dense"
         self.attend_impl = inf.attend_impl
+        # dispatch hooks (fault injection / observation): an object with
+        # before_dispatch(kind, active_slots) — may raise or sleep — and
+        # poison_logits(kind) -> bool (route this dispatch through the
+        # NaN-poisoned decode program). resilience.chaos.ServingChaos is
+        # the shipped implementation; None = no hooks.
+        self.hooks = hooks
         # a chunk wider than the cache window could never be written
         # (mirrors prefill_bucket's min(bucket, max_seq_len) cap)
         self.prefill_chunk = min(self.prefill_chunk, self.max_seq_len)
@@ -175,8 +194,22 @@ class InferenceEngine:
 
         self._pspecs = llama.param_pspecs(m)
         self._cspecs = kv_cache.cache_pspecs(self.quantized)
+        self._build_programs()
+        self._insert_jit = jax.jit(kv_cache.insert_prefill,
+                                   donate_argnums=(0,))
+        self._release_jit = jax.jit(kv_cache.release, donate_argnums=(0,))
+        self._init_cache_jit = jax.jit(
+            partial(kv_cache.init_cache, m, self.slots, self.max_seq_len,
+                    dtype=self.cache_dtype, quantized=self.quantized),
+            out_shardings=named_shardings(topo, self._cspecs))
+
+    def _build_programs(self) -> None:
+        """(Re)build the compiled model programs. Runs at construction and
+        again when the flash->dense degradation path flips ``attend_impl``:
+        the kernel choice is a trace-time constant the jit wrappers close
+        over, so changing it means new programs, not a runtime branch."""
         kv_spec = {n: s for n, s in self._cspecs.items() if n != "lengths"}
-        mesh = topo.mesh
+        mesh = self.topo.mesh
 
         self._prefill_jit = jax.jit(shard_map(
             self._prefill_impl, mesh,
@@ -192,12 +225,8 @@ class InferenceEngine:
             in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P(), P()),
             out_specs=(self._cspecs, P(), P())),
             donate_argnums=(1,))
-        self._decode_block_jit = jax.jit(shard_map(
-            self._decode_block_impl, mesh,
-            in_specs=(self._pspecs, self._cspecs,
-                      P(), P(), P(), P(), P(), P(), P()),
-            out_specs=(self._cspecs, P(), P())),
-            donate_argnums=(1,))
+        self._decode_block_jit = self._make_decode_block_jit()
+        self._decode_block_poison_jit = None  # chaos-only; built on demand
         self._verify_jit = None
         if self.spec_len > 0:
             self._verify_jit = jax.jit(shard_map(
@@ -206,13 +235,70 @@ class InferenceEngine:
                           P(), P(), P(), P(), P(), P(), P()),
                 out_specs=(self._cspecs, P(), P(), P())),
                 donate_argnums=(1,))
-        self._insert_jit = jax.jit(kv_cache.insert_prefill,
-                                   donate_argnums=(0,))
-        self._release_jit = jax.jit(kv_cache.release, donate_argnums=(0,))
-        self._init_cache_jit = jax.jit(
-            partial(kv_cache.init_cache, m, self.slots, self.max_seq_len,
-                    dtype=self.cache_dtype, quantized=self.quantized),
-            out_shardings=named_shardings(topo, self._cspecs))
+
+    def _make_decode_block_jit(self, poison: bool = False):
+        return jax.jit(shard_map(
+            partial(self._decode_block_impl, poison=poison), self.topo.mesh,
+            in_specs=(self._pspecs, self._cspecs,
+                      P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(self._cspecs, P(), P())),
+            donate_argnums=(1,))
+
+    def _decode_block_prog(self, poison: bool):
+        """The decode-block executable to run (lazily builds the chaos
+        NaN-poisoned variant)."""
+        if not poison:
+            return self._decode_block_jit
+        if self._decode_block_poison_jit is None:
+            self._decode_block_poison_jit = self._make_decode_block_jit(
+                poison=True)
+        return self._decode_block_poison_jit
+
+    # ---- dispatch hooks + graceful degradation ----------------------------
+
+    def _hook(self, kind: str, budget=None) -> None:
+        """Fire the before-dispatch hook with the active slot indices
+        (``budget > 0`` rows; dispatches without a budget report none)."""
+        if self.hooks is None:
+            return
+        slots = ([] if budget is None
+                 else np.flatnonzero(np.asarray(budget) > 0).tolist())
+        self.hooks.before_dispatch(kind, slots)
+
+    def _poison(self, kind: str) -> bool:
+        return self.hooks is not None and self.hooks.poison_logits(kind)
+
+    def _flash_fallback(self, exc: Exception) -> bool:
+        """Degrade flash->dense after a failed dispatch: latch the process
+        flag, log once, rebuild the compiled programs on dense. Returns
+        whether the caller should re-dispatch."""
+        if (self.attend_impl != "flash"
+                or not self.cfg.inference.attend_fallback):
+            return False
+        global _FLASH_BROKEN
+        if not _FLASH_BROKEN:
+            _FLASH_BROKEN = True
+            log0(f"attend_impl 'flash' failed at dispatch "
+                 f"({type(exc).__name__}: {exc}); falling back to 'dense' "
+                 f"for the rest of the process", flush=True)
+        self.attend_impl = self.cfg.inference.attend_impl = "dense"
+        self._build_programs()
+        return True
+
+    def _dispatch(self, call):
+        """Run one compiled cache dispatch. A flash failure rebuilds on
+        dense and re-dispatches once (``call`` must re-read the jit
+        attribute, not capture the object). The re-dispatch is sound when
+        the failure predates execution (trace/compile — where flash breaks
+        off-TPU); a failure AFTER the donated cache was consumed makes the
+        retry fail fast on the deleted buffers, which lands in the
+        batcher's slot-recovery path instead of wedging."""
+        try:
+            return call()
+        except Exception as e:  # noqa: BLE001 - rethrown unless degrading
+            if self._flash_fallback(e):
+                return call()
+            raise
 
     # ---- model programs (run inside shard_map; tp axis collectives live) --
 
@@ -301,7 +387,8 @@ class InferenceEngine:
         return new_cache, next_tok, logits
 
     def _decode_block_impl(self, params, cache, tokens, keys, eos_id,
-                           budget, temperature, top_k, top_p):
+                           budget, temperature, top_k, top_p,
+                           poison=False):
         """``decode_block_len`` autoregressive steps in one program.
 
         tokens [B] (each slot's current last token), keys [block_len, 2]
@@ -316,6 +403,11 @@ class InferenceEngine:
 
         Returns (cache, tokens [B, block_len], counts [B]): ``counts[b]``
         leading entries of row b are the tokens slot b actually produced.
+
+        ``poison`` (trace-time, chaos only) replaces every step's logits
+        with NaN — the build that proves the sampler's non-finite gate
+        keeps emitting defined tokens, the exact counterpart of
+        train_step's ``poison_nonfinite``.
         """
 
         def step(carry, key_t):
@@ -323,6 +415,8 @@ class InferenceEngine:
             pos = cache["lengths"]
             active = (pos > 0) & (budget > 0)
             new_leaves, logits = self._decode_core(params, cache, tok)
+            if poison:
+                logits = jnp.full_like(logits, jnp.nan)
             sampled = sampling.sample(logits, key_t, temperature,
                                       top_k, top_p)
             emit = jnp.where(active, sampled, 0)
@@ -465,6 +559,7 @@ class InferenceEngine:
         bucket = self.prefill_bucket(ids.size)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : ids.size] = ids
+        self._hook("prefill")
         return self._prefill_jit(params, jnp.asarray(padded),
                                  jnp.asarray([ids.size], jnp.int32))
 
@@ -495,11 +590,12 @@ class InferenceEngine:
             chunk = ids[start:end]
             padded = np.zeros((1, C), np.int32)
             padded[0, : chunk.size] = chunk
-            cache, logits = self._prefill_chunk_jit(
+            self._hook("prefill_chunk")
+            cache, logits = self._dispatch(lambda: self._prefill_chunk_jit(
                 params, cache, jnp.asarray(padded),
                 jnp.asarray(slot, jnp.int32),
                 jnp.asarray(start, jnp.int32),
-                jnp.asarray(chunk.size, jnp.int32))
+                jnp.asarray(chunk.size, jnp.int32)))
         return cache, logits
 
     def insert(self, cache, kv, slot: int, length: int) -> dict:
@@ -515,12 +611,13 @@ class InferenceEngine:
         """One token for every slot. tokens/temperature/top_k/top_p are
         [slots] host or device arrays; returns (cache, next_tokens [slots],
         logits [slots, V] fp32). Consumes ``cache``."""
-        return self._decode_jit(
+        self._hook("decode")
+        return self._dispatch(lambda: self._decode_jit(
             params, cache,
             jnp.asarray(np.asarray(tokens, np.int32)), key,
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
-            jnp.asarray(np.asarray(top_p, np.float32)))
+            jnp.asarray(np.asarray(top_p, np.float32))))
 
     def decode_block(self, params, cache, tokens, keys, eos_id, budget,
                      temperature, top_k, top_p) -> tuple:
@@ -535,14 +632,18 @@ class InferenceEngine:
             raise ValueError(
                 f"keys has {keys.shape[0]} rows; decode_block_len is "
                 f"{self.decode_block_len} (one key per in-block step)")
-        return self._decode_block_jit(
+        self._hook("decode", budget)
+        poison = self._poison("decode")
+        # the program is resolved INSIDE the lambda so the flash->dense
+        # fallback's rebuilt jits are what a re-dispatch runs
+        return self._dispatch(lambda: self._decode_block_prog(poison)(
             params, cache,
             jnp.asarray(np.asarray(tokens, np.int32)), keys,
             jnp.asarray(np.asarray(eos_id, np.int32)),
             jnp.asarray(np.asarray(budget, np.int32)),
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
-            jnp.asarray(np.asarray(top_p, np.float32)))
+            jnp.asarray(np.asarray(top_p, np.float32))))
 
     def verify(self, params, cache, tokens, key, eos_id, budget,
                temperature, top_k, top_p) -> tuple:
@@ -566,10 +667,11 @@ class InferenceEngine:
                 f"verify tokens must be [slots, spec_len + 1] = "
                 f"[{self.slots}, {self.spec_len + 1}]; got "
                 f"{tokens.shape}")
-        return self._verify_jit(
+        self._hook("verify", budget)
+        return self._dispatch(lambda: self._verify_jit(
             params, cache, jnp.asarray(tokens), key,
             jnp.asarray(np.asarray(eos_id, np.int32)),
             jnp.asarray(np.asarray(budget, np.int32)),
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
-            jnp.asarray(np.asarray(top_p, np.float32)))
+            jnp.asarray(np.asarray(top_p, np.float32))))

@@ -31,6 +31,24 @@ def greedy(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+def sanitize_logits(logits: jnp.ndarray) -> jnp.ndarray:
+    """Replace non-finite entries with the mask fill. The serving analogue
+    of train_step's non-finite gate: a poisoned/overflowed dispatch must not
+    push NaN through the categorical (whose draw would be garbage) and from
+    there into the KV state — masked, the bad entries simply can never be
+    selected. Identity on finite logits, so healthy decode is untouched."""
+    return jnp.where(jnp.isfinite(logits), logits, NEG_INF)
+
+
+def nonfinite_rows(logits: jnp.ndarray) -> jnp.ndarray:
+    """[..., V] -> [...] bool: rows carrying ANY non-finite logit. Those
+    rows fall back to greedy over the sanitized distribution (``sample``) —
+    a partially-poisoned distribution is not one the request asked to
+    sample from, and argmax of the surviving finite entries is the most
+    conservative defined answer (token 0 when the whole row is bad)."""
+    return ~jnp.all(jnp.isfinite(logits), axis=-1)
+
+
 def apply_top_k(logits: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
     """Keep each row's k highest logits (k: [B] int32; k <= 0 disables).
     Ties at the threshold all survive — the kept set can exceed k on exact
@@ -97,7 +115,14 @@ def sample(logits: jnp.ndarray, key, temperature: jnp.ndarray,
     logits. All sampling params are [B] arrays (see module docstring);
     rows draw independently from one key. An all-greedy batch (the common
     serving default) short-circuits past the sort/softmax/draw pipeline —
-    decode pays one argmax per step."""
+    decode pays one argmax per step.
+
+    Rows with non-finite logits fall back to GREEDY over the sanitized
+    (non-finite -> NEG_INF) distribution instead of propagating NaN into
+    the emitted stream; finite rows are bit-identical to the pre-gate
+    sampler (``sanitize_logits`` is the identity there)."""
+    bad = nonfinite_rows(logits)
+    logits = sanitize_logits(logits)
     greedy_tok = greedy(logits)
 
     def stochastic():
@@ -106,7 +131,7 @@ def sample(logits: jnp.ndarray, key, temperature: jnp.ndarray,
             logits.astype(jnp.float32) / t, top_k, top_p)
         drawn = jax.random.categorical(key, filtered, axis=-1).astype(
             jnp.int32)
-        return jnp.where(temperature <= 0.0, greedy_tok, drawn)
+        return jnp.where((temperature <= 0.0) | bad, greedy_tok, drawn)
 
     # no collectives in either branch, so the cond is shard_map-safe
     return jax.lax.cond(jnp.all(temperature <= 0.0),
@@ -117,10 +142,13 @@ def filtered_probs(logits: jnp.ndarray, temperature: jnp.ndarray,
                    top_k: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
     """The distribution ``sample`` draws its stochastic rows from:
     softmax over temperature-scaled, top-k/top-p-filtered logits.
-    logits [N, V] fp32 with [N] per-row params -> probs [N, V] fp32."""
+    logits [N, V] fp32 with [N] per-row params -> probs [N, V] fp32.
+    Non-finite entries are sanitized away first (see ``sanitize_logits``),
+    so a poisoned verify dispatch yields a defined distribution."""
     t = jnp.maximum(temperature, 1e-6)[:, None]
     return jax.nn.softmax(
-        filter_top_k_top_p(logits.astype(jnp.float32) / t, top_k, top_p),
+        filter_top_k_top_p(
+            sanitize_logits(logits).astype(jnp.float32) / t, top_k, top_p),
         axis=-1)
 
 
@@ -160,7 +188,10 @@ def speculative_accept(logits: jnp.ndarray, draft: jnp.ndarray, key,
     """
     B, S, V = logits.shape
     G = S - 1
-    preds = greedy(logits.reshape(B * S, V)).reshape(B, S)  # [B, S] argmax
+    # sanitized argmax: a poisoned verify row degrades to a defined greedy
+    # chain instead of NaN-ordering garbage (identity on finite logits)
+    preds = greedy(sanitize_logits(
+        logits.reshape(B * S, V))).reshape(B, S)  # [B, S] argmax
     acc_greedy = _leading_true(draft == preds[:, :G])
     last_greedy = jnp.take_along_axis(
         preds, acc_greedy[:, None], axis=1)[:, 0]

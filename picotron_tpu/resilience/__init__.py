@@ -27,7 +27,11 @@ from picotron_tpu.resilience.anomaly import (  # noqa: F401
     AnomalyAbort,
     LossAnomalyDetector,
 )
-from picotron_tpu.resilience.chaos import ChaosError, ChaosInjector  # noqa: F401
+from picotron_tpu.resilience.chaos import (  # noqa: F401
+    ChaosError,
+    ChaosInjector,
+    ServingChaos,
+)
 from picotron_tpu.resilience.preemption import (  # noqa: F401
     EXIT_PREEMPTED,
     PreemptionGuard,

@@ -20,12 +20,20 @@ tier-1 suite can prove kill→resume equivalence on a CPU mesh:
 Each event fires at most once per process, so a rollback that replays step k
 does not re-trip the same fault (which would livelock the rollback policy).
 All steps are 1-indexed optimizer steps; 0 disables an event.
+
+``ServingChaos`` is the SERVING-side injector: the same config-driven,
+deterministic discipline, but keyed to engine dispatch rounds instead of
+optimizer steps and delivered through the InferenceEngine's dispatch hooks
+(``engine.hooks``) — dispatch exceptions, latency spikes, and poisoned
+(NaN) logits, the faults the batcher's retry/isolation path, the serving
+watchdog, and the sampler's non-finite gate each exist to absorb.
 """
 
 from __future__ import annotations
 
 import os
 import signal
+import time
 
 from picotron_tpu.utils import log0
 
@@ -101,3 +109,81 @@ class ChaosInjector:
             os.kill(os.getpid(), signal.SIGTERM)
         if self._fire_once("raise", self.raise_step, step):
             raise ChaosError(f"chaos: injected crash after step {step}")
+
+
+class ServingChaos:
+    """Deterministic fault injection for the serving stack, installed as an
+    engine's dispatch hooks (``InferenceEngine(..., hooks=ServingChaos(r))``
+    or ``engine.hooks = ...``).
+
+    Rounds are 1-indexed decode/verify dispatch invocations (prefill
+    dispatches pass through untouched — admission faults are a different
+    layer) and every dispatch the engine attempts counts, INCLUDING the
+    batcher's retry and per-slot isolation re-dispatches: that is what lets
+    ``chaos_dispatch_raise_round`` prove the retry path (fires once, the
+    retry lands) while ``chaos_dispatch_fail_slot`` proves isolation (every
+    dispatch that slot participates in fails, so only its solo re-dispatch
+    keeps failing and only it finishes ``"error"``).
+
+    - ``chaos_dispatch_raise_round``  — raise ``ChaosError`` before round N
+      (once per process);
+    - ``chaos_dispatch_fail_slot``    — raise whenever this slot is active
+      (PERSISTENT, -1 = off);
+    - ``chaos_latency_round``         — sleep ``chaos_latency_s`` before
+      round N (once; drives the serve watchdog's stall detector);
+    - ``chaos_poison_logits_round``   — round N's decode dispatch runs the
+      NaN-poisoned program (once; drives the sampler's non-finite gate).
+    """
+
+    def __init__(self, r, sleep=time.sleep):
+        self.dispatch_raise_round = int(r.chaos_dispatch_raise_round)
+        self.fail_slot = int(r.chaos_dispatch_fail_slot)
+        self.latency_round = int(r.chaos_latency_round)
+        self.latency_s = float(r.chaos_latency_s)
+        self.poison_round = int(r.chaos_poison_logits_round)
+        self._sleep = sleep  # injectable so tests don't wall-clock wait
+        self.round = 0  # dispatch rounds seen so far (decode/verify only)
+        self._fired: set = set()
+
+    @property
+    def active(self) -> bool:
+        return (self.fail_slot >= 0
+                or any(s > 0 for s in (self.dispatch_raise_round,
+                                       self.latency_round,
+                                       self.poison_round)))
+
+    def _fire_once(self, event: str, at: int) -> bool:
+        if at > 0 and self.round == at and event not in self._fired:
+            self._fired.add(event)
+            return True
+        return False
+
+    def before_dispatch(self, kind: str, slots: list) -> None:
+        """Engine hook: called at the top of every host-facing dispatch with
+        the active slot indices. Latency fires before the exception faults
+        (a spike then a failure is the worst realistic ordering)."""
+        if kind not in ("decode", "verify"):
+            return
+        self.round += 1
+        if self._fire_once("latency", self.latency_round):
+            log0(f"chaos: {self.latency_s}s latency spike before dispatch "
+                 f"round {self.round}")
+            self._sleep(self.latency_s)
+        if self.fail_slot >= 0 and self.fail_slot in slots:
+            raise ChaosError(
+                f"chaos: persistent dispatch fault (slot {self.fail_slot} "
+                f"active, round {self.round})")
+        if self._fire_once("raise", self.dispatch_raise_round):
+            raise ChaosError(
+                f"chaos: injected dispatch exception at round {self.round}")
+
+    def poison_logits(self, kind: str) -> bool:
+        """Engine hook: whether THIS dispatch (the round ``before_dispatch``
+        just opened) should run the NaN-poisoned program. Consumes the
+        event."""
+        if kind not in ("decode", "verify"):
+            return False
+        if self._fire_once("poison", self.poison_round):
+            log0(f"chaos: poisoning dispatch round {self.round} logits")
+            return True
+        return False

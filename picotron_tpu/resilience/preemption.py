@@ -13,7 +13,8 @@ left untouched.
 from __future__ import annotations
 
 import signal
-from typing import Optional
+import threading
+from typing import Callable, Optional
 
 from picotron_tpu.utils import log0
 
@@ -68,6 +69,40 @@ class PreemptionGuard:
 
     def __exit__(self, *exc) -> None:
         self.uninstall()
+
+    def emergency_save(self, fn: Callable[[], None],
+                       timeout_s: float = 0.0) -> bool:
+        """Run the emergency checkpoint flush OFF the signal path: ``fn``
+        executes on a background thread and the caller joins it with a
+        deadline, so a save wedged on a dead mount delays the exit by at
+        most ``timeout_s`` seconds of the preemption grace window instead
+        of eating all of it (0 = wait forever — the save is worth more
+        than the exit). Atomicity is the save layer's job (orbax commits a
+        step by atomic directory rename; ``CheckpointManager`` mirrors the
+        same way), so an abandoned thread can never leave a half-step a
+        resume would trust. Returns True when ``fn`` completed in time;
+        its exception, if any, is re-raised on THIS thread (the caller's
+        error handling stays unchanged). False = deadline expired, the
+        daemon thread dies with the process."""
+        state: dict = {}
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 - relayed to caller
+                state["err"] = e
+
+        t = threading.Thread(target=run, name="emergency-save", daemon=True)
+        t.start()
+        t.join(timeout_s if timeout_s and timeout_s > 0 else None)
+        if t.is_alive():
+            log0(f"emergency save still running after {timeout_s}s "
+                 f"deadline; exiting without it (the last periodic "
+                 f"checkpoint stands)", flush=True)
+            return False
+        if "err" in state:
+            raise state["err"]
+        return True
 
 
 def was_preempted() -> bool:

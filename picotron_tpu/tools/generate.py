@@ -59,7 +59,8 @@ def _load_weights(args, cfg, engine):
         like = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             like, shardings)
-        mgr = ckpt.CheckpointManager(args.load_path)
+        mgr = ckpt.CheckpointManager(
+            args.load_path, mirror_dir=cfg.resilience.ckpt_mirror_dir)
         params, step, tokens = mgr.load_params(
             like, layout=(cfg.model.num_hidden_layers, 1))
         mgr.close()

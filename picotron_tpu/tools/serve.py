@@ -1,0 +1,669 @@
+"""Serving front end: a resilient stdlib-only HTTP server over the batcher.
+
+    python -m picotron_tpu.tools.serve --config exp.json \
+        --load-path checkpoints --port 8000
+
+The missing layer between ``ContinuousBatcher`` (a host-side scheduling
+loop) and "serves heavy traffic": admission control, load shedding, health
+surfaces, graceful drain, and a stall watchdog — the things that decide
+whether one bad request or one sick dispatch takes down every other
+request in flight (docs/SERVING.md). Stdlib only (``http.server``,
+``threading``, ``json``): the front end must not be the component with the
+exotic dependency.
+
+API (all bodies JSON):
+
+- ``POST /generate`` — ``{"prompt": [ids], "max_new_tokens", "temperature",
+  "top_k", "top_p", "eos_id", "timeout_s", "stream", "uid"}`` (all but
+  ``prompt`` optional). Non-streaming: one JSON document with ``tokens``
+  and ``finish_reason`` (``eos|length|timeout|shed|error``); HTTP status
+  200 for served outcomes, 503 + ``Retry-After`` when shed, 500 on
+  ``error``. ``"stream": true``: NDJSON events ``{"event":"token",...}``
+  per generated token, then one ``{"event":"done", ...}`` carrying the
+  full result.
+- ``GET /healthz`` — liveness: 200 while the dispatch loop is making
+  progress, 503 once the watchdog sees a stall (supervisors restart on
+  this, exactly like ``tools/supervise.py``'s heartbeat rule).
+- ``GET /readyz`` — readiness: 200 only when accepting work (503 while
+  draining or stalled — load balancers pull the replica first).
+- ``GET /statz`` — the batcher's ``stats()`` (terminal-state counters,
+  queue-wait / time-to-first-token percentiles) plus the server's
+  admission-rejection counters and drain/stall state.
+
+Admission control (checked atomically at POST time):
+
+- **bounded wait queue** — more than ``--max-queue`` waiting requests is a
+  503 (the queue is where latency hides; past the bound, waiting is worse
+  for the client than retrying another replica);
+- **token budget** — the worst-case token commitment (prompt +
+  ``max_new_tokens``) of every live request is capped by
+  ``--token-budget`` (default: ``slots * max_seq_len``, the cache's real
+  capacity); past it new work is a 429. Both carry ``Retry-After``.
+
+Graceful drain (the ``resilience.preemption.PreemptionGuard`` pattern):
+SIGTERM/SIGINT flips readiness, sheds the queued-but-unstarted requests
+(``finish_reason "shed"``), lets in-flight slots run to completion, then
+exits 0. A second signal aborts immediately (the operator means it).
+
+``--smoke`` is the ``make serve-smoke`` target: tiny CPU model, ephemeral
+port, one scripted client (health checks, a POST, a streamed POST, SIGTERM
+drain with accounting) — exits nonzero on any malfunction.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class AdmissionError(Exception):
+    """A request rejected at the door (shed before submission)."""
+
+    def __init__(self, status: int, reason: str, retry_after: int = 1):
+        super().__init__(reason)
+        self.status = status
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+class _Waiter:
+    """Per-request rendezvous between the dispatch loop and its HTTP
+    handler thread: token events stream through the queue, the final
+    GenerationResult ends it."""
+
+    def __init__(self):
+        self.events: queue.Queue = queue.Queue()
+
+    def put_token(self, tok: int) -> None:
+        self.events.put(("token", tok))
+
+    def put_done(self, result) -> None:
+        self.events.put(("done", result))
+
+
+class FrontEnd:
+    """Owns the batcher, the dispatch loop thread, and the watchdog.
+
+    All batcher access is serialized by ``_mu`` (the batcher is not
+    thread-safe); HTTP handler threads only touch it for the short
+    admission check + submit, the dispatch loop for step()/result
+    draining. ``guard`` is a ``PreemptionGuard`` (not installed here —
+    the CLI installs it on the main thread; tests drive ``begin_drain``
+    directly)."""
+
+    def __init__(self, engine, params, *, seed: int = 0,
+                 max_queue: int = 64, token_budget: Optional[int] = None,
+                 default_timeout_s: Optional[float] = None,
+                 stall_timeout_s: float = 60.0,
+                 watchdog_poll_s: float = 0.25,
+                 log=print):
+        from picotron_tpu.inference import ContinuousBatcher
+        from picotron_tpu.resilience.preemption import PreemptionGuard
+
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.token_budget = int(token_budget if token_budget is not None
+                                else engine.slots * engine.max_seq_len)
+        self.default_timeout_s = default_timeout_s
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.watchdog_poll_s = float(watchdog_poll_s)
+        self.guard = PreemptionGuard()
+        self._log = log
+        self._mu = threading.Lock()
+        self._wake = threading.Event()
+        self._waiters: dict = {}
+        self._batcher = ContinuousBatcher(engine, params, seed=seed,
+                                          on_token=self._on_token)
+        self.draining = False
+        self.stopped = threading.Event()  # dispatch loop has exited
+        self.stalled = False
+        self.stalls = 0  # stall episodes the watchdog flagged
+        self.rejections = {"queue_full": 0, "token_budget": 0,
+                           "draining": 0, "stalled": 0}
+        self._uid_seq = 0
+        self._start_t = time.monotonic()
+        self._progress_t = time.monotonic()
+        self._req_t: dict = {}  # uid -> wall submit time (request log)
+        self._threads: list = []
+        self._on_drained = None  # callback once drain completes (CLI: shutdown)
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        for name, fn in (("serve-dispatch", self._loop),
+                         ("serve-watchdog", self._watchdog)):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def begin_drain(self) -> None:
+        """Stop admitting, shed the unstarted queue, finish in-flight
+        slots, then stop the dispatch loop (readiness goes 503 at once)."""
+        if not self.draining:
+            self.draining = True
+            self._event("drain_begin")
+        self._wake.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self.stopped.wait(timeout)
+
+    # ---- admission --------------------------------------------------------
+
+    def submit(self, spec: dict) -> tuple:
+        """Admission-check + submit one request. Returns (uid, waiter) or
+        raises AdmissionError (the caller turns it into 429/503)."""
+        from picotron_tpu.inference import Request
+
+        prompt = spec.get("prompt")
+        if not isinstance(prompt, list) or not prompt \
+                or not all(isinstance(t, int) for t in prompt):
+            raise AdmissionError(400, "prompt must be a non-empty list of "
+                                      "token ids", retry_after=0)
+        timeout_s = spec.get("timeout_s", self.default_timeout_s)
+        try:
+            req = Request(
+                uid=str(spec.get("uid") or self._next_uid()),
+                prompt=list(prompt),
+                max_new_tokens=int(spec.get("max_new_tokens", 32)),
+                temperature=float(spec.get("temperature", 0.0)),
+                top_k=int(spec.get("top_k", 0)),
+                top_p=float(spec.get("top_p", 1.0)),
+                eos_id=spec.get("eos_id"),
+                timeout_s=None if timeout_s is None else float(timeout_s))
+        except (TypeError, ValueError) as e:
+            raise AdmissionError(400, f"bad request field: {e}",
+                                 retry_after=0)
+        cost = len(req.prompt) + req.max_new_tokens
+        # bounded wait for the batcher lock: during a wedged dispatch (the
+        # stall the watchdog flags) admission SHEDS instead of parking
+        # handler threads on the lock forever
+        if not self._mu.acquire(timeout=10.0):
+            self.rejections["stalled"] += 1
+            raise AdmissionError(
+                503, "dispatch stalled (admission unavailable)",
+                retry_after=10)
+        try:
+            if self.draining:
+                self.rejections["draining"] += 1
+                raise AdmissionError(
+                    503, "draining (restart in progress)", retry_after=5)
+            if self._batcher.queue_depth >= self.max_queue:
+                # the wait queue is bounded: past it, queueing only grows
+                # the client's latency — shed instead
+                self.rejections["queue_full"] += 1
+                raise AdmissionError(
+                    503, f"wait queue full ({self.max_queue})",
+                    retry_after=max(1, self.max_queue // 8))
+            if self._batcher.token_load() + cost > self.token_budget:
+                self.rejections["token_budget"] += 1
+                raise AdmissionError(
+                    429, f"token budget exhausted ({self.token_budget})",
+                    retry_after=1)
+            if req.uid in self._waiters:
+                raise AdmissionError(400, f"duplicate uid {req.uid!r}",
+                                     retry_after=0)
+            waiter = _Waiter()
+            self._waiters[req.uid] = waiter
+            self._req_t[req.uid] = time.monotonic()
+            try:
+                self._batcher.submit(req)  # validates prompt vs max_seq_len
+            except ValueError as e:
+                self._waiters.pop(req.uid, None)
+                self._req_t.pop(req.uid, None)
+                raise AdmissionError(400, str(e), retry_after=0)
+        finally:
+            self._mu.release()
+        self._wake.set()
+        return req.uid, waiter
+
+    def _next_uid(self) -> str:
+        with self._mu:
+            self._uid_seq += 1
+            return f"r{self._uid_seq}"
+
+    # ---- dispatch loop ----------------------------------------------------
+
+    def _on_token(self, uid: str, tok: int) -> None:
+        # called from inside batcher.step() (under _mu)
+        w = self._waiters.get(uid)
+        if w is not None:
+            w.put_token(tok)
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                if self.guard.triggered and not self.draining:
+                    self.begin_drain()
+                with self._mu:
+                    if self.draining:
+                        self._batcher.shed_pending()
+                    if self._batcher.busy:
+                        self._batcher.step()
+                    results = self._batcher.take_results()
+                    busy = self._batcher.busy
+                self._progress_t = time.monotonic()
+                for uid, res in results.items():
+                    self._deliver(uid, res)
+                if self.draining and not busy:
+                    self._event("drain_done")
+                    return
+                if not busy:
+                    self._wake.wait(0.05)
+                    self._wake.clear()
+        except BaseException as e:  # noqa: BLE001 - loop death is fatal news
+            self._event("dispatch_loop_died",
+                        error=f"{type(e).__name__}: {e}")
+            self.stalled = True  # healthz goes 503: supervisors restart us
+            raise
+        finally:
+            # never strand a blocked handler: whatever the loop's fate,
+            # every still-registered waiter gets a terminal "error" result
+            from picotron_tpu.inference.batcher import GenerationResult
+
+            for uid in list(self._waiters):
+                self._deliver(uid, GenerationResult(uid, [], [], "error"))
+            self.stopped.set()
+            if self._on_drained is not None:
+                self._on_drained()
+
+    def _deliver(self, uid: str, res) -> None:
+        t0 = self._req_t.pop(uid, None)
+        self._event(
+            "request", uid=uid, finish_reason=res.finish_reason,
+            prompt_tokens=len(res.prompt), new_tokens=len(res.tokens),
+            queue_wait_s=_r(res.queue_wait_s), ttft_s=_r(res.ttft_s),
+            total_s=_r(None if t0 is None else time.monotonic() - t0))
+        w = self._waiters.pop(uid, None)
+        if w is not None:
+            w.put_done(res)
+
+    def _watchdog(self) -> None:
+        """Dispatch-stall detector, the in-process mirror of
+        tools/supervise.py: while work exists, the loop must keep
+        finishing steps; a silent gap longer than the threshold flips
+        ``stalled`` (healthz 503 — the supervisor's restart signal).
+        Recovery (the next completed step) clears it."""
+        if self.stall_timeout_s <= 0:
+            return
+        while not self.stopped.is_set():
+            time.sleep(self.watchdog_poll_s)
+            busy = self._batcher.busy  # racy read: a threshold, not a ledger
+            age = time.monotonic() - self._progress_t
+            if busy and age > self.stall_timeout_s:
+                if not self.stalled:
+                    self.stalled = True
+                    self.stalls += 1
+                    self._event("stall", age_s=_r(age),
+                                threshold_s=self.stall_timeout_s)
+            elif self.stalled:
+                self.stalled = False
+                self._event("stall_recovered")
+
+    # ---- observability ----------------------------------------------------
+
+    def _event(self, evt: str, **fields) -> None:
+        """One structured (JSON) log line per server event."""
+        self._log(json.dumps({"evt": evt, "t": round(time.time(), 3),
+                              **fields}), flush=True)
+
+    def healthy(self) -> bool:
+        return not self.stalled
+
+    def ready(self) -> bool:
+        return not (self.draining or self.stalled)
+
+    def stats(self) -> dict:
+        # bounded wait: the stats an operator checks DURING a dispatch
+        # stall must answer, degraded, rather than park on the lock the
+        # stalled loop is holding
+        if self._mu.acquire(timeout=1.0):
+            try:
+                d = self._batcher.stats()
+            finally:
+                self._mu.release()
+        else:
+            d = {"snapshot": "partial (dispatch in progress)"}
+        d["rejected"] = dict(self.rejections)
+        d["draining"] = self.draining
+        d["stalled"] = self.stalled
+        d["stalls"] = self.stalls
+        d["uptime_s"] = round(time.monotonic() - self._start_t, 3)
+        return d
+
+
+def _r(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 6)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # close-delimited streaming: HTTP/1.0 responses end at connection close,
+    # which lets the token stream flush incrementally with zero framing code
+    protocol_version = "HTTP/1.0"
+
+    @property
+    def front(self) -> FrontEnd:
+        return self.server.front
+
+    def log_message(self, *a):  # the front end's JSON lines replace these
+        pass
+
+    def _json(self, status: int, payload: dict, headers=()) -> None:
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        f = self.front
+        if self.path == "/healthz":
+            ok = f.healthy()
+            self._json(200 if ok else 503,
+                       {"ok": ok, "stalled": f.stalled})
+        elif self.path == "/readyz":
+            ok = f.ready()
+            self._json(200 if ok else 503,
+                       {"ok": ok, "draining": f.draining,
+                        "stalled": f.stalled})
+        elif self.path == "/statz":
+            self._json(200, f.stats())
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:
+        if self.path != "/generate":
+            self._json(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            spec = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._json(400, {"error": f"bad request body: {e}"})
+            return
+        if not isinstance(spec, dict):
+            # valid JSON that is not an object ('[]', 'null', '3') must be
+            # a 400, not an AttributeError-dropped connection
+            self._json(400, {"error": "request body must be a JSON object"})
+            return
+        try:
+            uid, waiter = self.front.submit(spec)
+        except AdmissionError as e:
+            headers = ([("Retry-After", str(e.retry_after))]
+                       if e.retry_after else [])
+            self._json(e.status, {"error": e.reason, "shed": True}, headers)
+            return
+        if spec.get("stream"):
+            self._stream(uid, waiter)
+        else:
+            res = self._await_result(waiter)
+            payload = {"uid": uid, "tokens": list(res.tokens),
+                       "finish_reason": res.finish_reason,
+                       "queue_wait_s": _r(res.queue_wait_s),
+                       "ttft_s": _r(res.ttft_s)}
+            if res.finish_reason == "shed":
+                self._json(503, payload, [("Retry-After", "5")])
+            elif res.finish_reason == "error":
+                self._json(500, payload)
+            else:
+                self._json(200, payload)
+
+    def _await_result(self, waiter: _Waiter):
+        while True:
+            kind, val = waiter.events.get()
+            if kind == "done":
+                return val
+
+    def _stream(self, uid: str, waiter: _Waiter) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.end_headers()
+
+        def emit(obj):
+            self.wfile.write((json.dumps(obj) + "\n").encode())
+            self.wfile.flush()
+
+        while True:
+            kind, val = waiter.events.get()
+            try:
+                if kind == "token":
+                    emit({"event": "token", "uid": uid, "token": int(val)})
+                    continue
+                emit({"event": "done", "uid": uid,
+                      "tokens": list(val.tokens),
+                      "finish_reason": val.finish_reason,
+                      "queue_wait_s": _r(val.queue_wait_s),
+                      "ttft_s": _r(val.ttft_s)})
+            except (BrokenPipeError, ConnectionResetError):
+                # client went away: generation continues (the batcher owns
+                # the request; its per-request timeout_s bounds the waste),
+                # keep draining events so the waiter's queue empties
+                if kind == "done":
+                    return
+                continue
+            if kind == "done":
+                return
+
+
+class Server:
+    """FrontEnd + ThreadingHTTPServer, both on background threads. The
+    embedding entry point for the CLI, the smoke drive, and the tests."""
+
+    def __init__(self, engine, params, *, host: str = "127.0.0.1",
+                 port: int = 0, **front_kw):
+        self.front = FrontEnd(engine, params, **front_kw)
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.front = self.front
+        self.port = self.httpd.server_address[1]
+        self._http_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.front.start()
+        # once the dispatch loop finishes a drain, stop accepting sockets
+        self.front._on_drained = lambda: threading.Thread(
+            target=self.httpd.shutdown, daemon=True).start()
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="serve-http", daemon=True)
+        self._http_thread.start()
+
+    def drain_and_join(self, timeout: Optional[float] = None) -> None:
+        self.front.begin_drain()
+        self.front.join(timeout)
+        self.httpd.shutdown()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout)
+        self.httpd.server_close()
+
+
+# --------------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------------- #
+
+
+def _build_engine_and_params(args):
+    from picotron_tpu.config import Config
+    from picotron_tpu.inference import InferenceEngine
+    from picotron_tpu.tools.generate import SMOKE_CONFIG, _load_weights
+    from picotron_tpu.train import _ensure_devices
+
+    if args.smoke:
+        cfg = Config.from_dict(SMOKE_CONFIG)
+        args.random_init = True
+    elif args.config:
+        with open(args.config) as f:
+            cfg = Config.from_dict(json.load(f))
+    else:
+        raise SystemExit("pass --config (or --smoke)")
+    if not (args.load_path or args.hf_path or args.random_init):
+        raise SystemExit("pass one of --load-path / --hf-path / "
+                         "--random-init")
+    _ensure_devices(cfg)
+    from picotron_tpu.resilience.chaos import ServingChaos
+
+    chaos = ServingChaos(cfg.resilience)
+    hooks = chaos if chaos.active else None
+    engine = InferenceEngine(cfg, slots=args.slots,
+                             max_seq_len=args.max_seq_len, hooks=hooks)
+    params = _load_weights(args, cfg, engine)
+    return cfg, engine, params
+
+
+def _post(port: int, spec: dict, stream: bool = False):
+    """Minimal stdlib client for the smoke drive: returns (status,
+    parsed-JSON body) or, streaming, (status, [parsed NDJSON events])."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+    conn.request("POST", "/generate", json.dumps(spec),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if stream:
+        lines = [json.loads(l) for l in resp.read().splitlines() if l]
+        out = (resp.status, lines)
+    else:
+        out = (resp.status, json.loads(resp.read() or b"{}"))
+    conn.close()
+    return out
+
+
+def _get(port: int, path: str):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read() or b"{}"))
+    conn.close()
+    return out
+
+
+def _smoke(server: Server) -> int:
+    """The `make serve-smoke` drive: health, one POST, one streamed POST,
+    SIGTERM drain with full accounting. Returns an exit code."""
+    import os
+    import signal
+
+    port = server.port
+    fail = []
+
+    def check(name, ok):
+        print(f"serve-smoke: {name}: {'ok' if ok else 'FAIL'}", flush=True)
+        if not ok:
+            fail.append(name)
+
+    check("healthz", _get(port, "/healthz")[0] == 200)
+    check("readyz", _get(port, "/readyz")[0] == 200)
+
+    spec = {"prompt": [1, 2, 3, 4, 5], "max_new_tokens": 8}
+    st, body = _post(port, spec)
+    check("generate", st == 200 and len(body["tokens"]) == 8
+          and body["finish_reason"] == "length")
+
+    st, events = _post(port, {**spec, "stream": True}, stream=True)
+    done = [e for e in events if e["event"] == "done"]
+    toks = [e["token"] for e in events if e["event"] == "token"]
+    check("stream", st == 200 and len(done) == 1
+          and done[0]["tokens"] == toks
+          and done[0]["tokens"] == body["tokens"])  # greedy: deterministic
+
+    # drain: one slow request in flight + SIGTERM -> it finishes, the
+    # server stops admitting, and the exit is clean
+    slow: dict = {}
+
+    def bg():
+        slow["resp"] = _post(port, {"prompt": [7, 8, 9],
+                                    "max_new_tokens": 24})
+
+    t = threading.Thread(target=bg)
+    t.start()
+    time.sleep(0.2)  # let it admit
+    os.kill(os.getpid(), signal.SIGTERM)
+    server.front.join(timeout=120)
+    check("drain_finished", server.front.stopped.is_set())
+    t.join(timeout=120)
+    st, body = slow.get("resp", (None, {}))
+    check("inflight_served_through_drain",
+          st == 200 and body.get("finish_reason") == "length")
+    stats = server.front.stats()
+    # every admitted request reached a terminal state and nothing leaked
+    terminal = stats["completed"] + stats["expired"] + stats["errored"]
+    check("accounting", terminal == stats["admitted"] == 3
+          and stats["queued"] == 0 and stats["active_slots"] == 0)
+    check("no_stalls", stats["stalls"] == 0)
+    return 1 if fail else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="HTTP serving front end over the continuous batcher "
+                    "(admission control, load shedding, graceful drain)")
+    ap.add_argument("--config", help="training config.json (model shape, tp)")
+    ap.add_argument("--load-path", default="", help="orbax checkpoint dir")
+    ap.add_argument("--hf-path", default="", help="HF safetensors file/dir")
+    ap.add_argument("--random-init", action="store_true",
+                    help="seed-derived random weights (smoke)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000,
+                    help="0 = ephemeral (printed at startup)")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--max-queue", type=int, default=64,
+                    help="bounded wait queue: excess submissions get 503")
+    ap.add_argument("--token-budget", type=int, default=None,
+                    help="cap on live prompt+generation tokens (default: "
+                         "slots * max_seq_len); excess gets 429")
+    ap.add_argument("--default-timeout-s", type=float, default=None,
+                    help="per-request wall-clock deadline when the request "
+                         "does not set one (finish_reason 'timeout')")
+    ap.add_argument("--stall-timeout", type=float, default=60.0,
+                    help="dispatch-stall watchdog threshold (0 = off); a "
+                         "stall flips /healthz to 503")
+    ap.add_argument("--smoke", action="store_true",
+                    help="built-in tiny CPU model + scripted client drive "
+                         "(the `make serve-smoke` target)")
+    args = ap.parse_args(argv)
+
+    cfg, engine, params = _build_engine_and_params(args)
+
+    server = Server(
+        engine, params, host=args.host,
+        port=0 if args.smoke else args.port, seed=args.seed,
+        max_queue=args.max_queue, token_budget=args.token_budget,
+        default_timeout_s=args.default_timeout_s,
+        stall_timeout_s=args.stall_timeout)
+    # SIGTERM/SIGINT -> graceful drain (the PreemptionGuard pattern: first
+    # signal is cooperative, second aborts). Installed on the main thread.
+    server.front.guard.install()
+    server.start()
+    server.front._event(
+        "serving", port=server.port, slots=engine.slots,
+        max_seq_len=engine.max_seq_len, max_queue=args.max_queue,
+        token_budget=server.front.token_budget,
+        attend_impl=engine.attend_impl,
+        kv=str(engine.cache_dtype), tp=engine.topo.tp_size)
+
+    if args.smoke:
+        rc = _smoke(server)
+        print(f"serve-smoke: {'PASS' if rc == 0 else 'FAIL'}", flush=True)
+        return rc
+
+    # foreground: wait for the drain (SIGTERM) to complete
+    try:
+        while not server.front.stopped.is_set():
+            server.front.join(timeout=1.0)
+    except KeyboardInterrupt:
+        pass  # second signal: abort now
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

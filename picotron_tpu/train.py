@@ -180,7 +180,8 @@ def train(cfg, max_steps_override: Optional[int] = None,
         if c.save_frequency > 0 or resume_dir:
             manager = ckpt_mod.CheckpointManager(
                 resume_dir or c.save_dir, io_attempts=r.io_attempts,
-                io_backoff=r.io_backoff, io_jitter=r.io_jitter)
+                io_backoff=r.io_backoff, io_jitter=r.io_jitter,
+                mirror_dir=r.ckpt_mirror_dir)
         if manager is not None and resume_dir and (
                 resume_required or manager.latest_step() is not None):
             params, opt_state, step, trained_tokens = manager.load(
@@ -197,7 +198,8 @@ def train(cfg, max_steps_override: Optional[int] = None,
                 manager.close()
                 manager = ckpt_mod.CheckpointManager(
                     c.save_dir, io_attempts=r.io_attempts,
-                    io_backoff=r.io_backoff, io_jitter=r.io_jitter)
+                    io_backoff=r.io_backoff, io_jitter=r.io_jitter,
+                    mirror_dir=r.ckpt_mirror_dir)
 
         # wandb/log gating: only the controller process reports (reference
         # train.py:101, utils.py:12-20)
@@ -347,6 +349,7 @@ def train(cfg, max_steps_override: Optional[int] = None,
         if profiling:
             jax.profiler.stop_trace()
         guard.uninstall()
+        flush_abandoned = False
         try:
             # the emergency/final flush: reached on clean completion,
             # preemption, AND any crash — a run never loses more than the
@@ -354,12 +357,27 @@ def train(cfg, max_steps_override: Optional[int] = None,
             # state, in which case the last periodic checkpoint stands)
             if (manager is not None and c.save_frequency > 0 and r.save_on_exit
                     and step > last_saved_step and _savable(params, opt_state)):
-                manager.save(step, params, opt_state, trained_tokens,
-                             layout=layout, zero1=z1,
-                             data_meta=loader.state_meta(step))
-                utils.log0(f"flushed checkpoint at step {step}", flush=True)
+                def _flush():
+                    manager.save(step, params, opt_state, trained_tokens,
+                                 layout=layout, zero1=z1,
+                                 data_meta=loader.state_meta(step))
+
+                if guard.triggered:
+                    # preemption path: the flush runs on a background
+                    # thread, joined with a deadline — a wedged save costs
+                    # at most emergency_save_timeout_s of the grace window
+                    if guard.emergency_save(
+                            _flush, timeout_s=r.emergency_save_timeout_s):
+                        utils.log0(f"flushed emergency checkpoint at step "
+                                   f"{step}", flush=True)
+                    else:
+                        flush_abandoned = True
+                else:
+                    _flush()
+                    utils.log0(f"flushed checkpoint at step {step}",
+                               flush=True)
         finally:
-            if manager is not None:
+            if manager is not None and not flush_abandoned:
                 try:
                     manager.close()  # drains any in-flight async save
                 except Exception as e:

@@ -105,6 +105,38 @@ def test_anomaly_detector_flags_nonfinite_and_spikes():
     assert det.observe(11, 500.0) is None  # post-reset: re-warming, not judged
 
 
+def test_emergency_save_runs_off_signal_path_with_deadline():
+    """The preemption flush runs on a background thread joined with a
+    deadline: a completing save reports True (and re-raises its error on
+    the caller's thread), a wedged save reports False after the deadline
+    instead of eating the grace window."""
+    import threading
+
+    guard = PreemptionGuard()  # not installed: pure helper surface
+    ran = {}
+
+    def save():
+        ran["thread"] = threading.current_thread().name
+        ran["done"] = True
+
+    assert guard.emergency_save(save, timeout_s=30.0) is True
+    assert ran["done"] and ran["thread"] == "emergency-save"
+
+    # the save's own failure surfaces on the CALLER's thread, unchanged
+    def boom():
+        raise OSError("mount died")
+
+    with pytest.raises(OSError, match="mount died"):
+        guard.emergency_save(boom, timeout_s=30.0)
+
+    # a wedged save: the join deadline expires and the exit proceeds
+    release = threading.Event()
+    t0 = __import__("time").monotonic()
+    assert guard.emergency_save(release.wait, timeout_s=0.2) is False
+    assert __import__("time").monotonic() - t0 < 5.0
+    release.set()  # unwedge the daemon thread before the test exits
+
+
 def test_preemption_guard_flags_sigterm_and_restores_handlers():
     prev = signal.getsignal(signal.SIGTERM)
     guard = PreemptionGuard().install()

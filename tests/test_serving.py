@@ -1,0 +1,513 @@
+"""Serving resilience suite (ISSUE 6; docs/SERVING.md).
+
+The serving stack's fault surfaces, each with a deterministic chaos
+trigger and a bit-for-bit oracle where one exists:
+
+- the sampler's non-finite gate (greedy over a sanitized distribution);
+- dispatch retry (a transient exception costs nothing — outputs equal a
+  fault-free run exactly);
+- slot-failure isolation (a persistently failing slot finishes "error";
+  SURVIVING slots' outputs are bit-identical to a fault-free run; no slot
+  or queue entry leaks);
+- flash->dense graceful degradation (process-wide, logged once,
+  generation equals a dense engine's bit-for-bit);
+- the HTTP front end (tools/serve.py): admission control (bounded queue
+  503, token budget 429, Retry-After), streaming, SIGTERM-style drain
+  with shed accounting, the stall watchdog, /healthz //readyz //statz;
+- the serve-chaos acceptance: dispatch-exception + latency-spike +
+  poisoned-logits faults in one run — no hangs, every submitted request
+  terminates with an accounted finish_reason, unaffected requests
+  bit-identical to a chaos-off run.
+
+``make serve-chaos-smoke`` runs exactly this file.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_config
+from picotron_tpu.inference import (
+    ContinuousBatcher,
+    InferenceEngine,
+    Request,
+    sampling,
+)
+from picotron_tpu.models import llama
+from picotron_tpu.resilience.chaos import ChaosError, ServingChaos
+from picotron_tpu.tools import serve
+
+MAX_LEN = 64
+
+
+def _res(**kw):
+    """A ResilienceConfig with serving-chaos overrides."""
+    cfg = make_config(dict(_TINY))
+    for k, v in kw.items():
+        setattr(cfg.resilience, k, v)
+    cfg.validate()
+    return cfg.resilience
+
+
+_TINY = dict(
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    hidden_size=32, intermediate_size=64, vocab_size=128,
+    max_position_embeddings=MAX_LEN, rope_theta=10000.0, dtype="float32",
+    attention_impl="sdpa")
+
+
+def _engine(slots=3, hooks=None, **inf):
+    cfg = make_config(dict(_TINY), seq=32)
+    for k, v in inf.items():
+        setattr(cfg.inference, k, v)
+    engine = InferenceEngine(cfg, slots=slots, max_seq_len=MAX_LEN,
+                             hooks=hooks)
+    params = engine.shard_params(jax.jit(
+        lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
+    return cfg, engine, params
+
+
+def _requests(n=3, temperature=0.0, max_new=8):
+    # even-indexed requests carry the stochastic sampling params, so in the
+    # isolation test (slot 1 faulted) the SURVIVORS include a sampled row
+    return [Request(f"q{i}", [3 + i, 7 + i, 11 + i], max_new_tokens=max_new,
+                    temperature=0.0 if i % 2 else temperature)
+            for i in range(n)]
+
+
+# --------------------------------------------------------------------------- #
+# sampler non-finite gate
+# --------------------------------------------------------------------------- #
+
+
+def test_sampler_nonfinite_gate_greedy_over_sanitized():
+    """Rows with non-finite logits emit the argmax of the FINITE entries
+    (token 0 when nothing survives) on both the greedy and the stochastic
+    path; finite rows are bit-identical to the ungated sampler."""
+    V = 16
+    logits = np.zeros((4, V), np.float32)
+    logits[0, 5] = 3.0                      # finite row
+    logits[1, 7] = 2.0
+    logits[1, 9] = np.nan                   # partially poisoned
+    logits[2, :] = np.nan                   # fully poisoned
+    logits[3, 11] = np.inf                  # +inf: also non-finite
+    logits[3, 4] = 2.0                      # ...the finite max beneath it
+    key = jax.random.PRNGKey(0)
+
+    for temp in (0.0, 0.7):
+        t = np.full(4, temp, np.float32)
+        toks = np.asarray(sampling.sample(
+            jnp.asarray(logits), key, t, np.zeros(4, np.int32),
+            np.ones(4, np.float32)))
+        assert toks[1] == 7      # NaN masked; argmax of the finite rest
+        assert toks[2] == 0      # whole row bad -> the defined fallback
+        assert toks[3] == 4      # inf masked; 4 is the finite max
+        assert 0 <= toks[0] < V
+    # finite-only input: the gate is the identity (greedy chain unchanged)
+    clean = logits[:1]
+    a = sampling.sample(jnp.asarray(clean), key, np.zeros(1, np.float32),
+                        np.zeros(1, np.int32), np.ones(1, np.float32))
+    assert int(a[0]) == 5
+
+
+def test_poisoned_logits_round_emits_defined_tokens():
+    """chaos_poison_logits_round: the poisoned dispatch's tokens are
+    defined (the gate's greedy fallback), generation continues, and the
+    request terminates normally — NaN never reaches the emitted stream."""
+    chaos = ServingChaos(_res(chaos_poison_logits_round=2))
+    cfg, engine, params = _engine(slots=2, hooks=chaos, decode_block_len=2)
+    res = ContinuousBatcher(engine, params).run(_requests(2, max_new=10))
+    for r in res.values():
+        assert r.finish_reason == "length"
+        assert len(r.tokens) == 10
+        assert all(0 <= t < cfg.model.vocab_size for t in r.tokens)
+    assert chaos.round >= 2  # the poison round actually ran
+
+
+# --------------------------------------------------------------------------- #
+# dispatch retry + slot isolation
+# --------------------------------------------------------------------------- #
+
+
+def test_transient_dispatch_exception_is_retried_bit_identical():
+    """One injected dispatch exception (chaos_dispatch_raise_round) is
+    absorbed by the retry: every output equals the fault-free run exactly
+    — including the sampled (temperature > 0) streams, because the round's
+    keys are drawn before the dispatch and reused by the retry."""
+    reqs = _requests(3, temperature=0.8, max_new=20)  # >= 3 decode rounds
+    _, e0, p0 = _engine()
+    clean = ContinuousBatcher(e0, p0, seed=5).run(
+        [Request(**vars(r)) for r in reqs])
+
+    chaos = ServingChaos(_res(chaos_dispatch_raise_round=2))
+    _, e1, p1 = _engine(hooks=chaos)
+    b = ContinuousBatcher(e1, p1, seed=5)
+    res = b.run([Request(**vars(r)) for r in reqs])
+
+    assert chaos.round >= 2
+    for uid in clean:
+        assert res[uid].tokens == clean[uid].tokens
+        assert res[uid].finish_reason == clean[uid].finish_reason
+    assert b.counters["errored"] == 0
+    assert b.counters["completed"] == 3
+
+
+def test_slot_failure_isolation_mid_decode_block():
+    """A slot whose dispatches persistently fail
+    (chaos_dispatch_fail_slot) finishes "error"; SURVIVING slots' outputs
+    are bit-identical to a fault-free run (greedy AND sampled rows); no
+    slot or queue entry leaks."""
+    reqs = _requests(3, temperature=0.8, max_new=10)
+    _, e0, p0 = _engine()
+    clean = ContinuousBatcher(e0, p0, seed=7).run(
+        [Request(**vars(r)) for r in reqs])
+
+    chaos = ServingChaos(_res(chaos_dispatch_fail_slot=1))
+    _, e1, p1 = _engine(hooks=chaos)
+    b = ContinuousBatcher(e1, p1, seed=7)
+    res = b.run([Request(**vars(r)) for r in reqs])
+
+    # q1 was admitted into slot 1: it errors with only its prefill-time
+    # first token (identical to the clean run's first token)
+    assert res["q1"].finish_reason == "error"
+    assert res["q1"].tokens == clean["q1"].tokens[:1]
+    # survivors: bit-identical streams
+    for uid in ("q0", "q2"):
+        assert res[uid].finish_reason == clean[uid].finish_reason
+        assert res[uid].tokens == clean[uid].tokens
+    # no leaks: every slot free, nothing queued, cache lengths zeroed,
+    # and the accounting adds up
+    assert all(s is None for s in b._slots)
+    assert b.queue_depth == 0
+    np.testing.assert_array_equal(np.asarray(b._cache["lengths"]), 0)
+    assert b.counters["errored"] == 1
+    assert b.counters["completed"] == 2
+    assert b.counters["admitted"] == 3
+
+
+def test_prefill_failure_costs_only_the_incoming_request():
+    """A persistently failing prefill finishes ONLY the request being
+    admitted ("error"); everyone already decoding — and everyone admitted
+    after — is untouched (greedy oracle: identical tokens)."""
+
+    class PrefillBomb:
+        """Fails the 2nd prefill dispatch persistently (both attempts)."""
+
+        def __init__(self):
+            self.calls = 0
+
+        def before_dispatch(self, kind, slots):
+            if kind != "prefill":
+                return
+            self.calls += 1
+            if self.calls in (2, 3):  # attempt + its retry
+                raise ChaosError("prefill bomb")
+
+        def poison_logits(self, kind):
+            return False
+
+    reqs = _requests(3, max_new=6)
+    _, e0, p0 = _engine(slots=2)
+    clean = ContinuousBatcher(e0, p0).run(
+        [Request(**vars(r)) for r in reqs])
+
+    _, e1, p1 = _engine(slots=2, hooks=PrefillBomb())
+    b = ContinuousBatcher(e1, p1)
+    res = b.run([Request(**vars(r)) for r in reqs])
+
+    assert res["q1"].finish_reason == "error" and res["q1"].tokens == []
+    for uid in ("q0", "q2"):
+        assert res[uid].tokens == clean[uid].tokens
+        assert res[uid].finish_reason == clean[uid].finish_reason
+    assert all(s is None for s in b._slots) and b.queue_depth == 0
+    assert b.counters == {"admitted": 3, "completed": 2, "expired": 0,
+                          "errored": 1, "shed": 0}
+
+
+def test_batcher_stats_counters_and_percentiles():
+    _, engine, params = _engine(slots=2)
+    b = ContinuousBatcher(engine, params)
+    b.run(_requests(3, max_new=4))
+    s = b.stats()
+    assert s["admitted"] == s["completed"] == 3
+    assert s["queued"] == 0 and s["active_slots"] == 0
+    assert s["queue_wait_s"]["n"] == 3 and s["ttft_s"]["n"] == 3
+    assert s["ttft_s"]["p50"] >= s["queue_wait_s"]["p50"] >= 0.0
+    assert s["generated_tokens"] == 12
+
+
+# --------------------------------------------------------------------------- #
+# flash -> dense graceful degradation
+# --------------------------------------------------------------------------- #
+
+
+def test_flash_failure_falls_back_to_dense_for_the_process(
+        monkeypatch, capsys):
+    import picotron_tpu.inference.engine as eng_mod
+    import picotron_tpu.ops.pallas.decode_attention as da
+
+    monkeypatch.setattr(eng_mod, "_FLASH_BROKEN", False)
+
+    def kaput(*a, **kw):
+        raise RuntimeError("flash kernel kaput")
+
+    monkeypatch.setattr(da, "flash_decode_attention", kaput)
+
+    reqs = _requests(2, max_new=6)
+    _, e0, p0 = _engine(slots=2)  # dense oracle
+    clean = ContinuousBatcher(e0, p0).run(
+        [Request(**vars(r)) for r in reqs])
+
+    _, e1, p1 = _engine(slots=2, attend_impl="flash")
+    assert e1.attend_impl == "flash"
+    res = ContinuousBatcher(e1, p1).run(
+        [Request(**vars(r)) for r in reqs])
+    # degraded transparently: same results as a dense engine, flipped impl
+    assert e1.attend_impl == "dense"
+    for uid in clean:
+        assert res[uid].tokens == clean[uid].tokens
+    out = capsys.readouterr().out
+    assert out.count("falling back to 'dense'") == 1
+    # the latch is process-wide: a NEW flash engine starts on dense
+    assert eng_mod._FLASH_BROKEN
+    _, e2, _ = _engine(slots=2, attend_impl="flash")
+    assert e2.attend_impl == "dense"
+    # with the fallback disabled there is no silent degradation: the
+    # failure lands in the batcher's slot recovery instead (requests
+    # error, the engine stays on flash, the process survives)
+    monkeypatch.setattr(eng_mod, "_FLASH_BROKEN", False)
+    _, e3, p3 = _engine(slots=2, attend_impl="flash",
+                        attend_fallback=False)
+    res3 = ContinuousBatcher(e3, p3).run(
+        [Request("x", [1, 2], max_new_tokens=2)])
+    assert res3["x"].finish_reason == "error"
+    assert e3.attend_impl == "flash"
+
+
+# --------------------------------------------------------------------------- #
+# HTTP front end
+# --------------------------------------------------------------------------- #
+
+
+def _server(slots=2, hooks=None, inf=(), **front_kw):
+    cfg, engine, params = _engine(slots=slots, hooks=hooks, **dict(inf))
+    front_kw.setdefault("log", lambda *a, **k: None)
+    srv = serve.Server(engine, params, port=0, **front_kw)
+    srv.start()
+    return cfg, srv
+
+
+def test_http_generate_stream_health_and_stats():
+    cfg, srv = _server()
+    try:
+        port = srv.port
+        assert serve._get(port, "/healthz")[0] == 200
+        assert serve._get(port, "/readyz")[0] == 200
+
+        spec = {"prompt": [1, 2, 3], "max_new_tokens": 6}
+        st, body = serve._post(port, spec)
+        assert st == 200 and body["finish_reason"] == "length"
+        assert len(body["tokens"]) == 6
+        assert body["queue_wait_s"] is not None
+
+        st, events = serve._post(port, {**spec, "stream": True},
+                                 stream=True)
+        assert st == 200
+        toks = [e["token"] for e in events if e["event"] == "token"]
+        done = [e for e in events if e["event"] == "done"]
+        assert len(done) == 1 and done[0]["tokens"] == toks
+        assert toks == body["tokens"]  # greedy: deterministic across posts
+
+        st, stats = serve._get(port, "/statz")
+        assert st == 200
+        assert stats["completed"] == stats["admitted"] == 2
+        assert stats["rejected"] == {"queue_full": 0, "token_budget": 0,
+                                     "draining": 0, "stalled": 0}
+        assert not stats["draining"] and not stats["stalled"]
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+def _poll_statz(port, cond, deadline_s=10.0):
+    """Poll /statz until ``cond(stats)`` holds (returns the stats) or the
+    deadline passes (raises)."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        stats = serve._get(port, "/statz")[1]
+        if cond(stats):
+            return stats
+        if time.monotonic() > deadline:
+            raise AssertionError(f"statz condition never held: {stats}")
+        time.sleep(0.01)
+
+
+def test_http_admission_bounds_shed_with_retry_after():
+    # token budget first: one live request exhausts it. The slow request
+    # runs per-token (block 1) with a big budget, so it is live for many
+    # lock-release windows; its COMMITMENT counts from submission (queued
+    # or slotted), so the second POST is over budget the moment /statz
+    # shows the first one live.
+    cfg, srv = _server(token_budget=70, max_queue=8,
+                       inf={"decode_block_len": 1})
+    try:
+        port = srv.port
+        results = {}
+
+        def bg(name, spec):
+            results[name] = serve._post(port, spec)
+
+        t = threading.Thread(target=bg, args=(
+            "a", {"prompt": [1, 2, 3], "max_new_tokens": 58,
+                  "uid": "slow"}))
+        t.start()  # cost 61 of 70
+        # .get: while the first dispatch compiles, /statz may answer with
+        # the degraded (lock-free) snapshot, which has no counters
+        _poll_statz(port,
+                    lambda s: s.get("admitted", 0) + s.get("queued", 0) >= 1)
+        st, body = serve._post(port, {"prompt": [5, 6, 7],
+                                      "max_new_tokens": 8})  # cost 11
+        assert st == 429 and body["shed"]
+        t.join(60)
+        assert results["a"][0] == 200
+        st, stats = serve._get(port, "/statz")
+        assert stats["rejected"]["token_budget"] == 1
+    finally:
+        srv.drain_and_join(timeout=60)
+
+    # bounded wait queue: depth 0 sheds every submission outright
+    cfg, srv = _server(max_queue=0)
+    try:
+        st, body = serve._post(srv.port, {"prompt": [1], "max_new_tokens": 2})
+        assert st == 503 and body["shed"]
+        assert serve._get(srv.port, "/statz")[1]["rejected"]["queue_full"] == 1
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+def test_http_drain_finishes_inflight_and_sheds_queued():
+    # token_budget above the default slots*max_seq_len: "b" must reach the
+    # QUEUE (and be shed by the drain), not bounce off the budget gate
+    cfg, srv = _server(slots=1, token_budget=256,
+                       inf={"decode_block_len": 1})
+    try:
+        port = srv.port
+        results = {}
+
+        def bg(name, spec):
+            results[name] = serve._post(port, spec)
+
+        ta = threading.Thread(target=bg, args=(
+            "a", {"prompt": [1, 2, 3], "max_new_tokens": 59}))
+        ta.start()
+        _poll_statz(port, lambda s: s.get("admitted", 0) >= 1)  # "a" slotted
+        tb = threading.Thread(target=bg, args=(
+            "b", {"prompt": [4, 5], "max_new_tokens": 4}))
+        tb.start()
+        # "b" can only wait in the queue (one slot, "a" decoding per-token)
+        _poll_statz(port, lambda s: s.get("queued", 0) >= 1)
+        srv.front.begin_drain()
+        assert serve._get(port, "/readyz")[0] == 503
+        ta.join(60)
+        tb.join(60)
+        # in-flight finished intact; queued-but-unstarted was shed
+        assert results["a"][0] == 200
+        assert results["a"][1]["finish_reason"] == "length"
+        assert len(results["a"][1]["tokens"]) == 59
+        assert results["b"][0] == 503
+        assert results["b"][1]["finish_reason"] == "shed"
+        # post-drain: submissions are rejected, the loop has exited
+        srv.front.join(timeout=60)
+        assert srv.front.stopped.is_set()
+        stats = srv.front.stats()
+        assert stats["shed"] == 1 and stats["completed"] >= 1
+        assert stats["queued"] == 0 and stats["active_slots"] == 0
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+def test_watchdog_flags_latency_stall_and_recovers():
+    chaos = ServingChaos(_res(chaos_latency_round=2, chaos_latency_s=0.8))
+    cfg, srv = _server(hooks=chaos, stall_timeout_s=0.15,
+                       watchdog_poll_s=0.03)
+    try:
+        st, body = serve._post(srv.port, {"prompt": [1, 2, 3],
+                                          "max_new_tokens": 16})
+        assert st == 200 and len(body["tokens"]) == 16  # spike, no hang
+        # the flag and its recovery are the watchdog thread's writes —
+        # poll for both (its next tick clears `stalled` once steps resume)
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and not (srv.front.stalls >= 1 and not srv.front.stalled)):
+            time.sleep(0.02)
+        assert srv.front.stalls >= 1     # the spike was flagged...
+        assert not srv.front.stalled     # ...and recovery cleared it
+        assert serve._get(srv.port, "/healthz")[0] == 200
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+# --------------------------------------------------------------------------- #
+# the serve-chaos acceptance: all three faults in one run
+# --------------------------------------------------------------------------- #
+
+
+def test_chaos_run_accounts_everything_and_spares_the_unaffected():
+    """Dispatch-exception + latency-spike + poisoned-logits in one server:
+    no hangs, every submitted request terminates with an accounted
+    finish_reason, and requests that ran AFTER the fault window are
+    bit-identical to a chaos-off run."""
+    batch_a = [{"prompt": [2 + i, 9 + i], "max_new_tokens": 6,
+                "uid": f"a{i}"} for i in range(3)]
+    batch_b = [{"prompt": [30 + i, 40 + i, 50 + i], "max_new_tokens": 5,
+                "uid": f"b{i}"} for i in range(3)]
+
+    # chaos-off oracle for the unaffected batch (greedy: prompt-determined)
+    cfg, srv = _server(slots=2, inf={"decode_block_len": 2})
+    try:
+        want_b = {s["uid"]: serve._post(srv.port, s)[1]["tokens"]
+                  for s in batch_b}
+    finally:
+        srv.drain_and_join(timeout=60)
+
+    chaos = ServingChaos(_res(
+        chaos_dispatch_raise_round=2, chaos_latency_round=3,
+        chaos_latency_s=0.1, chaos_poison_logits_round=4))
+    cfg, srv = _server(slots=2, hooks=chaos, inf={"decode_block_len": 2})
+    try:
+        port = srv.port
+        results = {}
+
+        def bg(spec):
+            results[spec["uid"]] = serve._post(port, spec)
+
+        threads = [threading.Thread(target=bg, args=(s,)) for s in batch_a]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        # all three faults fired during batch A
+        assert chaos._fired >= {"raise", "latency", "poison"}
+        for s in batch_a:  # no hangs: every request terminated, accounted
+            st, body = results[s["uid"]]
+            assert st in (200, 500)
+            assert body["finish_reason"] in ("eos", "length", "timeout",
+                                             "shed", "error")
+        # batch B runs after the fault window: bit-identical to chaos-off
+        for s in batch_b:
+            st, body = serve._post(port, s)
+            assert st == 200
+            assert body["tokens"] == want_b[s["uid"]]
+        stats = srv.front.stats()
+        terminal = (stats["completed"] + stats["expired"]
+                    + stats["errored"])
+        assert terminal == stats["admitted"] == 6
+        assert stats["shed"] == 0 and stats["queued"] == 0
+        assert stats["active_slots"] == 0
+        assert serve._get(port, "/healthz")[0] == 200
+    finally:
+        srv.drain_and_join(timeout=60)
