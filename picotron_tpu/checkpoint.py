@@ -144,7 +144,8 @@ class CheckpointManager:
         # the same max_to_keep window as the primary.
         self.mirror_dir = os.path.abspath(mirror_dir) if mirror_dir else ""
         self._mirror_mgr = None
-        self._mirror_thread = None
+        self._mirror_q = None  # lazily-started worker's step queue
+        self._mirror_errs: list = []
         self._max_to_keep = max_to_keep
         # retrying I/O (resilience): transient NFS/GCS flakes on save/restore
         # are retried with exponential backoff before surfacing
@@ -205,36 +206,86 @@ class CheckpointManager:
             self._spawn_mirror(step)
 
     def _spawn_mirror(self, step: int) -> None:
-        """Replicate ``step`` on a background thread: wait out the async
-        primary write first (mirroring an in-flight write would just copy
-        the corruption it exists to survive), then copy + atomic rename,
-        retried. One replication in flight at a time; its failure warns at
-        the next join instead of killing the step that enqueued it."""
+        """Hand ``step`` to the background mirror worker, which waits out
+        the async primary write first (mirroring an in-flight write would
+        just copy the corruption it exists to survive), then copies +
+        atomic-renames, retried. Enqueue only: the training hot path never
+        waits on a previous replication (a slow mirror mount makes the
+        mirror LAG, not the run stall). Failures warn at the next join;
+        readers (restore fallback, wait_until_finished, close) join the
+        queue so they only ever see complete steps."""
+        import queue
         import threading
 
-        self._join_mirror()
-        state: dict = {}
+        if self._mirror_q is None:
+            self._mirror_q = queue.Queue()
+            t = threading.Thread(target=self._mirror_worker,
+                                 name="ckpt-mirror", daemon=True)
+            t.start()
+        self._mirror_q.put(step)
 
-        def run():
+    def _mirror_worker(self) -> None:
+        import queue
+
+        while True:
+            batch = [self._mirror_q.get()]
             try:
-                self.manager.wait_until_finished()
-                self._retry(partial(self._replicate_step, step),
-                            desc=f"mirror step {step}")
-            except Exception as e:  # noqa: BLE001 - surfaced at join
-                state["err"] = e
-
-        t = threading.Thread(target=run, name="ckpt-mirror", daemon=True)
-        t._mirror_state = state
-        t.start()
-        self._mirror_thread = t
+                while True:  # drain the backlog accumulated while copying
+                    batch.append(self._mirror_q.get_nowait())
+            except queue.Empty:
+                pass
+            try:
+                # only the newest max_to_keep backlog steps can survive
+                # the mirror's own pruning window: older entries would be
+                # full (multi-GB) copies deleted by the very next
+                # replication — skip them instead of compounding the lag
+                live = batch[-self._max_to_keep:]
+                stale = batch[:-self._max_to_keep]
+                if stale:
+                    warnings.warn(
+                        f"checkpoint mirror lagging: skipping superseded "
+                        f"steps {stale} (newer saves already queued)",
+                        RuntimeWarning)
+                for step in live:
+                    try:
+                        self.manager.wait_until_finished()
+                        if not os.path.isdir(os.path.join(self.directory,
+                                                          str(step))):
+                            # pruned by the primary's max_to_keep window
+                            # while it waited: it cannot be replicated —
+                            # skip, don't burn retries on a vanished dir
+                            raise FileNotFoundError(
+                                f"mirror lagging: primary step {step} "
+                                f"was pruned before replication")
+                        self._retry(partial(self._replicate_step, step),
+                                    desc=f"mirror step {step}")
+                    except Exception as e:  # noqa: BLE001
+                        # warn NOW — an operator must hear that the second
+                        # storage tier is stale when it happens, not at the
+                        # next reader join (possibly end of run); the
+                        # bounded list re-surfaces it to that reader too
+                        warnings.warn(
+                            f"checkpoint mirror replication of step {step} "
+                            f"failed ({type(e).__name__}: {e}); the mirror "
+                            f"tier is stale", RuntimeWarning)
+                        if len(self._mirror_errs) < 8:
+                            self._mirror_errs.append(e)
+            except BaseException as e:  # noqa: BLE001 - the worker must live
+                # e.g. warnings promoted to errors (-W error): a dead worker
+                # would strand queued entries and deadlock every later
+                # _mirror_q.join() (readers, close()) — record and continue
+                if len(self._mirror_errs) < 8:
+                    self._mirror_errs.append(e)
+            finally:
+                for _ in batch:
+                    self._mirror_q.task_done()
 
     def _join_mirror(self) -> None:
-        t, self._mirror_thread = self._mirror_thread, None
-        if t is None:
+        if self._mirror_q is None:
             return
-        t.join()
-        err = t._mirror_state.get("err")
-        if err is not None:
+        self._mirror_q.join()
+        errs, self._mirror_errs = self._mirror_errs, []
+        for err in errs:
             warnings.warn(
                 f"checkpoint mirror replication failed "
                 f"({type(err).__name__}: {err}); the mirror tier is stale",

@@ -212,6 +212,21 @@ class ContinuousBatcher:
             # fail at submission, not inside run(): an admit-time prefill
             # error would throw away every already-finished result
             raise ValueError(f"request {req.uid!r}: empty prompt")
+        if req.max_new_tokens < 1:
+            # a zero-budget request would occupy a slot forever: _remaining()
+            # is 0 from admission on, so _token_done() never fires to retire it
+            raise ValueError(
+                f"request {req.uid!r}: max_new_tokens must be >= 1 "
+                f"(got {req.max_new_tokens})")
+        if (req.uid in self._submit_t or req.uid in self._results
+                or any(s is not None and s.req.uid == req.uid
+                       for s in self._slots)):
+            # a duplicate would silently overwrite the first request's
+            # result (and its queue-wait clock) — fail at submission like
+            # the other contract violations above
+            raise ValueError(
+                f"request {req.uid!r}: duplicate uid (queued, in flight, "
+                f"or finished with an untaken result)")
         budget = self.engine.max_seq_len - len(req.prompt)
         if budget < 1:
             raise ValueError(
@@ -230,15 +245,24 @@ class ContinuousBatcher:
         """Requests waiting for a slot (the bounded-queue admission gate)."""
         return len(self._pending)
 
+    def commitment(self, req) -> int:
+        """Worst-case tokens ``req`` can actually occupy: prompt plus its
+        generation budget capped by the sequence window (``_remaining()``
+        enforces the same cap at decode time), so a huge ``max_new_tokens``
+        counts what it can consume, not what it asked for. The admission
+        gate (serve.py) prices requests with this BEFORE submit-time
+        validation, hence the clamp for over-window prompts."""
+        return len(req.prompt) + max(0, min(
+            req.max_new_tokens, self.engine.max_seq_len - len(req.prompt)))
+
     def token_load(self) -> int:
         """Worst-case token commitment of every queued and in-flight
-        request (prompt + full ``max_new_tokens`` budget) — the
-        token-budget admission-control metric: what the cache/compute would
-        owe if every live request ran to its cap."""
-        load = sum(len(r.prompt) + r.max_new_tokens for r in self._pending)
+        request — the token-budget admission-control metric: what the
+        cache/compute would owe if every live request ran to its cap."""
+        load = sum(self.commitment(r) for r in self._pending)
         for s in self._slots:
             if s is not None:
-                load += len(s.req.prompt) + s.req.max_new_tokens
+                load += self.commitment(s.req)
         return load
 
     def take_results(self) -> dict:

@@ -228,13 +228,26 @@ class InferenceEngine:
         self._decode_block_jit = self._make_decode_block_jit()
         self._decode_block_poison_jit = None  # chaos-only; built on demand
         self._verify_jit = None
+        self._verify_poison_jit = None  # chaos-only; built on demand
         if self.spec_len > 0:
-            self._verify_jit = jax.jit(shard_map(
-                self._verify_impl, mesh,
-                in_specs=(self._pspecs, self._cspecs,
-                          P(), P(), P(), P(), P(), P(), P()),
-                out_specs=(self._cspecs, P(), P(), P())),
-                donate_argnums=(1,))
+            self._verify_jit = self._make_verify_jit()
+
+    def _make_verify_jit(self, poison: bool = False):
+        return jax.jit(shard_map(
+            partial(self._verify_impl, poison=poison), self.topo.mesh,
+            in_specs=(self._pspecs, self._cspecs,
+                      P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(self._cspecs, P(), P(), P())),
+            donate_argnums=(1,))
+
+    def _verify_prog(self, poison: bool):
+        """The verify executable to run (lazily builds the chaos
+        NaN-poisoned variant)."""
+        if not poison:
+            return self._verify_jit
+        if self._verify_poison_jit is None:
+            self._verify_poison_jit = self._make_verify_jit(poison=True)
+        return self._verify_poison_jit
 
     def _make_decode_block_jit(self, poison: bool = False):
         return jax.jit(shard_map(
@@ -434,7 +447,7 @@ class InferenceEngine:
                 jnp.sum(actives.astype(jnp.int32), axis=0))
 
     def _verify_impl(self, params, cache, tokens, key, eos_id, budget,
-                     temperature, top_k, top_p):
+                     temperature, top_k, top_p, poison=False):
         """The speculative verify pass: tokens [B, S] (S = spec_len + 1 —
         each slot's current last token followed by its spec_len drafted
         continuation tokens), scored in ONE model dispatch.
@@ -467,6 +480,11 @@ class InferenceEngine:
         rows = pos0[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
         new_leaves, logits = self._model_block(
             params, cache, tokens, rows, pos0)  # logits [B, S, V]
+        if poison:
+            # chaos only (trace-time): the build that proves
+            # speculative_accept's sanitized argmax keeps the emitted
+            # stream defined — decode_block's ``poison`` counterpart
+            logits = jnp.full_like(logits, jnp.nan)
         emitted, counts = sampling.speculative_accept(
             logits, tokens[:, 1:], key, temperature, top_k, top_p)
         raw = counts  # pre-clip: accepted drafts + 1 fresh token
@@ -668,7 +686,9 @@ class InferenceEngine:
                 f"[{self.slots}, {self.spec_len + 1}]; got "
                 f"{tokens.shape}")
         self._hook("verify", budget)
-        return self._dispatch(lambda: self._verify_jit(
+        poison = self._poison("verify")
+        # resolved inside the lambda, exactly like decode_block's program
+        return self._dispatch(lambda: self._verify_prog(poison)(
             params, cache, jnp.asarray(tokens), key,
             jnp.asarray(np.asarray(eos_id, np.int32)),
             jnp.asarray(np.asarray(budget, np.int32)),

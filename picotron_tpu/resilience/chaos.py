@@ -131,8 +131,9 @@ class ServingChaos:
       (PERSISTENT, -1 = off);
     - ``chaos_latency_round``         — sleep ``chaos_latency_s`` before
       round N (once; drives the serve watchdog's stall detector);
-    - ``chaos_poison_logits_round``   — round N's decode dispatch runs the
-      NaN-poisoned program (once; drives the sampler's non-finite gate).
+    - ``chaos_poison_logits_round``   — round N's decode/verify dispatch
+      runs the NaN-poisoned program (once; drives the sampler's — or, on
+      speculative engines, ``speculative_accept``'s — non-finite gate).
     """
 
     def __init__(self, r, sleep=time.sleep):

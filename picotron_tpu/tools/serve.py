@@ -36,7 +36,7 @@ Admission control (checked atomically at POST time):
   503 (the queue is where latency hides; past the bound, waiting is worse
   for the client than retrying another replica);
 - **token budget** — the worst-case token commitment (prompt +
-  ``max_new_tokens``) of every live request is capped by
+  window-capped ``max_new_tokens``) of every live request is capped by
   ``--token-budget`` (default: ``slots * max_seq_len``, the cache's real
   capacity); past it new work is a 429. Both carry ``Retry-After``.
 
@@ -116,16 +116,20 @@ class FrontEnd:
         self.guard = PreemptionGuard()
         self._log = log
         self._mu = threading.Lock()
+        self._uid_mu = threading.Lock()  # uid counter only: never wait on
+        # _mu before the bounded acquire below, or a wedged dispatch parks
+        # every uid-less submission forever instead of shedding it after 10s
         self._wake = threading.Event()
         self._waiters: dict = {}
         self._batcher = ContinuousBatcher(engine, params, seed=seed,
                                           on_token=self._on_token)
         self.draining = False
         self.stopped = threading.Event()  # dispatch loop has exited
+        self.dead = False  # loop died on an exception (vs clean drain)
         self.stalled = False
         self.stalls = 0  # stall episodes the watchdog flagged
         self.rejections = {"queue_full": 0, "token_budget": 0,
-                           "draining": 0, "stalled": 0}
+                           "draining": 0, "stalled": 0, "dead": 0}
         self._uid_seq = 0
         self._start_t = time.monotonic()
         self._progress_t = time.monotonic()
@@ -179,7 +183,16 @@ class FrontEnd:
         except (TypeError, ValueError) as e:
             raise AdmissionError(400, f"bad request field: {e}",
                                  retry_after=0)
-        cost = len(req.prompt) + req.max_new_tokens
+        if req.max_new_tokens < 1:
+            # a zero-budget request would hold a slot forever (no token ever
+            # completes it); a negative one corrupts the token-budget math
+            raise AdmissionError(400, "max_new_tokens must be >= 1",
+                                 retry_after=0)
+        # window-capped commitment (the same pricing token_load() uses): a
+        # budget beyond max_seq_len can never be generated, so counting it
+        # raw would 429 a servable request forever. Reads only the engine's
+        # window — safe before taking _mu.
+        cost = self._batcher.commitment(req)
         # bounded wait for the batcher lock: during a wedged dispatch (the
         # stall the watchdog flags) admission SHEDS instead of parking
         # handler threads on the lock forever
@@ -189,6 +202,15 @@ class FrontEnd:
                 503, "dispatch stalled (admission unavailable)",
                 retry_after=10)
         try:
+            if self.stopped.is_set():
+                # the dispatch loop is gone (drain done, or it died on an
+                # unexpected exception): nothing will ever serve this
+                # request — shed it instead of stranding the handler on a
+                # waiter no loop will complete
+                self.rejections["dead"] += 1
+                raise AdmissionError(
+                    503, "dispatch loop exited (restart required)",
+                    retry_after=30)
             if self.draining:
                 self.rejections["draining"] += 1
                 raise AdmissionError(
@@ -223,7 +245,7 @@ class FrontEnd:
         return req.uid, waiter
 
     def _next_uid(self) -> str:
-        with self._mu:
+        with self._uid_mu:
             self._uid_seq += 1
             return f"r{self._uid_seq}"
 
@@ -259,16 +281,25 @@ class FrontEnd:
         except BaseException as e:  # noqa: BLE001 - loop death is fatal news
             self._event("dispatch_loop_died",
                         error=f"{type(e).__name__}: {e}")
-            self.stalled = True  # healthz goes 503: supervisors restart us
+            # a dedicated latch, not `stalled`: the watchdog CLEARS stalled
+            # on its next tick (progress looked recent), which would flip
+            # healthz back to 200 on a dead server forever
+            self.dead = True  # healthz goes 503: supervisors restart us
             raise
         finally:
             # never strand a blocked handler: whatever the loop's fate,
-            # every still-registered waiter gets a terminal "error" result
+            # every still-registered waiter gets a terminal "error" result.
+            # Under _mu, stopped BEFORE the snapshot: submit() checks
+            # stopped under the same lock, so every admission either saw
+            # it (shed 503) or registered its waiter before the snapshot
+            # (delivered here) — no in-between request is stranded
             from picotron_tpu.inference.batcher import GenerationResult
 
-            for uid in list(self._waiters):
+            with self._mu:
+                self.stopped.set()
+                stranded = list(self._waiters)
+            for uid in stranded:
                 self._deliver(uid, GenerationResult(uid, [], [], "error"))
-            self.stopped.set()
             if self._on_drained is not None:
                 self._on_drained()
 
@@ -313,10 +344,10 @@ class FrontEnd:
                               **fields}), flush=True)
 
     def healthy(self) -> bool:
-        return not self.stalled
+        return not (self.stalled or self.dead)
 
     def ready(self) -> bool:
-        return not (self.draining or self.stalled)
+        return not (self.draining or self.stalled or self.dead)
 
     def stats(self) -> dict:
         # bounded wait: the stats an operator checks DURING a dispatch
@@ -331,6 +362,7 @@ class FrontEnd:
             d = {"snapshot": "partial (dispatch in progress)"}
         d["rejected"] = dict(self.rejections)
         d["draining"] = self.draining
+        d["dead"] = self.dead
         d["stalled"] = self.stalled
         d["stalls"] = self.stalls
         d["uptime_s"] = round(time.monotonic() - self._start_t, 3)
@@ -339,6 +371,9 @@ class FrontEnd:
 
 def _r(v: Optional[float]) -> Optional[float]:
     return None if v is None else round(v, 6)
+
+
+MAX_BODY_BYTES = 8 << 20  # request-body cap: reject before allocating
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -368,12 +403,12 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             ok = f.healthy()
             self._json(200 if ok else 503,
-                       {"ok": ok, "stalled": f.stalled})
+                       {"ok": ok, "stalled": f.stalled, "dead": f.dead})
         elif self.path == "/readyz":
             ok = f.ready()
             self._json(200 if ok else 503,
                        {"ok": ok, "draining": f.draining,
-                        "stalled": f.stalled})
+                        "stalled": f.stalled, "dead": f.dead})
         elif self.path == "/statz":
             self._json(200, f.stats())
         else:
@@ -385,6 +420,19 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             n = int(self.headers.get("Content-Length", 0))
+        except ValueError as e:
+            self._json(400, {"error": f"bad Content-Length: {e}"})
+            return
+        if n < 0:
+            self._json(400, {"error": f"bad Content-Length: {n}"})
+            return
+        if n > MAX_BODY_BYTES:
+            # the declared length drives the read: cap it BEFORE allocating,
+            # or one client buys arbitrary memory ahead of any admission check
+            self._json(413, {"error": f"request body too large "
+                                      f"({n} > {MAX_BODY_BYTES} bytes)"})
+            return
+        try:
             spec = json.loads(self.rfile.read(n) or b"{}")
         except (ValueError, json.JSONDecodeError) as e:
             self._json(400, {"error": f"bad request body: {e}"})
@@ -584,7 +632,18 @@ def _smoke(server: Server) -> int:
 
     t = threading.Thread(target=bg)
     t.start()
-    time.sleep(0.2)  # let it admit
+    # wait until the slow request actually holds a slot (a fixed sleep is a
+    # race on a loaded host: still-queued at SIGTERM means it gets shed and
+    # the drain checks below fail spuriously); "completed" covers the other
+    # race, where the tiny model finishes it before we observe the slot
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        s = _get(port, "/statz")[1]
+        if s.get("active_slots", 0) > 0 or s.get("completed", 0) >= 3:
+            break
+        time.sleep(0.02)
+    else:
+        check("slow_request_admitted", False)
     os.kill(os.getpid(), signal.SIGTERM)
     server.front.join(timeout=120)
     check("drain_finished", server.front.stopped.is_set())

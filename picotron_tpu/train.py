@@ -320,7 +320,12 @@ def train(cfg, max_steps_override: Optional[int] = None,
 
             # Save at group boundaries only: params here are the end-of-group
             # state, so the recorded step must be the end-of-group step.
+            # A pending rollback skips the save — these params are the
+            # anomalous state the rollback exists to discard; saving them
+            # first would make the restore below reload the bad step and
+            # replay the anomaly until max_rollbacks aborts the run.
             if (manager is not None and c.save_frequency > 0
+                    and not do_rollback
                     and step // c.save_frequency > step_before // c.save_frequency):
                 manager.save(step, params, opt_state, trained_tokens, layout=layout,
                              zero1=z1, data_meta=loader.state_meta(step))

@@ -192,6 +192,30 @@ def test_mirror_replication_and_fallback(tiny_model_kwargs, tmp_path):
     mgr.close()
 
 
+def test_mirror_worker_survives_warnings_as_errors(tmp_path):
+    """A warning raised INSIDE the mirror worker (e.g. the lag warning
+    under ``-W error``) must not kill the worker thread: queued entries
+    still get ``task_done`` and readers' ``_mirror_q.join()`` returns
+    instead of deadlocking shutdown/restore, with the failure recorded."""
+    import threading
+    import warnings as w
+
+    mgr = ckpt.CheckpointManager(str(tmp_path / "c"), io_attempts=1,
+                                 mirror_dir=str(tmp_path / "m"))
+    with w.catch_warnings():
+        w.simplefilter("error")      # promote the worker's warnings
+        mgr._spawn_mirror(99)        # no step 99 dir: the lag-skip path
+        done = threading.Event()
+        t = threading.Thread(
+            target=lambda: (mgr._mirror_q.join(), done.set()), daemon=True)
+        t.start()
+        t.join(30)
+    assert done.is_set()             # no deadlock: the batch completed
+    assert mgr._mirror_errs          # ...and the failure was recorded
+    with pytest.warns(RuntimeWarning, match="mirror"):
+        mgr.close()                  # the join re-surfaces it to readers
+
+
 def test_mirror_through_train_entry(tiny_model_kwargs, tmp_path):
     """The config key wires through train(): a run with ckpt_mirror_dir
     replicates every periodic save, and a resume whose primary is fully

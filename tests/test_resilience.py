@@ -268,6 +268,24 @@ def test_rollback_policy_restores_and_replays(baseline, tmp_path):
     assert finite == baseline  # 1-4, then replayed 5-6
 
 
+def test_rollback_at_save_boundary_skips_the_anomalous_save(
+        baseline, tmp_path):
+    """An anomaly that fires ON a save boundary must not checkpoint the
+    anomalous state before rolling back — the restore must come from the
+    last GOOD checkpoint (step 2), and the replay must match the
+    uninterrupted trajectory bit-for-bit. (With the save running first,
+    the rollback would restore the just-saved bad step and replay the
+    anomaly until max_rollbacks aborted.)"""
+    hist = []
+    steps, _, _ = train(
+        _cfg(tmp_path / "ckpt", chaos_nan_step=4, anomaly_policy="rollback",
+             rollback_after=1), loss_history=hist)
+    assert steps == 6
+    finite = [h for h in hist if np.isfinite(h[1])]
+    # steps 1-3, then the replay from the restored step-2 checkpoint: 3-6
+    assert finite == baseline[:3] + baseline[2:]
+
+
 def test_abort_policy_raises_and_flushes(tmp_path):
     import picotron_tpu.checkpoint as ckpt
 

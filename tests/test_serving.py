@@ -22,6 +22,7 @@ trigger and a bit-for-bit oracle where one exists:
 ``make serve-chaos-smoke`` runs exactly this file.
 """
 
+import json
 import threading
 import time
 
@@ -127,6 +128,24 @@ def test_poisoned_logits_round_emits_defined_tokens():
         assert len(r.tokens) == 10
         assert all(0 <= t < cfg.model.vocab_size for t in r.tokens)
     assert chaos.round >= 2  # the poison round actually ran
+    assert "poison" in chaos._fired
+
+
+def test_poisoned_verify_round_emits_defined_tokens():
+    """On a speculative engine the poison round lands on a VERIFY
+    dispatch: speculative_accept's sanitized argmax keeps the emitted
+    stream defined and generation terminates normally."""
+    chaos = ServingChaos(_res(chaos_poison_logits_round=2))
+    cfg, engine, params = _engine(slots=2, hooks=chaos, spec_len=4)
+    res = ContinuousBatcher(engine, params).run(_requests(2, max_new=10))
+    for r in res.values():
+        assert r.finish_reason == "length"
+        assert len(r.tokens) == 10
+        assert all(0 <= t < cfg.model.vocab_size for t in r.tokens)
+    assert chaos.round >= 2
+    # the knob actually fired on the verify path (it was silently a no-op
+    # for spec engines before verify() consulted the poison hook)
+    assert "poison" in chaos._fired
 
 
 # --------------------------------------------------------------------------- #
@@ -241,6 +260,21 @@ def test_batcher_stats_counters_and_percentiles():
     assert s["generated_tokens"] == 12
 
 
+def test_batcher_rejects_duplicate_uid():
+    """A duplicate uid would silently overwrite the first request's
+    result and its queue-wait clock: fail at submission like the other
+    contract violations. Once the result is taken, the uid is reusable."""
+    _, engine, params = _engine(slots=2)
+    b = ContinuousBatcher(engine, params)
+    b.submit(Request("dup", [1, 2], max_new_tokens=2))
+    with pytest.raises(ValueError, match="duplicate uid"):
+        b.submit(Request("dup", [3, 4], max_new_tokens=2))
+    res = b.run()
+    assert res["dup"].finish_reason == "length"
+    res2 = b.run([Request("dup", [5, 6], max_new_tokens=2)])
+    assert res2["dup"].finish_reason == "length"
+
+
 # --------------------------------------------------------------------------- #
 # flash -> dense graceful degradation
 # --------------------------------------------------------------------------- #
@@ -327,7 +361,7 @@ def test_http_generate_stream_health_and_stats():
         assert st == 200
         assert stats["completed"] == stats["admitted"] == 2
         assert stats["rejected"] == {"queue_full": 0, "token_budget": 0,
-                                     "draining": 0, "stalled": 0}
+                                     "draining": 0, "stalled": 0, "dead": 0}
         assert not stats["draining"] and not stats["stalled"]
     finally:
         srv.drain_and_join(timeout=60)
@@ -385,6 +419,101 @@ def test_http_admission_bounds_shed_with_retry_after():
         st, body = serve._post(srv.port, {"prompt": [1], "max_new_tokens": 2})
         assert st == 503 and body["shed"]
         assert serve._get(srv.port, "/statz")[1]["rejected"]["queue_full"] == 1
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+def test_oversized_budget_is_window_capped_not_rejected():
+    """A max_new_tokens beyond the sequence window admits at its real
+    (window-capped) commitment instead of 429ing forever — the batcher
+    can only ever generate max_seq_len - len(prompt) tokens, so that is
+    what admission charges against the token budget."""
+    cfg, srv = _server()
+    try:
+        st, body = serve._post(srv.port, {"prompt": [1, 2, 3],
+                                          "max_new_tokens": 100000})
+        assert st == 200 and body["finish_reason"] == "length"
+        assert len(body["tokens"]) == MAX_LEN - 3
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+def test_http_rejects_zero_budget_and_oversized_bodies():
+    """max_new_tokens < 1 is a 400 at the door (a zero-budget request
+    would hold a slot forever — no token ever completes it — and a
+    negative one corrupts the token-budget arithmetic); a body whose
+    declared Content-Length exceeds the cap is a 413 before any read."""
+    import http.client
+
+    cfg, srv = _server()
+    try:
+        port = srv.port
+        for bad in (0, -3):
+            st, body = serve._post(port, {"prompt": [1, 2],
+                                          "max_new_tokens": bad})
+            assert st == 400 and "max_new_tokens" in body["error"]
+        # the batcher guards too: direct embedders get the same contract
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            srv.front._batcher.submit(Request("z", [1], max_new_tokens=0))
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/generate", b"{}",
+                     {"Content-Length": str(serve.MAX_BODY_BYTES + 1)})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 413 and "too large" in body["error"]
+
+        # a negative declared length is a malformed header: 400, not 413
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/generate", b"", {"Content-Length": "-5"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 400 and "Content-Length" in body["error"]
+
+        # nothing above was admitted; the server still serves
+        st, body = serve._post(port, {"prompt": [1, 2],
+                                      "max_new_tokens": 2})
+        assert st == 200 and body["finish_reason"] == "length"
+        stats = serve._get(port, "/statz")[1]
+        assert stats["admitted"] == stats["completed"] == 1
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_http_submissions_after_loop_death_are_shed():
+    """Once the dispatch loop dies on an unexpected exception, in-flight
+    waiters get terminal "error" results (nobody hangs) and LATER
+    submissions are shed with 503 instead of registering waiters no loop
+    will ever complete."""
+    cfg, srv = _server()
+    try:
+        port = srv.port
+
+        def boom(*a, **k):
+            raise RuntimeError("dispatch wedged beyond repair")
+
+        srv.front._batcher.step = boom
+        st, body = serve._post(port, {"prompt": [1, 2],
+                                      "max_new_tokens": 4})
+        assert st == 500 and body["finish_reason"] == "error"
+        srv.front.join(timeout=60)
+        assert srv.front.stopped.is_set()
+        # death is a dedicated latch: the watchdog's recovery tick clears
+        # `stalled` (progress looked recent), which must NOT flip a dead
+        # server's healthz back to 200
+        assert srv.front.dead
+        time.sleep(3 * srv.front.watchdog_poll_s)
+        assert not srv.front.healthy()  # supervisors see the 503
+        assert not srv.front.ready()
+        with pytest.raises(serve.AdmissionError) as ei:
+            srv.front.submit({"prompt": [1, 2], "max_new_tokens": 4})
+        assert ei.value.status == 503
+        assert srv.front.rejections["dead"] == 1
+        assert not srv.front._waiters  # nothing stranded
     finally:
         srv.drain_and_join(timeout=60)
 
