@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke kernel-smoke chaos-smoke serve-smoke serve-chaos-smoke clean
+.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke serve-smoke serve-chaos-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -60,6 +60,25 @@ spec-smoke:
 # fields).
 kernel-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_decode_kernel.py -q
+
+# Paged-KV smoke (inference/paged_kv.py): a shared-prefix batch through
+# the page-pool layout (block-table indirection, radix prefix sharing,
+# copy-on-write) with --check-layout-parity asserting every request's
+# tokens are IDENTICAL to the contiguous layout — fp32 and int8 caches —
+# then the paged bench so kv_pages_*/pool utilization land in the JSON
+# trajectory. tests/test_paged_kv.py is the full tier-1 matrix.
+paged-smoke:
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
+	  --kv-layout paged --check-layout-parity \
+	  --prompt-ids "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18" \
+	  --prompt-ids "1,2,3,4,5,6,7,8,9,10,11,12,13,14,21,22" \
+	  --prompt-ids "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,31"
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
+	  --kv-layout paged --check-layout-parity --kv-cache-dtype int8 \
+	  --decode-block-len 4 \
+	  --prompt-ids "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18" \
+	  --prompt-ids "1,2,3,4,5,6,7,8,9,10,11,12,13,14,21,22"
+	JAX_PLATFORMS=cpu python bench_decode.py --kv-layout paged --block-len 8
 
 # Fault-injection suite on a CPU mesh (picotron_tpu/resilience/): chaos
 # SIGTERM/crash/NaN/truncation at fixed steps, kill->resume bit-for-bit

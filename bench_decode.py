@@ -104,13 +104,23 @@ def kv_bytes_per_token(engine, lengths) -> int:
     dense-bf16, the opposite of what the flash path exists to fix. One
     walk serves one decode token (decode/blocked modes); speculative
     callers scale by dispatches-per-token (one walk per verify dispatch
-    emits ~1/dpt tokens)."""
+    emits ~1/dpt tokens).
+
+    Paged layout (``--kv-layout paged``): flash walks whole pages, so the
+    live window rounds up to the page size; dense first GATHERS the
+    slot's pages into a contiguous full-window copy (paged_kv.attend) —
+    that copy's write+read is counted on top, the same honesty rule as
+    the dense-int8 materialization."""
     import numpy as np
 
     m = engine.cfg.model
     live = float(np.mean(np.asarray(lengths)))
-    window = live if engine.attend_impl == "flash" else float(
-        engine.max_seq_len)
+    paged = engine.paged is not None
+    if engine.attend_impl == "flash":
+        window = (-(-live // engine.page_len) * engine.page_len if paged
+                  else live)
+    else:
+        window = float(engine.max_seq_len)
     per_row = 2 * m.num_key_value_heads * m.head_dim * \
         engine.cache_dtype.itemsize
     if engine.quantized:
@@ -119,12 +129,18 @@ def kv_bytes_per_token(engine, lengths) -> int:
             # whole-window fp32 K/V materialization: 4 bytes written then
             # read back per element, on top of the int8 cache read
             per_row += 2 * m.num_key_value_heads * m.head_dim * 4 * 2
+    if paged and engine.attend_impl == "dense":
+        # the gathered contiguous window copy: written then read back at
+        # the storage width (the fp32 materialization above already
+        # covers the int8 dequant copy)
+        per_row += 2 * m.num_key_value_heads * m.head_dim * \
+            engine.cache_dtype.itemsize * 2
     return int(round(m.num_hidden_layers * window * per_row))
 
 
 def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
         steps: int, warmup: int = 8, block_len: int = 1,
-        attend_impl: str = "dense"):
+        attend_impl: str = "dense", kv_layout: str = "contiguous"):
     """Time ``steps`` decode rounds (tokens per slot). Returns
     (tokens/s, dispatches_per_token, kv_bytes/token, engine)."""
     import jax
@@ -135,7 +151,7 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
 
     engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
                              decode_block_len=block_len,
-                             attend_impl=attend_impl)
+                             attend_impl=attend_impl, kv_layout=kv_layout)
     params = engine.shard_params(jax.jit(
         lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
     cache = engine.init_cache()
@@ -202,7 +218,8 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
 
 def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
              steps: int, warmup_rounds: int = SPEC_WARMUP_ROUNDS,
-             spec_len: int = 4, attend_impl: str = "dense"):
+             spec_len: int = 4, attend_impl: str = "dense",
+             kv_layout: str = "contiguous"):
     """Time ``steps`` speculative decode tokens per slot: the same
     protocol as ``run`` — prefill fills every slot OUTSIDE the timed
     window, warmup rounds absorb compilation, then the timed window runs
@@ -223,7 +240,8 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     from picotron_tpu.models import llama
 
     engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
-                             spec_len=spec_len, attend_impl=attend_impl)
+                             spec_len=spec_len, attend_impl=attend_impl,
+                             kv_layout=kv_layout)
     params = engine.shard_params(jax.jit(
         lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
     drafter = NgramDrafter(engine.spec_ngram)
@@ -305,6 +323,13 @@ def main(argv=None) -> None:
                          "length-aware Pallas flash decode (interpret "
                          "mode off TPU — a parity surface, not a CPU "
                          "perf one)")
+    ap.add_argument("--kv-layout", choices=("contiguous", "paged"),
+                    default="contiguous",
+                    help="KV cache layout: per-slot contiguous strips "
+                         "(default) or the paged pool with block-table "
+                         "indirection (inference/paged_kv.py) — the JSON "
+                         "then adds kv_pages_total/live, pool "
+                         "utilization, and prefix_hit_rate")
     args = ap.parse_args(argv)
     if args.spec_len > 0 and args.block_len != 1:
         ap.error("--spec-len replaces blocked decode; drop --block-len")
@@ -361,11 +386,13 @@ def main(argv=None) -> None:
         if args.spec_len > 0:
             tok_s, dpt, accept, kv_bytes, engine = run_spec(
                 cfg, spec_len=args.spec_len,
-                attend_impl=args.attend_impl, **sizes)
+                attend_impl=args.attend_impl,
+                kv_layout=args.kv_layout, **sizes)
         else:
             tok_s, dpt, kv_bytes, engine = run(
                 cfg, block_len=args.block_len,
-                attend_impl=args.attend_impl, **sizes)
+                attend_impl=args.attend_impl,
+                kv_layout=args.kv_layout, **sizes)
     except Exception as e:  # noqa: BLE001 - the record IS the error channel
         print(json.dumps({
             "metric": BENCH_METRICS["bench_decode"], "value": None,
@@ -378,6 +405,7 @@ def main(argv=None) -> None:
     print(f"# slots={sizes['slots']} prompt={sizes['prompt_len']} "
           f"steps={sizes['steps']} chips={chips} block_len={args.block_len} "
           f"spec_len={args.spec_len} attend_impl={args.attend_impl} "
+          f"kv_layout={args.kv_layout} "
           + (f"accept_rate={accept:.3f} " if accept is not None else "")
           + f"dispatches/token={dpt:.3f} kv_bytes/token={kv_bytes} "
           f"tokens/s={tok_s:.1f}",
@@ -387,11 +415,24 @@ def main(argv=None) -> None:
               "block_len": args.block_len,
               "dispatches_per_token": round(dpt, 4),
               "attend_impl": args.attend_impl,
+              "kv_layout": args.kv_layout,
               "kv_bytes_per_token": kv_bytes,
               # hardware-validated numbers vs CPU-proxy fallback: the
               # kv_bytes/attend_impl deltas are layout facts and hold
               # either way; tokens/s only means hardware when validated
               "validated": tpu}
+    if engine.paged is not None:
+        # capacity story next to the bytes story: pool occupancy at the
+        # end of the timed window + prefix-cache effectiveness (the bench
+        # drives the engine directly, so hit rate is nonzero only for
+        # workloads routed through the batcher's shared-prefix admission)
+        p = engine.paged.stats()
+        record.update(
+            kv_page_len=p["kv_page_len"],
+            kv_pages_total=p["kv_pages_total"],
+            kv_pages_live=p["kv_pages_live"],
+            kv_pool_utilization=p["kv_pool_utilization"],
+            prefix_hit_rate=p["prefix_hit_rate"])
     if not tpu:
         record["preflight"] = preflight_note
     if args.spec_len > 0:

@@ -305,6 +305,31 @@ class InferenceConfig:
     # (kv_cache.quantize_kv) — ~2x the slots or context at the same HBM
     # budget, dequantized inside decode attention.
     kv_cache_dtype: str = "auto"
+    # KV cache memory layout: "contiguous" = every slot owns a
+    # max_seq_len strip (the bit-pinned default); "paged" = block-table
+    # indirection over a global pool of fixed-size KV pages
+    # (inference/paged_kv.py) with refcounted prefix sharing and
+    # copy-on-write — HBM tracks LIVE tokens instead of slots x window,
+    # and identical prompt prefixes are stored (and prefilled) once.
+    # Generations are pinned identical to contiguous
+    # (tests/test_paged_kv.py); contiguous stays the default until the
+    # paged path is A/B'd on hardware.
+    kv_layout: str = "contiguous"
+    # Rows per KV page (paged layout only). Small pages waste less
+    # capacity per sequence and fork prefixes at finer grain; large pages
+    # make each kernel DMA deeper. Power of two >= 8 (the flash kernel's
+    # sublane quantum).
+    kv_page_len: int = 16
+    # Pool size in pages (paged layout only). 0 = auto: one reserved
+    # NULL page + slots * ceil(max_seq_len / kv_page_len) — capacity
+    # parity with the contiguous layout; raise it to oversubscribe slots
+    # against short typical sequences, shrink it to cap HBM.
+    kv_num_pages: int = 0
+    # Radix prefix cache (paged layout only): prompt pages are kept in a
+    # token-keyed trie after prefill and new requests reuse (refcount,
+    # skip prefilling) their longest cached prefix, copy-on-write at the
+    # fork point. False = pure paging, no sharing.
+    prefix_cache: bool = True
     # Prompts longer than this prefill as a sequence of fixed-width chunk
     # dispatches writing K/V straight into the target slot
     # (engine.prefill_chunked): O(1) compiled shapes in prompt length and
@@ -607,6 +632,19 @@ class Config:
             raise ValueError(
                 f"unknown inference.kv_cache_dtype {inf.kv_cache_dtype!r} "
                 "(auto|int8)")
+        if inf.kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"unknown inference.kv_layout {inf.kv_layout!r} "
+                "(contiguous|paged)")
+        if inf.kv_page_len < 8 or inf.kv_page_len & (inf.kv_page_len - 1):
+            # powers of two keep page/window math exact and respect the
+            # flash kernel's 8-row sublane tiling
+            raise ValueError(
+                f"inference.kv_page_len must be a power of two >= 8, got "
+                f"{inf.kv_page_len}")
+        if inf.kv_num_pages < 0:
+            raise ValueError(
+                "inference.kv_num_pages must be >= 0 (0 = auto-size)")
         if inf.attend_impl not in ("dense", "flash"):
             raise ValueError(
                 f"unknown inference.attend_impl {inf.attend_impl!r} "

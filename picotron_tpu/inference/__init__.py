@@ -6,6 +6,10 @@ into a batched generation engine:
 - ``kv_cache``: preallocated slot-based K/V cache (compact GQA heads, head
   axis tp-sharded; optional int8 storage with per-row absmax scales) + the
   masked dot-product decode kernel;
+- ``paged_kv``: the paged layout (``inference.kv_layout: "paged"``) — a
+  global pool of fixed-size KV pages behind per-slot block tables, with a
+  host-side refcounting allocator, radix prefix sharing (identical prompt
+  prefixes stored and prefilled once), and copy-on-write at fork points;
 - ``sampling``: greedy / temperature / top-k / top-p as pure jittable
   functions with per-request parameter arrays;
 - ``engine``: jitted ``prefill`` / ``prefill_chunked`` / ``decode_step`` /

@@ -265,6 +265,36 @@ class ContinuousBatcher:
                 load += self.commitment(s.req)
         return load
 
+    @property
+    def paged(self):
+        """The engine's host page allocator (None on the contiguous
+        layout) — the admission gate prices in pages against it."""
+        return self.engine.paged
+
+    def page_commitment(self, req) -> int:
+        """Worst-case POOL PAGES ``req`` can occupy — the paged layout's
+        admission price: ``ceil(commitment / page_len)``, not a
+        contiguous ``max_seq_len`` strip. Prefix hits only make the
+        actual footprint smaller (shared pages are counted once, in the
+        holder that wrote them). The price covers the dispatch overshoot
+        rows too — a stopped slot's ghost rewrite (+1) or the verify's
+        optimistic ``spec_len`` draft rows past the cap — clamped at the
+        per-slot window, so a priced admission can never starve
+        decode-time allocation."""
+        overshoot = (self.engine.spec_len if self.engine.spec_len > 0
+                     else 1)
+        return min(self.paged.pages_for(self.commitment(req) + overshoot),
+                   self.paged.max_pages)
+
+    def page_load(self) -> int:
+        """Worst-case page commitment of every queued and in-flight
+        request (the serve front end's 429 gate on the paged layout)."""
+        load = sum(self.page_commitment(r) for r in self._pending)
+        for s in self._slots:
+            if s is not None:
+                load += self.page_commitment(s.req)
+        return load
+
     def take_results(self) -> dict:
         """Drain finished results accumulated since the last call:
         {uid: GenerationResult}. The serve loop calls this after each
@@ -312,6 +342,10 @@ class ContinuousBatcher:
         )
         if self.draft_proposed:
             d["accept_rate"] = self.accept_rate
+        if self.paged is not None:
+            # pool occupancy + prefix-cache effectiveness (kv_pages_*,
+            # prefix_hit_rate, cow_copies, ...) ride into /statz
+            d.update(self.paged.stats())
         return d
 
     # ---- one scheduler round ----------------------------------------------
@@ -378,7 +412,16 @@ class ContinuousBatcher:
 
     def _prefill_into(self, req: Request, i: int):
         """Prefill ``req`` into slot ``i`` (one-shot or chunked) and return
-        its last-token logits. Mutates the cache/dispatch counters."""
+        its last-token logits. Mutates the cache/dispatch counters. On the
+        paged layout the engine's prefix-sharing admission runs instead:
+        the longest radix-cached prefix is shared (no dispatches) and only
+        the suffix prefills."""
+        if self.paged is not None:
+            self.paged.priced[i] = self.page_commitment(req)
+            self._cache, logits, n, _cached = self.engine.prefill_paged(
+                self.params, self._cache, req.prompt, i)
+            self.prefill_dispatches += n
+            return logits
         if len(req.prompt) > self.engine.prefill_chunk:
             # long prompt: fixed-width chunks straight into the slot —
             # O(1) compiled shapes in prompt length
@@ -393,12 +436,36 @@ class ContinuousBatcher:
             self.prefill_dispatches += 1
         return logits
 
+    def _pages_admit(self) -> bool:
+        """Page-priced admission gate (paged layout): shed head requests
+        whose worst-case page commitment can NEVER fit the pool, then
+        report whether the head request fits RIGHT NOW (free + evictable
+        pages minus what live slots are still owed). Admission waits
+        (returns False) under transient pressure — slots finishing return
+        pages — instead of admitting a request the pool could strand
+        mid-decode. Out-of-pages sheds at the door; it never corrupts a
+        live slot."""
+        while self._pending:
+            req = self._pending[0]
+            need = self.page_commitment(req)
+            if need > self.paged.usable_pages:
+                self._pending.popleft()
+                self._submit_t.pop(req.uid, None)
+                self.counters["shed"] += 1
+                self._results[req.uid] = GenerationResult(
+                    req.uid, list(req.prompt), [], "shed")
+                continue
+            return self.paged.can_admit(need)
+        return False
+
     def _admit(self) -> None:
         for i in range(len(self._slots)):
             if not self._pending:
                 return
             if self._slots[i] is not None:
                 continue
+            if self.paged is not None and not self._pages_admit():
+                return
             req = self._pending.popleft()
             submit_t = self._submit_t.pop(req.uid, None)
             try:

@@ -63,7 +63,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from picotron_tpu.config import Config
-from picotron_tpu.inference import kv_cache, sampling
+from picotron_tpu.inference import kv_cache, paged_kv, sampling
 from picotron_tpu.models import llama
 from picotron_tpu.ops.rope import precompute_rope, rope_at_positions
 from picotron_tpu.parallel.tp import tp_gather
@@ -112,6 +112,9 @@ class InferenceEngine:
                  spec_len: Optional[int] = None,
                  spec_ngram: Optional[int] = None,
                  attend_impl: Optional[str] = None,
+                 kv_layout: Optional[str] = None,
+                 kv_page_len: Optional[int] = None,
+                 kv_num_pages: Optional[int] = None,
                  hooks=None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
@@ -187,36 +190,88 @@ class InferenceEngine:
                             else jnp.dtype(cache_dtype or m.dtype))
         self._dt = jnp.dtype(m.dtype)
 
+        # KV memory layout: "contiguous" (per-slot strips — the pinned
+        # default) or "paged" (block-table indirection over a global page
+        # pool with refcounted prefix sharing + copy-on-write —
+        # inference/paged_kv.py). A Python-level choice like attend_impl:
+        # every jitted program traces the selected layout statically.
+        if kv_layout is not None:
+            if kv_layout not in ("contiguous", "paged"):
+                raise ValueError(
+                    f"unknown kv_layout {kv_layout!r} (contiguous|paged)")
+            inf.kv_layout = kv_layout
+        self.kv_layout = inf.kv_layout
+        self.paged: Optional[paged_kv.PagedKV] = None
+        if self.kv_layout == "paged":
+            self.page_len = int(kv_page_len or inf.kv_page_len)
+            if self.page_len < 8 or self.page_len & (self.page_len - 1):
+                raise ValueError(
+                    f"kv_page_len must be a power of two >= 8, got "
+                    f"{self.page_len}")
+            # logical window per slot, in pages (>= max_seq_len rows)
+            self.max_pages = -(-self.max_seq_len // self.page_len)
+            self.num_pages = int(kv_num_pages or inf.kv_num_pages
+                                 or 1 + self.slots * self.max_pages)
+            if self.num_pages < 2:
+                raise ValueError("kv_num_pages must be >= 2 "
+                                 "(page 0 is the reserved NULL page)")
+            self.paged = paged_kv.PagedKV(
+                self.slots, self.page_len, self.max_pages, self.num_pages,
+                prefix_cache=inf.prefix_cache)
+
         # angle tables cover the whole cache window; decode gathers rows at
         # each slot's own offset
         self._cos, self._sin = precompute_rope(
             self.max_seq_len, m.head_dim, m.rope_theta, self._dt)
 
         self._pspecs = llama.param_pspecs(m)
-        self._cspecs = kv_cache.cache_pspecs(self.quantized)
+        if self.paged is not None:
+            self._cspecs = paged_kv.cache_pspecs(self.quantized)
+        else:
+            self._cspecs = kv_cache.cache_pspecs(self.quantized)
         self._build_programs()
-        self._insert_jit = jax.jit(kv_cache.insert_prefill,
-                                   donate_argnums=(0,))
+        # kv_cache.release works on both layouts (a paged release is the
+        # same 1-element length write; the host manager frees the pages)
         self._release_jit = jax.jit(kv_cache.release, donate_argnums=(0,))
-        self._init_cache_jit = jax.jit(
-            partial(kv_cache.init_cache, m, self.slots, self.max_seq_len,
-                    dtype=self.cache_dtype, quantized=self.quantized),
-            out_shardings=named_shardings(topo, self._cspecs))
+        if self.paged is not None:
+            self._insert_jit = jax.jit(paged_kv.insert_prefill,
+                                       donate_argnums=(0,))
+            self._copy_page_jit = jax.jit(paged_kv.copy_page,
+                                          donate_argnums=(0,))
+            self._set_length_jit = jax.jit(paged_kv.set_length,
+                                           donate_argnums=(0,))
+            self._init_cache_jit = jax.jit(
+                partial(paged_kv.init_cache, m, self.slots, self.num_pages,
+                        self.page_len, self.max_pages,
+                        dtype=self.cache_dtype, quantized=self.quantized),
+                out_shardings=named_shardings(topo, self._cspecs))
+        else:
+            self._insert_jit = jax.jit(kv_cache.insert_prefill,
+                                       donate_argnums=(0,))
+            self._init_cache_jit = jax.jit(
+                partial(kv_cache.init_cache, m, self.slots,
+                        self.max_seq_len, dtype=self.cache_dtype,
+                        quantized=self.quantized),
+                out_shardings=named_shardings(topo, self._cspecs))
 
     def _build_programs(self) -> None:
         """(Re)build the compiled model programs. Runs at construction and
         again when the flash->dense degradation path flips ``attend_impl``:
         the kernel choice is a trace-time constant the jit wrappers close
         over, so changing it means new programs, not a runtime branch."""
-        kv_spec = {n: s for n, s in self._cspecs.items() if n != "lengths"}
+        kv_spec = {n: s for n, s in self._cspecs.items()
+                   if n not in ("lengths", "block_tables")}
         mesh = self.topo.mesh
 
+        chunk_impl = (self._prefill_chunk_impl_paged
+                      if self.kv_layout == "paged"
+                      else self._prefill_chunk_impl)
         self._prefill_jit = jax.jit(shard_map(
             self._prefill_impl, mesh,
             in_specs=(self._pspecs, P(), P()),
             out_specs=(kv_spec, P())))
         self._prefill_chunk_jit = jax.jit(shard_map(
-            self._prefill_chunk_impl, mesh,
+            chunk_impl, mesh,
             in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P()),
             out_specs=(self._cspecs, P())),
             donate_argnums=(1,))
@@ -350,9 +405,41 @@ class InferenceEngine:
 
     def _split_cache(self, cache):
         """(per-layer K/V leaves to scan, lengths) — the scan consumes every
-        [L, ...] cache leaf the way it consumes the stacked params."""
-        return ({n: a for n, a in cache.items() if n != "lengths"},
+        [L, ...] cache leaf the way it consumes the stacked params. The
+        paged layout's ``block_tables`` has no layer axis: it rides as a
+        scan constant, injected per layer by ``_layer_body``."""
+        return ({n: a for n, a in cache.items()
+                 if n not in ("lengths", "block_tables")},
                 cache["lengths"])
+
+    def _layer_body(self, cos_b, sin_b, pos, block_tables):
+        """Build the layer-scan body: decode one layer against its cache
+        leaves. For paged caches the (layer-less) block tables are spliced
+        into each layer's dict on the way in — kv_cache.cache_write/attend
+        dispatch on their presence — and stripped on the way out so the
+        scan stacks only real [L, ...] leaves."""
+
+        def body(hc, xs):
+            lp, lc = xs
+            if block_tables is not None:
+                lc = {**lc, "block_tables": block_tables}
+            hc, lc = llama.decoder_layer(lp, hc, cos_b, sin_b, self.cfg,
+                                         cache=lc, pos=pos)
+            if block_tables is not None:
+                lc = {n: a for n, a in lc.items() if n != "block_tables"}
+            return hc, lc
+
+        return body
+
+    def _rebuild(self, cache, new_leaves, lengths):
+        """Reassemble a cache pytree from updated per-layer leaves +
+        lengths, carrying the paged layout's block tables through
+        unchanged (the HOST allocator owns them; device programs only
+        read)."""
+        out = {**new_leaves, "lengths": lengths}
+        if "block_tables" in cache:
+            out["block_tables"] = cache["block_tables"]
+        return out
 
     def _model_block(self, params, cache, tokens, rows, pos):
         """The shared incremental-decode model body: embed ``tokens``
@@ -366,13 +453,8 @@ class InferenceEngine:
         cos_b, sin_b = rope_at_positions(self._cos, self._sin, rows)
         h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
         leaves, _ = self._split_cache(cache)
-
-        def body(hc, xs):
-            lp, lc = xs
-            hc, lc = llama.decoder_layer(lp, hc, cos_b, sin_b, self.cfg,
-                                         cache=lc, pos=pos)
-            return hc, lc
-
+        body = self._layer_body(cos_b, sin_b, pos,
+                                cache.get("block_tables"))
         h, new_leaves = lax.scan(body, h, (params["layers"], leaves))
         logits = tp_gather(llama.head_logits(params, h, self.cfg))
         return new_leaves, logits.astype(jnp.float32)
@@ -395,8 +477,8 @@ class InferenceEngine:
         next_tok = sampling.sample(logits, key, temperature, top_k, top_p)
         # free slots (length 0) ride along for shape stability but stay at
         # length 0 — their row-0 writes are never visible
-        new_cache = {**new_leaves,
-                     "lengths": jnp.where(pos > 0, pos + 1, 0)}
+        new_cache = self._rebuild(cache, new_leaves,
+                                  jnp.where(pos > 0, pos + 1, 0))
         return new_cache, next_tok, logits
 
     def _decode_block_impl(self, params, cache, tokens, keys, eos_id,
@@ -436,8 +518,8 @@ class InferenceEngine:
             new_budget = jnp.where(active, budget - 1, budget)
             hit_eos = active & (eos_id >= 0) & (sampled == eos_id)
             new_budget = jnp.where(hit_eos, 0, new_budget)
-            new_cache = {**new_leaves,
-                         "lengths": jnp.where(active, pos + 1, pos)}
+            new_cache = self._rebuild(cache, new_leaves,
+                                      jnp.where(active, pos + 1, pos))
             next_tok = jnp.where(active, sampled, tok)
             return (new_cache, next_tok, new_budget), (emit, active)
 
@@ -500,8 +582,8 @@ class InferenceEngine:
         # when nothing clipped, raw - 1 drafts + 1 fresh; when EOS/budget
         # clipped below that, every emitted token was a draft
         accepted = jnp.minimum(raw - 1, counts)
-        new_cache = {**new_leaves,
-                     "lengths": jnp.where(active, pos0 + counts, pos0)}
+        new_cache = self._rebuild(cache, new_leaves,
+                                  jnp.where(active, pos0 + counts, pos0))
         return new_cache, emitted, counts, accepted
 
     def _prefill_chunk_impl(self, params, cache, tokens, slot, start, valid):
@@ -543,6 +625,34 @@ class InferenceEngine:
                      "lengths": lengths.at[slot].set(start + valid)}
         return new_cache, last.astype(jnp.float32)
 
+    def _prefill_chunk_impl_paged(self, params, cache, tokens, slot, start,
+                                  valid):
+        """Paged counterpart of ``_prefill_chunk_impl``: the slot's pages
+        cannot be sliced out as a contiguous block, so the layer scan runs
+        against the whole pool with the slot's block-table row (B = 1) —
+        writes scatter through the row, attention gathers/walks it. Also
+        the prefix-sharing resume path: with ``start`` past a cached
+        prefix, the chunk attends over SHARED pages it never computed."""
+        cfg = self.cfg
+        C = tokens.shape[1]
+        start = jnp.asarray(start, jnp.int32)
+        pos_rows = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
+        cos_b, sin_b = rope_at_positions(self._cos, self._sin, pos_rows)
+        h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
+        leaves, lengths = self._split_cache(cache)
+        row = lax.dynamic_slice_in_dim(cache["block_tables"], slot, 1,
+                                       axis=0)  # [1, max_pages]
+        pos = jnp.full((1,), start, jnp.int32)
+        body = self._layer_body(cos_b, sin_b, pos, row)
+        h, new_leaves = lax.scan(body, h, (params["layers"], leaves))
+        idx = jnp.clip(valid - 1, 0, C - 1)
+        h_last = jnp.take_along_axis(
+            h, jnp.full((1, 1, 1), idx, jnp.int32), axis=1)
+        last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
+        new_cache = self._rebuild(cache, new_leaves,
+                                  lengths.at[slot].set(start + valid))
+        return new_cache, last.astype(jnp.float32)
+
     # ---- host-facing API ---------------------------------------------------
 
     def shard_params(self, params):
@@ -553,8 +663,51 @@ class InferenceEngine:
                             named_shardings(self.topo, self._pspecs))
 
     def init_cache(self) -> dict:
-        """Fresh zeroed cache, sharded on the engine mesh."""
+        """Fresh zeroed cache, sharded on the engine mesh. For the paged
+        layout this also resets the host allocator (pool, radix cache,
+        block tables) — a new cache means every parked byte is gone, so
+        the batcher's cache-lost rebuild gets a coherent empty pool."""
+        if self.paged is not None:
+            self.paged.reset()
         return self._init_cache_jit()
+
+    # ---- paged-layout host plumbing ---------------------------------------
+
+    def _sync_tables(self, cache) -> dict:
+        """Ship the host allocator's block-table master to the device
+        (replacing the donated copy the last dispatch consumed). Tiny
+        ([slots, max_pages] int32) and unconditional — simpler than dirty
+        tracking and invisible next to a model dispatch."""
+        return {**cache, "block_tables": jnp.asarray(self.paged.tables)}
+
+    def _ensure(self, cache, slot: int, from_pos: int, to_pos: int) -> dict:
+        """Make rows [from_pos, to_pos) of ``slot`` writable before a
+        dispatch: the allocator allocates growth pages and plans
+        copy-on-writes; the (src, dst) pairs run here as byte-exact
+        device page copies. After this, no write the dispatch performs
+        can touch a page anyone else holds."""
+        for src, dst in self.paged.ensure_writable(slot, from_pos, to_pos):
+            cache = self._copy_page_jit(cache, src, dst)
+        return cache
+
+    def _pre_write(self, cache, nwrite: int, budget=None) -> dict:
+        """Before a decode/verify dispatch: every PARKED slot (length > 0)
+        writes up to ``nwrite`` rows from its current length — including
+        inactive slots' recomputed ghost rows, which the mask hides but
+        which must still never land in a shared page. Ensure + COW them
+        all, then sync the tables. ``budget`` (decode blocks) caps each
+        slot's reach at ``budget[s] + 1`` rows — the emitted run plus the
+        one ghost row a stopped slot keeps rewriting — so page demand
+        tracks what the dispatch can actually produce, which is what the
+        batcher's admission pricing reserves."""
+        p = self.paged
+        window = p.max_pages * p.page_len
+        for s in np.flatnonzero(p.host_len > 0):
+            n = nwrite if budget is None else min(
+                nwrite, int(np.asarray(budget)[s]) + 1)
+            cache = self._ensure(cache, int(s), int(p.host_len[s]),
+                                 min(int(p.host_len[s]) + n, window))
+        return self._sync_tables(cache)
 
     def prefill_bucket(self, prompt_len: int) -> int:
         """Power-of-two padding bucket for a prompt (one compile each)."""
@@ -581,12 +734,18 @@ class InferenceEngine:
         return self._prefill_jit(params, jnp.asarray(padded),
                                  jnp.asarray([ids.size], jnp.int32))
 
-    def prefill_chunked(self, params, cache, prompt_ids, slot: int) -> tuple:
-        """Prefill one prompt as ``ceil(len / prefill_chunk)`` fixed-width
-        chunk dispatches writing K/V straight into ``slot`` (consumes
-        ``cache``). Returns (cache, last_logits [1, V] fp32). One compiled
-        shape regardless of prompt length; the ragged final chunk pads to
-        the chunk width with rows past the final length unreachable."""
+    def prefill_chunked(self, params, cache, prompt_ids, slot: int,
+                        start: int = 0) -> tuple:
+        """Prefill one prompt as fixed-width chunk dispatches writing K/V
+        straight into ``slot`` (consumes ``cache``). Returns (cache,
+        last_logits [1, V] fp32). One compiled shape regardless of prompt
+        length; the ragged final chunk pads to the chunk width with rows
+        past the final length unreachable.
+
+        ``start`` > 0 resumes past an already-parked prefix (the paged
+        prefix-sharing admission: rows [0, start) are cached pages the
+        chunks attend over but never recompute). ``prompt_ids`` is always
+        the FULL prompt — chunk positions are absolute."""
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -594,34 +753,103 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt of {ids.size} tokens exceeds max_seq_len "
                 f"{self.max_seq_len}")
+        if not 0 <= start < ids.size:
+            raise ValueError(
+                f"chunked-prefill start {start} outside prompt of "
+                f"{ids.size} tokens")
         C = self.prefill_chunk
         logits = None
-        for s0 in range(0, ids.size, C):
+        for s0 in range(start, ids.size, C):
             end = min(s0 + C, ids.size)
-            # the write window is the chunk's full [start, start + C) rows;
-            # past max_seq_len, dynamic_update_slice would CLAMP the start
-            # and silently shift the chunk onto earlier rows — instead slide
-            # the window back and re-feed the overlap tokens, whose rows
-            # recompute to the values already parked there (same prefix,
-            # same positions, same program)
-            start = min(s0, self.max_seq_len - C)
-            chunk = ids[start:end]
+            if self.paged is None:
+                # the write window is the chunk's full [w0, w0 + C) rows;
+                # past max_seq_len, dynamic_update_slice would CLAMP the
+                # start and silently shift the chunk onto earlier rows —
+                # instead slide the window back and re-feed the overlap
+                # tokens, whose rows recompute to the values already
+                # parked there (same prefix, same positions, same program)
+                w0 = min(s0, self.max_seq_len - C)
+            else:
+                # the paged scatter has no clamp hazard (rows past the
+                # window drop to the NULL page), so the chunk never
+                # slides — critical for the prefix-sharing resume, where
+                # a slid window would re-feed (and pointlessly COW) the
+                # shared prefix it exists to skip
+                w0 = s0
+            chunk = ids[w0:end]
             padded = np.zeros((1, C), np.int32)
             padded[0, : chunk.size] = chunk
+            if self.paged is not None:
+                # COW/alloc every page holding REAL chunk rows ([w0, end)
+                # — the trailing pad rows target unallocated entries and
+                # drop to the NULL page)
+                cache = self._ensure(cache, slot, w0, end)
+                cache = self._sync_tables(cache)
             self._hook("prefill_chunk")
             cache, logits = self._dispatch(lambda: self._prefill_chunk_jit(
                 params, cache, jnp.asarray(padded),
                 jnp.asarray(slot, jnp.int32),
-                jnp.asarray(start, jnp.int32),
+                jnp.asarray(w0, jnp.int32),
                 jnp.asarray(chunk.size, jnp.int32)))
+            if self.paged is not None:
+                self.paged.set_len(slot, end)
         return cache, logits
 
+    def prefill_paged(self, params, cache, prompt_ids, slot: int) -> tuple:
+        """Paged admission: prefix-match, share, and prefill one prompt
+        into ``slot`` (consumes ``cache``). Returns (cache, last_logits
+        [1, V] fp32, n_dispatches, cached_tokens).
+
+        The radix cache resolves the longest cached prefix; its pages are
+        shared into the slot (refcount bumps — ZERO prefill work for
+        those tokens) and only the suffix runs through the model, as
+        chunk dispatches attending over the shared pages. A miss takes
+        exactly the contiguous path's dispatches (pow-2-bucketed one-shot
+        at or under ``prefill_chunk``, chunked above it) so paged-vs-
+        contiguous generations stay bit-identical. Either way the
+        prompt's pages are then registered in the radix cache for the
+        next request — the first decode write past the prompt COWs the
+        tail page rather than mutate what the cache now holds."""
+        if self.paged is None:
+            raise ValueError("prefill_paged needs kv_layout='paged'")
+        ids = [int(t) for t in np.asarray(prompt_ids, np.int32).reshape(-1)]
+        if not ids:
+            raise ValueError("empty prompt")
+        cached = self.paged.match_prefix(slot, ids)
+        if cached > 0:
+            cache = self._set_length_jit(self._sync_tables(cache), slot,
+                                         cached)
+            cache, logits = self.prefill_chunked(params, cache, ids, slot,
+                                                 start=cached)
+            n = -(-(len(ids) - cached) // self.prefill_chunk)
+        elif len(ids) <= self.prefill_chunk:
+            kv, logits = self.prefill(params, ids)
+            cache = self.insert(cache, kv, slot, len(ids))
+            n = 1
+        else:
+            cache, logits = self.prefill_chunked(params, cache, ids, slot)
+            n = -(-len(ids) // self.prefill_chunk)
+        self.paged.register_prompt(slot, ids)
+        return cache, logits, n, cached
+
     def insert(self, cache, kv, slot: int, length: int) -> dict:
-        """Park a prefill's blocks into ``slot`` (consumes ``cache``)."""
+        """Park a prefill's blocks into ``slot`` (consumes ``cache``).
+        On the paged layout this first allocates the slot's pages host-
+        side, then scatters the blocks through its block-table row."""
+        if self.paged is not None:
+            cache = self._ensure(cache, slot, 0, length)
+            cache = self._sync_tables(cache)
+            self.paged.set_len(slot, length)
         return self._insert_jit(cache, kv, slot, length)
 
     def release(self, cache, slot: int) -> dict:
-        """Free a slot for the next request (consumes ``cache``)."""
+        """Free a slot for the next request (consumes ``cache``). Paged:
+        drop the slot's page references — exclusively-held pages return
+        to the pool, pages shared with the radix cache (or other slots)
+        live on for the next prefix hit."""
+        if self.paged is not None:
+            self.paged.free_slot(slot)
+            cache = self._sync_tables(cache)
         return self._release_jit(cache, slot)
 
     def decode_step(self, params, cache, tokens, key, temperature,
@@ -630,12 +858,18 @@ class InferenceEngine:
         [slots] host or device arrays; returns (cache, next_tokens [slots],
         logits [slots, V] fp32). Consumes ``cache``."""
         self._hook("decode")
-        return self._dispatch(lambda: self._decode_jit(
+        if self.paged is not None:
+            cache = self._pre_write(cache, 1)
+        out = self._dispatch(lambda: self._decode_jit(
             params, cache,
             jnp.asarray(np.asarray(tokens, np.int32)), key,
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
             jnp.asarray(np.asarray(top_p, np.float32))))
+        if self.paged is not None:
+            # mirror the device rule: parked slots advanced by one
+            self.paged.advance((self.paged.host_len > 0).astype(np.int64))
+        return out
 
     def decode_block(self, params, cache, tokens, keys, eos_id, budget,
                      temperature, top_k, top_p) -> tuple:
@@ -652,9 +886,12 @@ class InferenceEngine:
                 f"{self.decode_block_len} (one key per in-block step)")
         self._hook("decode", budget)
         poison = self._poison("decode")
+        if self.paged is not None:
+            cache = self._pre_write(cache, self.decode_block_len,
+                                    budget=budget)
         # the program is resolved INSIDE the lambda so the flash->dense
         # fallback's rebuilt jits are what a re-dispatch runs
-        return self._dispatch(lambda: self._decode_block_prog(poison)(
+        out = self._dispatch(lambda: self._decode_block_prog(poison)(
             params, cache,
             jnp.asarray(np.asarray(tokens, np.int32)), keys,
             jnp.asarray(np.asarray(eos_id, np.int32)),
@@ -662,6 +899,12 @@ class InferenceEngine:
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
             jnp.asarray(np.asarray(top_p, np.float32))))
+        if self.paged is not None:
+            # mirror device length advancement (counts per slot). The
+            # host sync this forces is the block's ONE sync, just moved
+            # ahead of the batcher's own np.asarray on the same buffers.
+            self.paged.advance(np.asarray(out[2], np.int64))
+        return out
 
     def verify(self, params, cache, tokens, key, eos_id, budget,
                temperature, top_k, top_p) -> tuple:
@@ -687,11 +930,22 @@ class InferenceEngine:
                 f"{tokens.shape}")
         self._hook("verify", budget)
         poison = self._poison("verify")
+        if self.paged is not None:
+            # the verify writes spec_len + 1 rows OPTIMISTICALLY for every
+            # parked slot; ensuring them all exclusive BEFORE the dispatch
+            # is what makes the rollback free — rejected rows strand in
+            # pages only this slot holds, never in a shared one
+            cache = self._pre_write(cache, self.spec_len + 1)
         # resolved inside the lambda, exactly like decode_block's program
-        return self._dispatch(lambda: self._verify_prog(poison)(
+        out = self._dispatch(lambda: self._verify_prog(poison)(
             params, cache, jnp.asarray(tokens), key,
             jnp.asarray(np.asarray(eos_id, np.int32)),
             jnp.asarray(np.asarray(budget, np.int32)),
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
             jnp.asarray(np.asarray(top_p, np.float32))))
+        if self.paged is not None:
+            # device lengths advanced by the ACCEPTED counts (the length
+            # pointer is the rollback) — mirror exactly that
+            self.paged.advance(np.asarray(out[2], np.int64))
+        return out

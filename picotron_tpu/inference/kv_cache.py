@@ -147,7 +147,16 @@ def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
 
     int8 caches quantize on write; the scale rows land at the same
     positions in ``k_scale``/``v_scale``.
+
+    Paged caches (``inference.kv_layout: "paged"`` — the per-layer dict
+    carries ``block_tables``) route to the page-indirect scatter
+    (inference/paged_kv.py): same three write shapes, rows land in pool
+    pages instead of a contiguous strip.
     """
+    if "block_tables" in layer_cache:
+        from picotron_tpu.inference import paged_kv
+
+        return paged_kv.cache_write(layer_cache, k_new, v_new, pos)
     B, S = k_new.shape[0], k_new.shape[1]
     out = dict(layer_cache)
 
@@ -198,7 +207,16 @@ def attend(q: jnp.ndarray, layer_cache: dict, lengths: jnp.ndarray,
       kernel as stored and dequantize in registers: no whole-cache fp32
       materialization ever exists on this path. Runs in interpret mode off
       TPU; allclose-pinned against dense (tests/test_decode_kernel.py).
+
+    Paged caches (the per-layer dict carries ``block_tables``) route to
+    the page-indirect attends (inference/paged_kv.py): dense gathers the
+    slots' pages into a contiguous window and runs the same masked
+    einsum; flash walks the block table page by page in the kernel.
     """
+    if "block_tables" in layer_cache:
+        from picotron_tpu.inference import paged_kv
+
+        return paged_kv.attend(q, layer_cache, lengths, scale, impl)
     if impl == "flash":
         from picotron_tpu.ops.pallas.decode_attention import (
             flash_decode_attention,

@@ -1,0 +1,705 @@
+"""Paged KV cache: a global page pool with refcounted prefix sharing + COW.
+
+The contiguous cache (inference/kv_cache.py) gives every slot its own
+``max_seq_len`` strip, so HBM capacity is ``slots x max window`` no matter
+how short the live sequences are — and two requests with the same system
+prompt each prefill and store their own copy of it. This module replaces
+the strip with **block-table indirection** over a global pool of
+fixed-size KV pages (vLLM's PagedAttention layout) and builds **radix
+prefix sharing** on top (SGLang's RadixAttention):
+
+- **Device layout** (``init_cache``): the per-layer cache leaves become a
+  page pool ``k``/``v``: ``[num_layers, num_pages, page_len, n_kv_heads,
+  head_dim]`` (int8 mode adds ``k_scale``/``v_scale``
+  ``[L, P, page_len, Hkv]`` exactly like the contiguous layout), plus
+  ``block_tables [slots, max_pages_per_slot] int32`` mapping each slot's
+  logical page index to a pool page, and the same ``lengths [slots]``.
+  Page 0 is the reserved NULL page: unallocated table entries point at it
+  and every out-of-window or masked write is redirected into it, so a
+  bad index can scribble only on bytes nothing ever reads.
+- **Host allocator** (``PagePool`` / ``PagedKV``): a free list plus a
+  refcount per page. A page's refcount is the number of holders — each
+  slot whose block table points at it, plus the radix cache when the page
+  backs a cached prefix. Slots allocate lazily as their sequences grow
+  (``ensure_writable``), release returns every held page
+  (refcount-aware), and a write into a page with refcount > 1 first
+  **copies-on-write**: the writer gets a fresh copy (``copy_page``, a
+  byte-exact device copy) and drops its reference, so shared bytes are
+  immutable for as long as anyone shares them.
+- **Prefix sharing** (``RadixCache``): a trie over page-sized token
+  chunks. After a prompt prefills, its prompt pages are inserted (the
+  cache takes a reference); a new request walks the trie, reuses the
+  pages of its longest cached prefix (bumping refcounts — zero prefill
+  work for those tokens), and prefills only the suffix. The match may
+  end mid-page (a fork point): the request shares the tail page too, and
+  its first write past the fork triggers the COW above. Refcount-1
+  leaves (held by nobody but the cache) are evicted LRU-first when the
+  pool runs dry.
+
+Correctness contract: K/V rows at position ``p`` depend only on tokens
+``0..p`` (causal attention; the chunked-prefill overlap re-feed already
+relies on this), so a cached page whose token path matches a request's
+prompt prefix holds exactly the bytes that request's own prefill would
+have written — sharing changes WHERE bytes live, never what they are.
+The attend paths consume the indirection without changing math: the
+dense path gathers the slot's pages into a contiguous window and runs
+the same masked einsum (bit-identical — masked columns contribute exact
+zeros), the flash kernel walks ``block_tables[b, i]`` pages instead of
+contiguous blocks (ops/pallas/decode_attention.py). Selected by
+``inference.kv_layout: "paged"``; tests/test_paged_kv.py pins paged
+generations against contiguous across every dispatch family.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from picotron_tpu.config import ModelConfig
+from picotron_tpu.inference import kv_cache
+
+# table entries start here; page 0 is the reserved NULL page (never
+# allocated, the target of masked/out-of-window writes)
+NULL_PAGE = 0
+
+
+class PagePoolExhausted(RuntimeError):
+    """No free page and nothing evictable — the caller sheds, it never
+    corrupts a live slot."""
+
+
+# --------------------------------------------------------------------------- #
+# device ops (jitted by the engine)
+# --------------------------------------------------------------------------- #
+
+
+def cache_pspecs(quantized: bool = False) -> dict:
+    """PartitionSpecs of the paged cache pytree: identical to the
+    contiguous layout's (the kv-head axis of the pool — and of the int8
+    scale tensors — shards over 'tp'; page axes are replicated), plus the
+    replicated ``block_tables``."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = kv_cache.cache_pspecs(quantized)
+    specs["block_tables"] = P()
+    return specs
+
+
+def init_cache(m: ModelConfig, slots: int, num_pages: int, page_len: int,
+               max_pages: int, dtype=None, quantized: bool = False) -> dict:
+    """Zeroed page pool + NULL block tables + zero lengths. Same dtype
+    rules as the contiguous ``kv_cache.init_cache``."""
+    shape = (m.num_hidden_layers, num_pages, page_len,
+             m.num_key_value_heads, m.head_dim)
+    if quantized:
+        cache = {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], kv_cache.SCALE_DTYPE),
+            "v_scale": jnp.zeros(shape[:-1], kv_cache.SCALE_DTYPE),
+        }
+    else:
+        dt = jnp.dtype(dtype if dtype is not None else m.dtype)
+        cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    cache["block_tables"] = jnp.full((slots, max_pages), NULL_PAGE,
+                                     jnp.int32)
+    cache["lengths"] = jnp.zeros((slots,), jnp.int32)
+    return cache
+
+
+def _targets(bt: jnp.ndarray, rows: jnp.ndarray, page_len: int):
+    """Map logical row positions to (pool page, in-page offset) through a
+    block table. ``bt`` [..., max_pages], ``rows`` [..., S] global
+    positions. Rows outside the paged window redirect to the NULL page at
+    offset 0 (mirroring the contiguous scatter's drop semantics — those
+    rows are never visible either way)."""
+    maxp = bt.shape[-1]
+    valid = (rows >= 0) & (rows < maxp * page_len)
+    page_idx = jnp.clip(rows // page_len, 0, maxp - 1)
+    pid = jnp.take_along_axis(bt, page_idx, axis=-1)
+    pid = jnp.where(valid, pid, NULL_PAGE)
+    off = jnp.where(valid, rows % page_len, 0)
+    return pid, off
+
+
+def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                pos: jnp.ndarray) -> dict:
+    """Paged counterpart of ``kv_cache.cache_write``: scatter each slot's
+    S fresh rows through its block-table row. One generic gather+scatter
+    serves all three write shapes (decode S=1, verify B>1 S>1, chunked
+    prefill B=1 S=C) — row ``pos[b] + s`` lands in pool page
+    ``bt[b, (pos+s) // page_len]`` at offset ``(pos+s) % page_len``.
+    Out-of-window rows (and free slots' NULL table entries) write the
+    NULL page. int8 caches quantize on write exactly like the contiguous
+    path. The host allocator guarantees every page this can touch is
+    exclusively owned by the writing slot (COW ran before the dispatch),
+    so a shared page's bytes are never mutated — including by the
+    speculative verify's optimistic writes that a rollback later strands.
+    """
+    B, S = k_new.shape[0], k_new.shape[1]
+    bt = layer_cache["block_tables"]  # [B, max_pages] int32
+    page_len = layer_cache["k"].shape[1]
+    rows = pos[:, None].astype(jnp.int32) + jnp.arange(S, dtype=jnp.int32)
+    pid, off = _targets(bt, rows, page_len)  # [B, S] each
+    out = dict(layer_cache)
+
+    def store(name, sname, new):
+        if kv_cache.quantized(layer_cache):
+            vals, scales = kv_cache.quantize_kv(new)
+        else:
+            vals, scales = new.astype(layer_cache[name].dtype), None
+        out[name] = layer_cache[name].at[pid, off].set(vals)
+        if scales is not None:
+            out[sname] = layer_cache[sname].at[pid, off].set(
+                scales.astype(kv_cache.SCALE_DTYPE))
+
+    store("k", "k_scale", k_new)
+    store("v", "v_scale", v_new)
+    return out
+
+
+def gather_window(pool: jnp.ndarray, bt: jnp.ndarray) -> jnp.ndarray:
+    """Materialize slots' logical windows from the pool: ``pool``
+    [P, page_len, ...] + ``bt`` [B, max_pages] -> [B, max_pages *
+    page_len, ...] — the contiguous view the dense reference attend
+    consumes. (The flash kernel never materializes this; it walks the
+    table page by page.)"""
+    g = pool[bt]  # [B, max_pages, page_len, ...]
+    return g.reshape((bt.shape[0], -1) + pool.shape[2:])
+
+
+def attend(q: jnp.ndarray, layer_cache: dict, lengths: jnp.ndarray,
+           scale: float, impl: str = "dense") -> jnp.ndarray:
+    """Masked attention of S fresh queries against one layer's paged
+    cache. "dense" gathers the slots' pages into a contiguous window and
+    runs the bit-pinned ``kv_cache.decode_attention`` (int8 first
+    dequantizes the gathered window to fp32, the same reference
+    discipline as contiguous dense); "flash" hands the pool + block
+    tables to the Pallas kernel, which DMAs pages straight from HBM —
+    no gathered window ever exists on that path."""
+    bt = layer_cache["block_tables"]
+    if impl == "flash":
+        from picotron_tpu.ops.pallas.decode_attention import (
+            flash_decode_attention,
+        )
+        from picotron_tpu.utils import on_tpu
+
+        return flash_decode_attention(
+            q, layer_cache["k"], layer_cache["v"], lengths, scale,
+            k_scale=layer_cache.get("k_scale"),
+            v_scale=layer_cache.get("v_scale"),
+            block_tables=bt, interpret=not on_tpu())
+    if impl != "dense":
+        raise ValueError(f"unknown attend impl {impl!r} (dense|flash)")
+    k = gather_window(layer_cache["k"], bt)
+    v = gather_window(layer_cache["v"], bt)
+    if kv_cache.quantized(layer_cache):
+        k = kv_cache.dequantize_kv(
+            k, gather_window(layer_cache["k_scale"], bt), jnp.float32)
+        v = kv_cache.dequantize_kv(
+            v, gather_window(layer_cache["v_scale"], bt), jnp.float32)
+    return kv_cache.decode_attention(q, k, v, lengths, scale)
+
+
+def insert_prefill(cache: dict, kv: dict, slot, length) -> dict:
+    """Park a one-shot prefill's ``[L, 1, S_bucket, H, D]`` blocks into
+    ``slot``'s pages and set its length — the paged ``insert``. Pad rows
+    beyond ``length`` (and rows whose page was never allocated) write the
+    NULL page. ``slot``/``length`` may be traced — one compile per bucket
+    size, like the contiguous path."""
+    slot = jnp.asarray(slot, jnp.int32)
+    length = jnp.asarray(length, jnp.int32)
+    bt = cache["block_tables"]
+    row = lax.dynamic_slice_in_dim(bt, slot, 1, axis=0)  # [1, max_pages]
+    S = kv["k"].shape[2]
+    page_len = cache["k"].shape[2]
+    rows = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
+    rows = jnp.where(rows < length, rows, -1)  # pad rows -> NULL page
+    pid, off = _targets(row, rows, page_len)
+    pid, off = pid[0], off[0]  # [S]
+
+    def put(name):
+        dst = cache[name]
+        src = kv[name][:, 0].astype(dst.dtype)  # [L, S, ...]
+        return dst.at[:, pid, off].set(src)
+
+    out = {name: put(name) for name in cache
+           if name not in ("lengths", "block_tables")}
+    out["block_tables"] = bt
+    out["lengths"] = cache["lengths"].at[slot].set(length)
+    return out
+
+
+def copy_page(cache: dict, src, dst) -> dict:
+    """Byte-exact pool-page copy across every layer and every storage
+    leaf (K, V, scales) — the device half of copy-on-write. ``src``/
+    ``dst`` may be traced scalars: one compiled executable serves every
+    copy."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    out = dict(cache)
+    for name, a in cache.items():
+        if name in ("lengths", "block_tables"):
+            continue
+        page = lax.dynamic_slice_in_dim(a, src, 1, axis=1)
+        out[name] = lax.dynamic_update_slice_in_dim(a, page, dst, axis=1)
+    return out
+
+
+def set_length(cache: dict, slot, length) -> dict:
+    """Set one slot's length pointer (admission of a shared prefix: the
+    slot's visible history becomes the cached pages, no prefill ran)."""
+    return {**cache, "lengths": cache["lengths"].at[slot].set(
+        jnp.asarray(length, jnp.int32))}
+
+
+def slot_rows(cache: dict, tables: np.ndarray, slot: int, n: int,
+              name: str = "k") -> np.ndarray:
+    """Test/debug helper: read back slot ``slot``'s first ``n`` logical
+    rows of storage leaf ``name`` as [L, n, ...] host arrays, resolving
+    the page indirection through the HOST table copy."""
+    pool = np.asarray(cache[name])
+    plen = pool.shape[2]
+    out = []
+    for r in range(n):
+        pid = int(tables[slot, r // plen])
+        out.append(pool[:, pid, r % plen])
+    return np.stack(out, axis=1)
+
+
+# --------------------------------------------------------------------------- #
+# host-side allocator
+# --------------------------------------------------------------------------- #
+
+
+class PagePool:
+    """Free list + refcounts over ``num_pages`` pool pages. Page 0 is
+    reserved (NULL) and never allocated. A page's refcount counts its
+    holders — slots whose tables point at it plus the radix cache —
+    and the page returns to the free list exactly when the count hits 0.
+    Deterministic FIFO allocation order (tests replay it)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        self.num_pages = int(num_pages)
+        self.refs = np.zeros(self.num_pages, np.int32)
+        self.refs[NULL_PAGE] = 1  # permanently held, never freed
+        self._free: deque = deque(range(1, self.num_pages))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def usable_pages(self) -> int:
+        """Pages that can ever hold data (everything but NULL)."""
+        return self.num_pages - 1
+
+    @property
+    def live_count(self) -> int:
+        return self.usable_pages - self.free_count
+
+    @property
+    def shared_count(self) -> int:
+        """Pages with more than one holder (prefix sharing in effect)."""
+        return int(np.sum(self.refs[1:] > 1))
+
+    def alloc(self):
+        """Pop a free page at refcount 1, or None when the pool is dry
+        (the caller evicts or sheds — alloc itself never raises)."""
+        if not self._free:
+            return None
+        pid = self._free.popleft()
+        assert self.refs[pid] == 0
+        self.refs[pid] = 1
+        return pid
+
+    def ref(self, pid: int) -> None:
+        """Add a holder. Refusing to resurrect a freed page (refcount 0)
+        is what makes use-after-free a loud error instead of corruption."""
+        if pid == NULL_PAGE:
+            raise ValueError("cannot take a reference on the NULL page")
+        if self.refs[pid] <= 0:
+            raise ValueError(f"page {pid} is free; ref would resurrect it")
+        self.refs[pid] += 1
+
+    def unref(self, pid: int) -> bool:
+        """Drop a holder; returns True when this freed the page. A drop
+        below zero is a double free — raised, never masked."""
+        if pid == NULL_PAGE:
+            raise ValueError("cannot drop a reference on the NULL page")
+        if self.refs[pid] <= 0:
+            raise ValueError(f"double free of page {pid}")
+        self.refs[pid] -= 1
+        if self.refs[pid] == 0:
+            self._free.append(pid)
+            return True
+        return False
+
+
+class _Node:
+    """One radix-cache node: a pool page holding the K/V rows of
+    ``tokens`` (a full ``page_len`` chunk for interior nodes, shorter for
+    partial leaves at prompt tails)."""
+
+    __slots__ = ("tokens", "page_id", "parent", "children", "last_use")
+
+    def __init__(self, tokens: tuple, page_id: int, parent):
+        self.tokens = tokens
+        self.page_id = page_id
+        self.parent = parent
+        self.children: dict = {}
+        self.last_use = 0
+
+
+class RadixCache:
+    """Prefix trie over page-sized token chunks -> pool pages.
+
+    ``match`` walks full-page chunks by exact lookup, then closes with
+    the best partial overlap among the children at the divergence point —
+    the page backing that overlap is shared too, and the sharer's first
+    write past the fork COWs it. ``insert`` registers a prefilled
+    prompt's pages (the cache becomes a holder: refcount +1). Eviction is
+    LRU over refcount-1 leaves (pages nobody but the cache holds);
+    freeing a leaf can expose its parent as the next candidate."""
+
+    def __init__(self, page_len: int, pool: PagePool):
+        self.page_len = int(page_len)
+        self.pool = pool
+        self.root = _Node((), -1, None)
+        self._clock = 0
+        self.evictions = 0
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.last_use = self._clock
+
+    @staticmethod
+    def _overlap(a, b) -> int:
+        n = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            n += 1
+        return n
+
+    def match(self, ids) -> tuple:
+        """Longest cached prefix of ``ids``: returns (pages, matched)
+        where ``pages`` back positions ``[0, matched)`` in order (the
+        last may be partial: ``matched`` can end mid-page)."""
+        node, pages, matched = self.root, [], 0
+        rest = list(ids)
+        while True:
+            chunk = tuple(rest[: self.page_len])
+            child = (node.children.get(chunk)
+                     if len(chunk) == self.page_len else None)
+            if child is not None and len(child.tokens) == self.page_len:
+                pages.append(child.page_id)
+                matched += self.page_len
+                rest = rest[self.page_len:]
+                self._touch(child)
+                node = child
+                continue
+            best, bj = None, 0
+            for c in node.children.values():
+                j = self._overlap(c.tokens, rest)
+                if j > bj:
+                    best, bj = c, j
+            if best is not None:
+                pages.append(best.page_id)
+                matched += bj
+                self._touch(best)
+            return pages, matched
+
+    def insert(self, ids, page_at) -> int:
+        """Register a prefilled prompt's pages: ``page_at(i)`` resolves
+        the prompt's logical page ``i`` (the slot's table). Existing
+        nodes are touched, new ones take a cache reference on the slot's
+        page. The partial tail (a prompt ending mid-page) becomes a
+        partial leaf unless an existing child already covers it. Returns
+        the number of nodes created."""
+        node, created = self.root, 0
+        n = len(ids)
+        full = n // self.page_len
+        for i in range(full):
+            chunk = tuple(ids[i * self.page_len:(i + 1) * self.page_len])
+            child = node.children.get(chunk)
+            if child is None:
+                pid = page_at(i)
+                if pid == NULL_PAGE or self.pool.refs[pid] != 1:
+                    # not exclusively the slot's (window edge oddities);
+                    # stop registering rather than freeze a moving page
+                    return created
+                child = _Node(chunk, pid, node)
+                node.children[chunk] = child
+                self.pool.ref(pid)
+                created += 1
+            self._touch(child)
+            node = child
+        tail = tuple(ids[full * self.page_len:])
+        if tail:
+            for c in node.children.values():
+                if self._overlap(c.tokens, tail) == len(tail):
+                    return created  # an existing child already covers it
+            pid = page_at(full)
+            if pid != NULL_PAGE and self.pool.refs[pid] == 1:
+                leaf = _Node(tail, pid, node)
+                node.children[tail] = leaf
+                self.pool.ref(pid)
+                self._touch(leaf)
+                created += 1
+        return created
+
+    def _leaves(self):
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            if n is not self.root and not n.children:
+                yield n
+            stack.extend(n.children.values())
+
+    def evictable_count(self) -> int:
+        """Pages eviction could free, cascading: nodes whose ENTIRE
+        subtree is held only by the cache (freeing a leaf exposes its
+        parent, so a refcount-1 chain frees end to end). Counting the
+        cascade — not just today's leaves — is what keeps admission from
+        deadlocking behind a deep cached prefix when no slot holds it."""
+
+        def count(n: _Node) -> tuple:
+            total, free = 0, True
+            for c in n.children.values():
+                ct, cf = count(c)
+                total += ct
+                free = free and cf
+            if not free or self.pool.refs[n.page_id] != 1:
+                return total, False
+            return total + 1, True
+
+        return sum(count(c)[0] for c in self.root.children.values())
+
+    def evict_one(self) -> bool:
+        """Free the least-recently-used refcount-1 leaf's page. Returns
+        False when nothing is evictable (every cached page is also held
+        by a live slot)."""
+        best = None
+        for n in self._leaves():
+            if self.pool.refs[n.page_id] == 1 and (
+                    best is None or n.last_use < best.last_use):
+                best = n
+        if best is None:
+            return False
+        self.pool.unref(best.page_id)
+        del best.parent.children[best.tokens]
+        self.evictions += 1
+        return True
+
+    def clear(self) -> None:
+        """Drop every cache reference (pool reset path)."""
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            self.pool.unref(n.page_id)
+            stack.extend(n.children.values())
+        self.root.children = {}
+
+
+class PagedKV:
+    """Host-side page manager for one engine: per-slot block tables +
+    lengths, the pool, the radix cache, and admission pricing.
+
+    The engine consults it before every dispatch (``ensure_writable`` —
+    allocate growth pages, COW shared ones), mirrors device length
+    advancement after (``advance``/``set_len``), and frees on slot
+    release. The batcher prices admission in pages against
+    ``can_admit`` so decode-time allocation is never the thing that
+    discovers overload. ``tables`` is the numpy master the engine ships
+    to the device before each dispatch."""
+
+    def __init__(self, slots: int, page_len: int, max_pages: int,
+                 num_pages: int, prefix_cache: bool = True):
+        self.slots = int(slots)
+        self.page_len = int(page_len)
+        self.max_pages = int(max_pages)
+        self.num_pages = int(num_pages)
+        self.prefix_cache = bool(prefix_cache)
+        self.reset()
+
+    def reset(self) -> None:
+        """Fresh pool/trie/tables — pairs with a fresh zeroed device
+        cache (engine.init_cache), including the batcher's cache-lost
+        rebuild."""
+        self.pool = PagePool(self.num_pages)
+        self.radix = RadixCache(self.page_len, self.pool)
+        self.tables = np.full((self.slots, self.max_pages), NULL_PAGE,
+                              np.int32)
+        self.host_len = np.zeros(self.slots, np.int64)
+        self.priced = np.zeros(self.slots, np.int64)
+        # prefix-cache effectiveness counters (stats())
+        self.prefix_queries = 0
+        self.prefix_hits = 0
+        self.prompt_tokens = 0
+        self.cached_tokens = 0
+        self.cow_copies = 0
+
+    # ---- pricing / admission ---------------------------------------------
+
+    def pages_for(self, tokens: int) -> int:
+        """Worst-case pages ``tokens`` rows can occupy."""
+        return -(-max(int(tokens), 0) // self.page_len)
+
+    @property
+    def usable_pages(self) -> int:
+        return self.pool.usable_pages
+
+    def future_need(self) -> int:
+        """Pages the live slots may still demand: each priced slot can
+        grow (and COW) until every page of its worst-case commitment is
+        exclusively its own, so only exclusively-held pages discharge the
+        debt. Conservative by construction — shared full-prefix pages are
+        never actually COW'd, but counting them keeps decode-time
+        allocation from ever being the thing that discovers overload."""
+        need = 0
+        for s in range(self.slots):
+            if self.priced[s] <= 0:
+                continue
+            exclusive = sum(1 for pid in self.tables[s]
+                            if pid != NULL_PAGE and self.pool.refs[pid] == 1)
+            need += max(0, int(self.priced[s]) - exclusive)
+        return need
+
+    def available_pages(self) -> int:
+        """Pages an incoming request could claim right now: free +
+        immediately evictable, minus what live slots are still owed."""
+        return (self.pool.free_count + self.radix.evictable_count()
+                - self.future_need())
+
+    def can_admit(self, need: int) -> bool:
+        return need <= self.available_pages()
+
+    # ---- slot lifecycle ---------------------------------------------------
+
+    def _alloc(self) -> int:
+        pid = self.pool.alloc()
+        while pid is None:
+            if not self.radix.evict_one():
+                raise PagePoolExhausted(
+                    f"page pool exhausted ({self.pool.usable_pages} pages, "
+                    f"none free or evictable)")
+            pid = self.pool.alloc()
+        return pid
+
+    def match_prefix(self, slot: int, ids) -> int:
+        """Admission half of prefix sharing: find the longest cached
+        prefix of ``ids``, take references on its pages into ``slot``'s
+        table, and return the cached length (capped at ``len(ids) - 1``
+        so the last prompt token always runs through the model — its
+        logits seed the first sampled token).
+
+        Idempotent under the batcher's dispatch retry: any holdings a
+        FAILED earlier admission attempt left in this slot (shared refs,
+        stranded COW copies) are released first — without that, a
+        transient prefill fault would double-ref the cached pages, and
+        pages nobody holds could never return to the free list."""
+        for pi in range(self.max_pages):
+            pid = int(self.tables[slot, pi])
+            if pid != NULL_PAGE:
+                self.pool.unref(pid)
+        self.tables[slot] = NULL_PAGE
+        self.host_len[slot] = 0
+        self.prefix_queries += 1
+        self.prompt_tokens += len(ids)
+        if not self.prefix_cache:
+            return 0
+        pages, matched = self.radix.match(ids)
+        cached = min(matched, len(ids) - 1)
+        npages = self.pages_for(cached)
+        for i in range(npages):
+            self.pool.ref(pages[i])
+            self.tables[slot, i] = pages[i]
+        self.host_len[slot] = cached
+        if cached > 0:
+            self.prefix_hits += 1
+            self.cached_tokens += cached
+        return cached
+
+    def ensure_writable(self, slot: int, from_pos: int, to_pos: int) -> list:
+        """Make rows ``[from_pos, to_pos)`` of ``slot`` writable: allocate
+        missing pages, and for shared pages (refcount > 1) allocate a
+        fresh page, record a (src, dst) copy-on-write pair for the engine
+        to execute on device, and swap the slot's reference. Idempotent —
+        already-exclusive pages are untouched. Clamped to the paged
+        window. Raises PagePoolExhausted when the pool is truly dry."""
+        to_pos = min(int(to_pos), self.max_pages * self.page_len)
+        from_pos = max(int(from_pos), 0)
+        cows = []
+        if to_pos <= from_pos:
+            return cows
+        first = from_pos // self.page_len
+        last = -(-to_pos // self.page_len)  # exclusive
+        for pi in range(first, last):
+            pid = int(self.tables[slot, pi])
+            if pid == NULL_PAGE:
+                self.tables[slot, pi] = self._alloc()
+            elif self.pool.refs[pid] > 1:
+                fresh = self._alloc()
+                cows.append((pid, fresh))
+                self.tables[slot, pi] = fresh
+                self.pool.unref(pid)
+                self.cow_copies += 1
+        return cows
+
+    def register_prompt(self, slot: int, ids) -> None:
+        """Insert a freshly prefilled prompt's pages into the radix
+        cache (post-prefill: the pages hold final bytes; the slot's
+        decode writes land past the prompt and COW first)."""
+        if self.prefix_cache:
+            self.radix.insert(ids, lambda i: int(self.tables[slot, i]))
+
+    def advance(self, slot_counts: np.ndarray) -> None:
+        """Mirror device length advancement after a dispatch (counts per
+        slot, 0 for inactive)."""
+        self.host_len += np.asarray(slot_counts, np.int64)
+
+    def set_len(self, slot: int, n: int) -> None:
+        self.host_len[slot] = int(n)
+
+    def free_slot(self, slot: int) -> None:
+        """Release every page reference the slot holds (pages shared
+        with the radix cache or other slots survive; exclusive ones
+        return to the free list) and clear its table row."""
+        for pi in range(self.max_pages):
+            pid = int(self.tables[slot, pi])
+            if pid != NULL_PAGE:
+                self.pool.unref(pid)
+        self.tables[slot] = NULL_PAGE
+        self.host_len[slot] = 0
+        self.priced[slot] = 0
+
+    # ---- observability ----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Pool occupancy + prefix-cache effectiveness (merged into
+        ``batcher.stats()`` -> ``/statz`` and the bench JSON)."""
+        total = self.pool.usable_pages
+        live = self.pool.live_count
+        return {
+            "kv_layout": "paged",
+            "kv_page_len": self.page_len,
+            "kv_pages_total": total,
+            "kv_pages_free": self.pool.free_count,
+            "kv_pages_live": live,
+            "kv_pool_utilization": round(live / max(total, 1), 4),
+            "kv_pages_shared": self.pool.shared_count,
+            "prefix_queries": self.prefix_queries,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_rate": (
+                round(self.cached_tokens / self.prompt_tokens, 4)
+                if self.prompt_tokens else None),
+            "prefix_cached_tokens": self.cached_tokens,
+            "cow_copies": self.cow_copies,
+            "radix_evictions": self.radix.evictions,
+        }

@@ -92,14 +92,22 @@ def _pick_block_t(seq: int, want: int, rows: int = _SUBLANE) -> int:
     return _pick_block(seq, want)
 
 
-def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized):
+def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized, paged):
     """One (slot, kv head) grid instance: all S*g query rows of slot ``b``
-    under kv head ``h`` against the slot's live KV blocks."""
+    under kv head ``h`` against the slot's live KV blocks. ``paged``
+    mode walks the slot's block-table row instead of contiguous blocks:
+    iteration ``j`` DMAs pool page ``bt[b, j]`` (K/V are the global
+    ``[num_pages, page_len, Hkv, D]`` pool, ``block_t == page_len``) —
+    the indirection lives entirely in the DMA source address, the
+    online-softmax math is unchanged."""
+    refs = list(refs)
+    len_ref = refs.pop(0)
+    bt_ref = refs.pop(0) if paged else None
     if quantized:
-        (len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
          kbuf, vbuf, ksbuf, vsbuf, sems) = refs
     else:
-        (len_ref, q_ref, k_ref, v_ref, o_ref, kbuf, vbuf, sems) = refs
+        (q_ref, k_ref, v_ref, o_ref, kbuf, vbuf, sems) = refs
         ks_ref = vs_ref = ksbuf = vsbuf = None
     # program ids are read ONCE here: the 0.4.37 interpreter cannot resolve
     # pl.program_id inside the fori_loop body's sub-jaxpr
@@ -115,18 +123,24 @@ def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized):
 
     def body(j, carry):
         acc, m, l = carry
-        rows = pl.ds(j * block_t, block_t)
-        kdma = pltpu.make_async_copy(k_ref.at[b, rows, h, :], kbuf,
-                                     sems.at[0])
-        vdma = pltpu.make_async_copy(v_ref.at[b, rows, h, :], vbuf,
-                                     sems.at[1])
+        if paged:
+            # the page walk: block j's DMA source is pool page bt[b, j]
+            pid = bt_ref[0, j]
+            ksrc, vsrc = k_ref.at[pid, :, h, :], v_ref.at[pid, :, h, :]
+            kssrc = None if not quantized else ks_ref.at[pid, :, h]
+            vssrc = None if not quantized else vs_ref.at[pid, :, h]
+        else:
+            rows = pl.ds(j * block_t, block_t)
+            ksrc, vsrc = k_ref.at[b, rows, h, :], v_ref.at[b, rows, h, :]
+            kssrc = None if not quantized else ks_ref.at[b, rows, h]
+            vssrc = None if not quantized else vs_ref.at[b, rows, h]
+        kdma = pltpu.make_async_copy(ksrc, kbuf, sems.at[0])
+        vdma = pltpu.make_async_copy(vsrc, vbuf, sems.at[1])
         kdma.start()
         vdma.start()
         if quantized:
-            ksdma = pltpu.make_async_copy(ks_ref.at[b, rows, h], ksbuf,
-                                          sems.at[2])
-            vsdma = pltpu.make_async_copy(vs_ref.at[b, rows, h], vsbuf,
-                                          sems.at[3])
+            ksdma = pltpu.make_async_copy(kssrc, ksbuf, sems.at[2])
+            vsdma = pltpu.make_async_copy(vssrc, vsbuf, sems.at[3])
             ksdma.start()
             vsdma.start()
         kdma.wait()
@@ -165,9 +179,10 @@ def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized):
     # block count: at the window edge the engine's write-then-attend
     # convention can pass lengths = pos + S > T (the scatter dropped the
     # out-of-bounds rows), and the walk must not DMA past the cache
-    # (the dense kernel's mask absorbs the same case for free).
-    nb = jnp.minimum(lax.div(L + block_t - 1, block_t),
-                     k_ref.shape[1] // block_t)
+    # (the dense kernel's mask absorbs the same case for free). Paged
+    # mode clamps to the block-table width instead.
+    max_nb = bt_ref.shape[1] if paged else k_ref.shape[1] // block_t
+    nb = jnp.minimum(lax.div(L + block_t - 1, block_t), max_nb)
     acc, _, l = lax.fori_loop(0, nb, body, (acc0, m0, l0))
     out = acc / jnp.where(l > 0, l, 1.0)
     o_ref[0, 0] = jnp.where(l > 0, out, 0.0).astype(o_ref.dtype)
@@ -176,6 +191,7 @@ def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized):
 def flash_decode_attention(q, k, v, lengths, scale, *,
                            k_scale=None, v_scale=None,
                            block_t: int | None = None,
+                           block_tables=None,
                            interpret: bool = False):
     """Fused masked attention of S fresh queries per slot against a KV
     cache block, reading only live rows.
@@ -191,9 +207,25 @@ def flash_decode_attention(q, k, v, lengths, scale, *,
     ``lengths == 0``, or the leading rows of a direct call with
     ``lengths < S`` — return ZEROS, where the dense kernel emits an
     equally-unconsumed uniform average over the whole window.
-    ``interpret=True`` runs the Pallas interpreter (the CPU path)."""
+    ``interpret=True`` runs the Pallas interpreter (the CPU path).
+
+    ``block_tables`` ([B, max_pages] int32) switches to the PAGED cache
+    layout (inference/paged_kv.py): k/v (and scales) are then the global
+    page pool — ``[num_pages, page_len, n_kv_heads, D]`` — and slot
+    ``b``'s walk reads pool page ``block_tables[b, j]`` at iteration
+    ``j`` instead of its contiguous block ``j``. The KV block size is
+    the page length; everything else (masking, online softmax, GQA fold,
+    in-register dequant) is the identical code path."""
     B, S, nh, D = q.shape
-    T, nkv = k.shape[1], k.shape[2]
+    paged = block_tables is not None
+    if paged:
+        if block_tables.shape[0] != B:
+            raise ValueError(
+                f"block_tables rows {block_tables.shape[0]} != batch {B}")
+        T = block_tables.shape[1] * k.shape[1]  # max_pages * page_len
+        nkv = k.shape[2]
+    else:
+        T, nkv = k.shape[1], k.shape[2]
     if nh % nkv:
         raise ValueError(f"n_heads {nh} not a multiple of n_kv_heads {nkv}")
     quantized = k_scale is not None
@@ -206,7 +238,10 @@ def flash_decode_attention(q, k, v, lengths, scale, *,
     g = nh // nkv
     sg = S * g
     sgp = -(-sg // _SUBLANE) * _SUBLANE  # pad query rows to the sublane tile
-    bt = _pick_block_t(T, block_t or DEFAULT_BLOCK_T, rows=sgp)
+    # paged: the DMA unit is a whole pool page, so the block size IS the
+    # page length (the allocator's granularity, already VMEM-sized)
+    bt = (k.shape[1] if paged
+          else _pick_block_t(T, block_t or DEFAULT_BLOCK_T, rows=sgp))
     # fold [B, S, nkv, g, D] -> [B, nkv, S*g, D]: one kv head's whole query
     # group per grid instance (tiny copy — S is 1..chunk, never the cache)
     qf = q.reshape(B, S, nkv, g, D).swapaxes(1, 2).reshape(B, nkv, sg, D)
@@ -215,14 +250,22 @@ def flash_decode_attention(q, k, v, lengths, scale, *,
 
     kernel = functools.partial(
         _flash_decode_kernel, scale=float(scale), block_t=bt, S=S, g=g,
-        quantized=quantized)
+        quantized=quantized, paged=paged)
     in_specs = [
         pl.BlockSpec((1,), lambda b, h: (b,), memory_space=pltpu.SMEM),
+    ]
+    operands = [lengths.astype(jnp.int32)]
+    if paged:
+        maxp = block_tables.shape[1]
+        in_specs.append(pl.BlockSpec((1, maxp), lambda b, h: (b, 0),
+                                     memory_space=pltpu.SMEM))
+        operands.append(block_tables.astype(jnp.int32))
+    in_specs += [
         pl.BlockSpec((1, 1, sgp, D), lambda b, h: (b, h, 0, 0)),
         pl.BlockSpec(memory_space=pltpu.ANY),  # K stays in HBM
         pl.BlockSpec(memory_space=pltpu.ANY),  # V stays in HBM
     ]
-    operands = [lengths.astype(jnp.int32), qf, k, v]
+    operands += [qf, k, v]
     scratch = [pltpu.VMEM((bt, D), k.dtype), pltpu.VMEM((bt, D), v.dtype)]
     if quantized:
         in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2

@@ -132,6 +132,15 @@ def main(argv=None) -> int:
     ap.add_argument("--spec-ngram", type=int, default=None,
                     help="longest suffix n-gram the prompt-lookup drafter "
                          "matches (default: config inference.spec_ngram)")
+    ap.add_argument("--kv-layout", choices=["contiguous", "paged"],
+                    default=None,
+                    help="KV cache layout (default: config "
+                         "inference.kv_layout; paged = block-table pool "
+                         "with refcounted prefix sharing + COW)")
+    ap.add_argument("--check-layout-parity", action="store_true",
+                    help="run the batch again under the OTHER kv layout "
+                         "and fail unless every request's tokens match — "
+                         "the `make paged-smoke` equivalence gate")
     ap.add_argument("--smoke", action="store_true",
                     help="built-in tiny CPU model + random init + fixed "
                     "prompts (the `make decode-smoke` target)")
@@ -165,6 +174,8 @@ def main(argv=None) -> int:
 
     if args.kv_cache_dtype is not None:
         cfg.inference.kv_cache_dtype = args.kv_cache_dtype
+    if args.kv_layout is not None:
+        cfg.inference.kv_layout = args.kv_layout
     t0 = time.perf_counter()
     engine = InferenceEngine(cfg, slots=args.slots,
                              max_seq_len=args.max_seq_len,
@@ -180,6 +191,32 @@ def main(argv=None) -> int:
     batcher = ContinuousBatcher(engine, params, seed=args.seed)
     results = batcher.run(requests)
     gen_s = time.perf_counter() - t0
+
+    if args.check_layout_parity:
+        # same batch, same seed/weights, the OTHER cache layout: every
+        # request's token stream must match exactly (the paged layout's
+        # equivalence gate — prefix sharing and COW must be invisible in
+        # the output)
+        other = ("contiguous" if engine.kv_layout == "paged" else "paged")
+        eng2 = InferenceEngine(cfg, slots=args.slots,
+                               max_seq_len=args.max_seq_len,
+                               decode_block_len=args.decode_block_len,
+                               prefill_chunk=args.prefill_chunk,
+                               spec_len=args.spec_len,
+                               spec_ngram=args.spec_ngram,
+                               kv_layout=other)
+        results2 = ContinuousBatcher(
+            eng2, _load_weights(args, cfg, eng2), seed=args.seed,
+        ).run(_build_requests(args, tokenizer))
+        bad = [u for u in results
+               if results[u].tokens != results2[u].tokens]
+        if bad:
+            print(f"FAILED: layout parity mismatch "
+                  f"({engine.kv_layout} vs {other}) for {bad}",
+                  file=sys.stderr)
+            return 1
+        print(f"layout parity: {engine.kv_layout} == {other} for "
+              f"{len(results)} requests")
 
     n_tokens = 0
     failed = False

@@ -39,6 +39,13 @@ Admission control (checked atomically at POST time):
   window-capped ``max_new_tokens``) of every live request is capped by
   ``--token-budget`` (default: ``slots * max_seq_len``, the cache's real
   capacity); past it new work is a 429. Both carry ``Retry-After``.
+- **page budget** (``inference.kv_layout: "paged"`` only) — requests are
+  additionally priced in KV POOL PAGES (``ceil(commitment / page_len)``,
+  not a contiguous worst-case strip) against the pool size; past it, 429
+  with a ``Retry-After`` scaled to the page deficit. ``/statz`` then also
+  carries the pool occupancy and prefix-cache hit stats
+  (``kv_pages_*``, ``prefix_hit_rate``, ``cow_copies`` — from
+  ``batcher.stats()``; docs/SERVING.md).
 
 Graceful drain (the ``resilience.preemption.PreemptionGuard`` pattern):
 SIGTERM/SIGINT flips readiness, sheds the queued-but-unstarted requests
@@ -129,7 +136,8 @@ class FrontEnd:
         self.stalled = False
         self.stalls = 0  # stall episodes the watchdog flagged
         self.rejections = {"queue_full": 0, "token_budget": 0,
-                           "draining": 0, "stalled": 0, "dead": 0}
+                           "page_budget": 0, "draining": 0, "stalled": 0,
+                           "dead": 0}
         self._uid_seq = 0
         self._start_t = time.monotonic()
         self._progress_t = time.monotonic()
@@ -227,6 +235,23 @@ class FrontEnd:
                 raise AdmissionError(
                     429, f"token budget exhausted ({self.token_budget})",
                     retry_after=1)
+            if self.engine.paged is not None:
+                # paged layout: price in POOL PAGES, not contiguous
+                # strips — ceil(commitment / page_len) against the pool,
+                # with Retry-After scaled to the page deficit (deeper
+                # overload -> back off longer; capped at 30s)
+                need = self._batcher.page_commitment(req)
+                usable = self.engine.paged.usable_pages
+                load = self._batcher.page_load()
+                if load + need > usable:
+                    deficit = load + need - usable
+                    self.rejections["page_budget"] += 1
+                    raise AdmissionError(
+                        429,
+                        f"kv page pool exhausted (need {need} of "
+                        f"{usable - min(load, usable)} pages free)",
+                        retry_after=min(30, 1 + deficit
+                                        // max(1, usable // 4)))
             if req.uid in self._waiters:
                 raise AdmissionError(400, f"duplicate uid {req.uid!r}",
                                      retry_after=0)
@@ -708,7 +733,8 @@ def main(argv=None) -> int:
         max_seq_len=engine.max_seq_len, max_queue=args.max_queue,
         token_budget=server.front.token_budget,
         attend_impl=engine.attend_impl,
-        kv=str(engine.cache_dtype), tp=engine.topo.tp_size)
+        kv=str(engine.cache_dtype), kv_layout=engine.kv_layout,
+        tp=engine.topo.tp_size)
 
     if args.smoke:
         rc = _smoke(server)
