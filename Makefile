@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke serve-smoke serve-chaos-smoke clean
+.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -85,6 +85,17 @@ paged-smoke:
 # equivalence, corrupt-checkpoint fallback, supervisor restart bounds.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+
+# Pod-scale chaos drills (resilience/cluster.py, docs/MULTIHOST.md): a
+# REAL 2-process jax.distributed CPU pod under tools/supervise.py
+# --num-procs. Chaos-preempt one rank -> preemption consensus takes the
+# same coordinated emergency save on both ranks (75/75, no hang) and the
+# relaunch resumes bit-for-bit; chaos-SIGKILL one rank -> the peer's
+# cluster monitor exits 77 within peer_timeout_s instead of wedging in
+# gloo, and the pod restarts together. A few minutes (pytest.mark.slow;
+# the fast consensus/monitor units are tier-1 in tests/test_cluster.py).
+chaos-pod-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_cluster_pod.py -q
 
 # HTTP serving front end smoke (tools/serve.py, docs/SERVING.md): start
 # the server on an ephemeral port with the tiny CPU model, check

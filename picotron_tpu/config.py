@@ -17,6 +17,27 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 
+def parse_rank_at_step(name: str, spec: str) -> tuple[int, int]:
+    """Parse a ``"RANK:STEP"`` pod-chaos spec (resilience
+    ``chaos_*_rank_at_step`` fields) into ``(rank, step)``; "" (off) ->
+    ``(-1, 0)``. Lives here rather than resilience/chaos.py so validate()
+    stays importable without pulling in jax."""
+    if not spec:
+        return -1, 0
+    rank_s, sep, step_s = spec.partition(":")
+    try:
+        if not sep:
+            raise ValueError
+        rank, step = int(rank_s), int(step_s)
+        if rank < 0 or step < 1:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f'{name} must be "RANK:STEP" with RANK >= 0 and STEP >= 1 '
+            f'(got {spec!r})') from None
+    return rank, step
+
+
 @dataclass
 class DistributedConfig:
     """4D topology sizes. Grid ordering is (dp, pp, cp, tp), tp fastest-varying,
@@ -272,6 +293,24 @@ class ResilienceConfig:
     dispatch_backoff: float = 0.05  # seconds; doubles per attempt
     # -- supervisor heartbeat (tools/supervise.py); also via $PICOTRON_HEARTBEAT --
     heartbeat_path: str = ""
+    # -- cluster fault tolerance (resilience/cluster.py; docs/MULTIHOST.md) --
+    # Steps between preemption-consensus rounds: a tiny jitted all-reduce of
+    # every host's PreemptionGuard flag, so ANY host's SIGTERM becomes the
+    # SAME coordinated emergency save + exit 75 on every host. Only active
+    # with >1 JAX process (single-host behavior is byte-identical); raising
+    # it trades per-boundary overhead for signal latency inside the
+    # preemption grace window. 0 = off (legacy local-only check — a
+    # preempted host may wedge its peers' collective save).
+    consensus_interval: int = 1
+    # A peer process silent (no lease renewal) this long is a dead host:
+    # the ClusterMonitor exits THIS process with EXIT_CLUSTER_FAILED (77)
+    # instead of wedging forever inside the next collective. 0 = off
+    # (default: needs a shared cluster_dir to mean anything).
+    peer_timeout_s: float = 0.0
+    lease_interval_s: float = 2.0  # how often the monitor renews this host's lease
+    # Shared directory for lease/done files — must be visible to every host
+    # (a checkpoint-tier mount works). "" = <checkpoint.save_dir>/_cluster.
+    cluster_dir: str = ""
     # -- chaos injection (resilience/chaos.py; each fires once per process) --
     chaos_raise_step: int = 0
     chaos_nan_step: int = 0
@@ -286,6 +325,13 @@ class ResilienceConfig:
     chaos_latency_round: int = 0  # sleep chaos_latency_s before round N
     chaos_latency_s: float = 0.25
     chaos_poison_logits_round: int = 0  # round N's logits come back NaN
+    # -- pod chaos ("RANK:STEP" strings, "" = off; fires on the process
+    #    whose jax.process_index() == RANK after step STEP; a fired marker
+    #    under save_dir keeps pod restarts from re-tripping the fault) --
+    chaos_preempt_rank_at_step: str = ""  # SIGTERM one host: consensus drill
+    chaos_kill_rank_at_step: str = ""  # SIGKILL one host: dead-peer drill
+    chaos_stall_rank_at_step: str = ""  # one host sleeps: straggler drill
+    chaos_stall_rank_s: float = 30.0  # how long the stalled rank sleeps
 
 
 @dataclass
@@ -653,6 +699,18 @@ class Config:
             raise ValueError("inference.spec_len must be >= 0 (0 = off)")
         if inf.spec_ngram < 1:
             raise ValueError("inference.spec_ngram must be >= 1")
+        if r.consensus_interval < 0:
+            raise ValueError("consensus_interval must be >= 0 (0 = off)")
+        if r.peer_timeout_s < 0:
+            raise ValueError("peer_timeout_s must be >= 0 (0 = off)")
+        if r.lease_interval_s <= 0:
+            raise ValueError("lease_interval_s must be > 0")
+        if 0 < r.peer_timeout_s <= 2 * r.lease_interval_s:
+            # a timeout inside the renewal cadence would read normal lease
+            # jitter as a dead host and kill healthy pods
+            raise ValueError(
+                f"peer_timeout_s ({r.peer_timeout_s}) must exceed "
+                f"2 * lease_interval_s ({2 * r.lease_interval_s}) or be 0")
         chaos_on = False
         for name in ("chaos_raise_step", "chaos_nan_step",
                      "chaos_sigterm_step", "chaos_truncate_step"):
@@ -660,6 +718,21 @@ class Config:
             if v < 0:
                 raise ValueError(f"{name} must be >= 0 (0 = off)")
             chaos_on = chaos_on or v > 0
+        for name in ("chaos_preempt_rank_at_step", "chaos_kill_rank_at_step",
+                     "chaos_stall_rank_at_step"):
+            rank, _ = parse_rank_at_step(name, getattr(r, name))
+            if rank >= 0 and not self.checkpoint.save_dir:
+                # a SIGKILLed/preempted pod replays the chaos step on
+                # relaunch; only the fired marker persisted under save_dir
+                # stops the fault re-tripping every incarnation until the
+                # restart budget burns to zero
+                raise ValueError(
+                    f"{name} requires checkpoint.save_dir (the fired "
+                    f"marker lives there; without it a supervised pod "
+                    f"re-trips the fault on every relaunch)")
+            chaos_on = chaos_on or rank >= 0
+        if r.chaos_stall_rank_s < 0:
+            raise ValueError("chaos_stall_rank_s must be >= 0")
         for name in ("chaos_dispatch_raise_round", "chaos_latency_round",
                      "chaos_poison_logits_round"):
             if getattr(r, name) < 0:

@@ -14,12 +14,17 @@ bounded hiccups:
 - ``retry``       — bounded exponential-backoff retry for checkpoint and
   safetensors I/O;
 - ``chaos``       — config-driven deterministic fault injector (raise /
-  NaN loss / SIGTERM / checkpoint truncation at step k) so recovery has a
-  tier-1 test surface instead of being exercised only by real outages.
+  NaN loss / SIGTERM / checkpoint truncation at step k, plus rank-targeted
+  preempt/kill/stall for pods) so recovery has a tier-1 test surface
+  instead of being exercised only by real outages;
+- ``cluster``     — the pod-level control plane: preemption consensus (any
+  host's SIGTERM triggers the same coordinated save on every host) and a
+  peer-liveness monitor that exits ``EXIT_CLUSTER_FAILED`` instead of
+  wedging inside a collective when a host dies.
 
 The supervisor (``tools/supervise.py``) sits one level above: a bounded-
-restart watchdog around ``python -m picotron_tpu.train`` keyed off these
-exit codes and a heartbeat file.
+restart watchdog around ``python -m picotron_tpu.train`` — per process or
+per pod (``--num-procs``) — keyed off these exit codes and heartbeat files.
 """
 
 from picotron_tpu.resilience.anomaly import (  # noqa: F401
@@ -31,6 +36,11 @@ from picotron_tpu.resilience.chaos import (  # noqa: F401
     ChaosError,
     ChaosInjector,
     ServingChaos,
+)
+from picotron_tpu.resilience.cluster import (  # noqa: F401
+    EXIT_CLUSTER_FAILED,
+    ClusterCoordinator,
+    ClusterMonitor,
 )
 from picotron_tpu.resilience.preemption import (  # noqa: F401
     EXIT_PREEMPTED,

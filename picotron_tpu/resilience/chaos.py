@@ -17,9 +17,30 @@ tier-1 suite can prove kill→resume equivalence on a CPU mesh:
                             of the newest checkpoint step (a partial write;
                             the restore-fallback path).
 
+Pod-scale (rank-targeted) events — the cluster fault-tolerance test surface
+(resilience/cluster.py, docs/MULTIHOST.md). Specs are ``"RANK:STEP"``
+strings ("" = off): the event fires only on the process whose
+``jax.process_index()`` equals RANK, after step STEP completes:
+
+- ``chaos_preempt_rank_at_step`` — SIGTERM to self: ONE host of the pod is
+                                   preempted; the consensus path must turn
+                                   it into a coordinated save + exit 75 on
+                                   every host;
+- ``chaos_kill_rank_at_step``    — SIGKILL to self: a dead host; peers must
+                                   detect the silence (ClusterMonitor) and
+                                   exit EXIT_CLUSTER_FAILED instead of
+                                   wedging in the next collective;
+- ``chaos_stall_rank_at_step``   — sleep ``chaos_stall_rank_s`` seconds: a
+                                   straggler; drives the supervisor's
+                                   heartbeat stall detector at pod scale.
+
 Each event fires at most once per process, so a rollback that replays step k
 does not re-trip the same fault (which would livelock the rollback policy).
-All steps are 1-indexed optimizer steps; 0 disables an event.
+Rank-targeted events additionally persist a fired marker under ``save_dir``:
+a SIGKILL leaves no checkpoint past the chaos step, so the relaunched pod
+REPLAYS it — without the marker the fault would re-fire every incarnation
+and the restart budget would burn to zero. All steps are 1-indexed optimizer
+steps; 0 disables an event.
 
 ``ServingChaos`` is the SERVING-side injector: the same config-driven,
 deterministic discipline, but keyed to engine dispatch rounds instead of
@@ -33,8 +54,10 @@ from __future__ import annotations
 
 import os
 import signal
+import sys
 import time
 
+from picotron_tpu.config import parse_rank_at_step
 from picotron_tpu.utils import log0
 
 
@@ -66,26 +89,71 @@ def truncate_latest_checkpoint(save_dir: str) -> str:
 
 
 class ChaosInjector:
-    def __init__(self, r, save_dir: str = ""):
+    def __init__(self, r, save_dir: str = "", rank: "int | None" = None):
         """``r`` is a ResilienceConfig; ``save_dir`` is the checkpoint dir
-        (needed only for truncation)."""
+        (truncation target + rank-targeted fired markers). ``rank``
+        overrides ``jax.process_index()`` for tests; it is resolved lazily
+        so constructing an injector never forces a backend."""
         self.raise_step = int(r.chaos_raise_step)
         self.nan_step = int(r.chaos_nan_step)
         self.sigterm_step = int(r.chaos_sigterm_step)
         self.truncate_step = int(r.chaos_truncate_step)
+        self.preempt_rank, self.preempt_step = parse_rank_at_step(
+            "chaos_preempt_rank_at_step", r.chaos_preempt_rank_at_step)
+        self.kill_rank, self.kill_step = parse_rank_at_step(
+            "chaos_kill_rank_at_step", r.chaos_kill_rank_at_step)
+        self.stall_rank, self.stall_step = parse_rank_at_step(
+            "chaos_stall_rank_at_step", r.chaos_stall_rank_at_step)
+        self.stall_s = float(r.chaos_stall_rank_s)
         self.save_dir = save_dir
+        self._rank = rank
         self._fired: set = set()
 
     @property
     def active(self) -> bool:
-        return any(s > 0 for s in (self.raise_step, self.nan_step,
-                                   self.sigterm_step, self.truncate_step))
+        return (any(s > 0 for s in (self.raise_step, self.nan_step,
+                                    self.sigterm_step, self.truncate_step))
+                or any(k >= 0 for k in (self.preempt_rank, self.kill_rank,
+                                        self.stall_rank)))
+
+    def _my_rank(self) -> int:
+        if self._rank is None:
+            import jax
+
+            self._rank = jax.process_index()
+        return self._rank
 
     def _fire_once(self, event: str, at: int, step: int) -> bool:
         if at > 0 and step == at and event not in self._fired:
             self._fired.add(event)
             return True
         return False
+
+    def _marker_path(self, event: str, rank: int, at: int) -> str:
+        return os.path.join(self.save_dir, f".chaos_{event}_p{rank}_s{at}")
+
+    def _fire_rank_once(self, event: str, rank: int, at: int,
+                        step: int) -> bool:
+        """Rank-targeted one-shot: fires only on the targeted process, at
+        most once per RUN — the fired marker under save_dir survives a pod
+        restart, because the replayed step would otherwise re-trip a fault
+        (SIGKILL) that never let a checkpoint advance past it."""
+        if rank < 0 or step != at or event in self._fired:
+            return False
+        self._fired.add(event)  # marker or not, never re-check this process
+        if rank != self._my_rank():
+            return False
+        if self.save_dir:
+            marker = self._marker_path(event, rank, at)
+            if os.path.exists(marker):
+                return False
+            try:
+                os.makedirs(self.save_dir, exist_ok=True)
+                with open(marker, "w") as f:
+                    f.write(f"step {step}\n")
+            except OSError:
+                pass  # no marker beats no chaos drill at all
+        return True
 
     def poison_step(self, step: int) -> bool:
         """Whether the dispatch about to run step ``step`` should use the
@@ -98,7 +166,10 @@ class ChaosInjector:
     def after_step(self, step: int, manager=None) -> None:
         """Fire post-step events. Truncation runs before sigterm/raise so a
         combined config corrupts, then dies — the worst realistic ordering.
-        Raise fires last (it does not return)."""
+        Rank-targeted pod events run next (stall, then preempt, then kill —
+        escalating severity); raise fires last (it does not return). The
+        rank-targeted prints deliberately bypass the log0 process-0 gate:
+        the targeted rank is usually NOT the logging controller."""
         if self._fire_once("truncate", self.truncate_step, step):
             if manager is not None:
                 manager.wait_until_finished()  # corrupt a COMPLETE write
@@ -107,6 +178,22 @@ class ChaosInjector:
         if self._fire_once("sigterm", self.sigterm_step, step):
             log0(f"chaos: SIGTERM to self after step {step}")
             os.kill(os.getpid(), signal.SIGTERM)
+        if self._fire_rank_once("stall", self.stall_rank, self.stall_step,
+                                step):
+            print(f"chaos[p{self._my_rank()}]: stalling {self.stall_s}s "
+                  f"after step {step}", flush=True)
+            time.sleep(self.stall_s)
+        if self._fire_rank_once("preempt", self.preempt_rank,
+                                self.preempt_step, step):
+            print(f"chaos[p{self._my_rank()}]: SIGTERM to self (pod "
+                  f"preemption of one host) after step {step}", flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self._fire_rank_once("kill", self.kill_rank, self.kill_step,
+                                step):
+            print(f"chaos[p{self._my_rank()}]: SIGKILL to self (dead host) "
+                  f"after step {step}", flush=True)
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
         if self._fire_once("raise", self.raise_step, step):
             raise ChaosError(f"chaos: injected crash after step {step}")
 

@@ -1,0 +1,331 @@
+"""Cluster control plane: multi-host preemption consensus + peer liveness.
+
+The framework is a multi-controller program (docs/MULTIHOST.md): one Python
+process per host, and the collectives — including orbax checkpoint saves —
+span all of them. That makes single-process fault tolerance insufficient on
+a pod:
+
+- **Preemption tears.** Preemptible pools deliver SIGTERM per *host*. If one
+  host's ``PreemptionGuard`` breaks out of the train loop alone, its
+  emergency save is a collective that its peers never joined: the signaled
+  host wedges inside orbax, the grace window burns, and the checkpoint is
+  lost. ``ClusterCoordinator`` fixes the decision, not the save: every
+  process contributes its local ``guard.triggered`` flag to a tiny jitted
+  all-reduce (``jnp.max`` over the full device mesh) at step boundaries, so
+  when ANY host is preempted, EVERY host learns it at the same step, takes
+  the same collective emergency save, and exits ``EXIT_PREEMPTED`` together.
+
+- **Dead hosts wedge survivors.** A SIGKILLed/OOMed/vaporized host leaves
+  its peers blocked inside a collective that will never complete (gloo and
+  the TPU runtime both hang far longer than any scheduler's patience).
+  ``ClusterMonitor`` is the escape hatch: a per-process background thread
+  renews a lease file in a shared directory and watches the peers' leases;
+  a peer silent past ``resilience.peer_timeout_s`` means a dead host inside
+  a collective, and the monitor kills THIS process with
+  ``EXIT_CLUSTER_FAILED`` via ``os._exit`` (the main thread is stuck in C —
+  a Python exception could never unwind it). The pod supervisor
+  (``tools/supervise.py --num-procs``) sees the exit code and restarts the
+  pod together.
+
+Exit-code ladder (what a supervisor keys restarts off):
+
+======================  ====================================================
+``0``                   done — do not restart
+``EXIT_PREEMPTED`` 75   coordinated emergency checkpoint written — relaunch
+                        resumes (EX_TEMPFAIL semantics)
+``EXIT_ANOMALY`` 76     loss diverged under policy 'abort' — human attention
+``EXIT_CLUSTER_FAILED`` a peer died inside a collective — restart the whole
+``77``                  pod; auto-resume recovers from the last checkpoint
+anything else           a local crash
+======================  ====================================================
+
+Single-host behavior is unchanged: with one JAX process the coordinator is
+inert (the local flag IS the global truth, checked every step as before)
+and the monitor has no peers to watch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+# A peer process died inside a collective: this process exits instead of
+# wedging forever. Distinct from 75 (no checkpoint was written — resume
+# falls back to the last periodic save) and from a local crash (the fault
+# was elsewhere; the supervisor restarts the whole pod, not just one rank).
+EXIT_CLUSTER_FAILED = 77
+
+
+class ClusterCoordinator:
+    """Preemption consensus: a jitted ``jnp.max`` all-reduce of the local
+    preemption flag over the full device mesh, evaluated at step boundaries
+    every ``interval`` steps.
+
+    All processes run the identical deterministic step sequence, so gating
+    rounds on the step counter gives every process the same consensus
+    schedule — each round is a collective and MUST be entered by everyone.
+    ``interval`` trades signal latency for overhead: a round is a scalar
+    all-reduce (microseconds on ICI, ~ms on DCN/gloo), so ``1`` (every
+    boundary) is the production default; raising it delays how long a
+    SIGTERM sits host-local before the pod reacts, eating into the
+    preemption grace window.
+
+    With one JAX process the coordinator is inert: ``preempt_now`` returns
+    the local flag on every step, exactly the pre-cluster behavior.
+    """
+
+    def __init__(self, interval: int = 1, process_count: Optional[int] = None):
+        import jax
+
+        self.interval = max(1, int(interval))
+        self._nproc = (jax.process_count() if process_count is None
+                       else int(process_count))
+        self._last_round_step: Optional[int] = None
+        self.rounds = 0  # consensus rounds actually evaluated
+        self._reduce = None
+        self._sharding = None
+        if self.active:
+            self._build()
+
+    @property
+    def active(self) -> bool:
+        return self._nproc > 1
+
+    def _build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        # One element per device over a private 1-axis mesh: each process
+        # fills its addressable entries with its local flag, the jitted max
+        # reduces across the whole pod, and the replicated result is read
+        # back from a local shard (a multi-process array cannot be read
+        # whole-array on any one host).
+        self._mesh = Mesh(np.asarray(jax.devices()), ("cluster",))
+        self._sharding = NamedSharding(self._mesh, PartitionSpec("cluster"))
+        replicated = NamedSharding(self._mesh, PartitionSpec())
+        self._reduce = jax.jit(jnp.max, out_shardings=replicated)
+
+    def due(self, step: int) -> bool:
+        """Whether ``step``'s boundary holds a consensus round. Pure function
+        of the step sequence — identical on every process, INCLUDING after an
+        anomaly rollback: the restore rewinds ``step`` below the last round
+        on every process at once, so restarting the schedule there keeps the
+        rounds aligned (waiting for the old high-water mark instead would
+        leave the whole replay deaf to preemptions)."""
+        if not self.active:
+            return True
+        return (self._last_round_step is None
+                or step < self._last_round_step
+                or step - self._last_round_step >= self.interval)
+
+    def preempt_now(self, step: int, local_flag: bool) -> bool:
+        """Consensus entry point, called at the top of every loop iteration
+        by EVERY process. Returns True when the pod should break for a
+        coordinated emergency save at this boundary.
+
+        Between rounds a locally-set flag returns False — breaking alone
+        would tear the collective save; the flag is raised at the next
+        round instead (that latency is the ``interval`` trade-off)."""
+        if not self.active:
+            return bool(local_flag)
+        if not self.due(step):
+            return False
+        self._last_round_step = step
+        self.rounds += 1
+        return self._any_true(bool(local_flag))
+
+    def _any_true(self, flag: bool) -> bool:
+        import jax
+        import numpy as np
+
+        n = len(self._mesh.devices.ravel())
+        local = np.asarray([1 if flag else 0], dtype=np.int32)
+        arr = jax.make_array_from_callback((n,), self._sharding,
+                                           lambda idx: local)
+        out = jax.block_until_ready(self._reduce(arr))
+        return int(np.asarray(out.addressable_data(0))) > 0
+
+
+class ClusterMonitor:
+    """Peer-liveness watchdog: lease files as cross-host heartbeats.
+
+    Each process's monitor thread touches ``lease_p<id>`` in a shared
+    directory (content: the last completed step, for the post-mortem log
+    line) every ``lease_interval_s`` and checks the peers' lease mtimes. A
+    peer lease stale past ``peer_timeout_s`` — and not marked done — means
+    the peer died; any collective this process enters (or is already wedged
+    inside) will never complete, so the monitor exits the process with
+    ``EXIT_CLUSTER_FAILED`` via ``os._exit``.
+
+    Clean exits (completion, coordinated preemption) call
+    ``stop(mark_done=True)``, which drops a ``done_p<id>`` marker so peers
+    still flushing their final save don't mistake the natural end of a rank
+    for its death. A crash must NOT mark done — the stale lease is exactly
+    how the peers learn to stop waiting. ``train()`` handles this by
+    marking done only when no exception is unwinding.
+
+    The directory must be on storage every host mounts (the checkpoint
+    tier works: ``resilience.cluster_dir`` defaults to
+    ``<save_dir>/_cluster``). The pod supervisor relaunches every rank
+    together over the same directory, so a PREVIOUS incarnation's files
+    linger until each rank's own ``reset()`` removes them — peers gate on
+    freshness instead of trusting them: a peer file whose mtime predates
+    this monitor's start is stale (a dead incarnation's lease must not
+    read as an instant timeout, and its done marker must not blind this
+    incarnation to that rank's next death).
+    """
+
+    def __init__(self, cluster_dir: str, process_id: int, num_processes: int,
+                 peer_timeout_s: float, lease_interval_s: float = 2.0,
+                 exit_fn: Optional[Callable[[int, float], None]] = None,
+                 clock: Callable[[], float] = time.time):
+        self.dir = cluster_dir
+        self.pid = int(process_id)
+        self.nproc = int(num_processes)
+        self.peer_timeout_s = float(peer_timeout_s)
+        self.lease_interval_s = float(lease_interval_s)
+        self._exit = exit_fn or self._default_exit
+        self._clock = clock
+        self.step = 0  # last completed local step (advisory, for logging)
+        self._births: dict[int, float] = {}
+        self._done: set[int] = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- paths ------------------------------------------------------------ #
+
+    def lease_path(self, pid: int) -> str:
+        return os.path.join(self.dir, f"lease_p{pid}")
+
+    def done_path(self, pid: int) -> str:
+        return os.path.join(self.dir, f"done_p{pid}")
+
+    # -- lifecycle --------------------------------------------------------- #
+
+    def start(self) -> "ClusterMonitor":
+        os.makedirs(self.dir, exist_ok=True)
+        self.reset()
+        self._renew()
+        now = self._clock()
+        # a peer that NEVER leases counts its silence from our start: a host
+        # that failed to come up at all is detected too, not just one that
+        # died mid-run
+        self._births = {p: now for p in range(self.nproc) if p != self.pid}
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="cluster-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def reset(self) -> None:
+        """Clear THIS process's markers from a previous incarnation (the
+        pod supervisor restarts every rank together, same cluster_dir): a
+        leftover done marker would blind the peers to this rank's next
+        death, and a stale lease would look like an instant timeout."""
+        for p in (self.lease_path(self.pid), self.done_path(self.pid)):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def stop(self, mark_done: bool = True) -> None:
+        """Stop watching. ``mark_done=True`` (clean/coordinated exits only)
+        tells the peers this rank's silence from here on is natural."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if mark_done:
+            try:
+                with open(self.done_path(self.pid), "w") as f:
+                    f.write(str(self.step))
+            except OSError:
+                pass
+
+    def notify_step(self, step: int) -> None:
+        """Record loop progress (written into the lease by the next renewal;
+        purely advisory — liveness is the mtime, not the content)."""
+        self.step = int(step)
+
+    # -- the watch loop ---------------------------------------------------- #
+
+    def _renew(self) -> None:
+        try:
+            with open(self.lease_path(self.pid), "w") as f:
+                f.write(str(self.step))
+        except OSError:
+            # one missed renewal is survivable (peer_timeout_s spans several
+            # intervals); a persistently dead mount eventually reads as OUR
+            # death to the peers, which is the correct verdict anyway
+            pass
+
+    # Peer files older than this much before our own start belong to a dead
+    # incarnation (the slack absorbs cross-host mtime/clock jitter; a
+    # LEGITIMATE done/lease can't predate us by more — the peer must have
+    # joined collectives with this incarnation first).
+    _STALE_SLACK_S = 1.0
+
+    def _fresh_mtime(self, path: str, birth: float) -> Optional[float]:
+        """The file's mtime, or None when missing OR left over from a
+        previous incarnation of the pod (same cluster_dir, relaunched
+        together — the owner's reset() may not have run yet)."""
+        try:
+            m = os.path.getmtime(path)
+        except OSError:
+            return None
+        return m if m >= birth - self._STALE_SLACK_S else None
+
+    def check_peers(self) -> Optional[tuple[int, float]]:
+        """Returns ``(peer_id, silence_s)`` for the first peer found silent
+        past the timeout, or None. Split from the thread loop so tests can
+        drive it synchronously."""
+        now = self._clock()
+        for p in sorted(self._births):
+            if p in self._done:
+                continue
+            birth = self._births[p]
+            if self._fresh_mtime(self.done_path(p), birth) is not None:
+                self._done.add(p)
+                continue
+            lease = self._fresh_mtime(self.lease_path(p), birth)
+            # no (fresh) lease: silence counts from our start — covers a
+            # host that never came up AND a dead incarnation's leftovers
+            age = now - lease if lease is not None else now - birth
+            if age > self.peer_timeout_s:
+                return p, age
+        return None
+
+    def _peer_step(self, p: int) -> str:
+        try:
+            with open(self.lease_path(p)) as f:
+                return f.read().strip() or "?"
+        except OSError:
+            return "?"
+
+    def _run(self) -> None:
+        poll = min(self.lease_interval_s, max(self.peer_timeout_s / 4, 0.05))
+        while not self._stop.wait(poll):
+            self._renew()
+            dead = self.check_peers()
+            if dead is not None:
+                self._exit(*dead)
+                return  # test exit_fns return; the real one never does
+
+    def _default_exit(self, peer: int, age: float) -> None:
+        # The main thread is (or soon will be) wedged inside a collective:
+        # only an immediate process exit escapes. Write the post-mortem
+        # straight to fd 2 — never through the log0 gate (the dead peer may
+        # BE process 0) and never through buffered stdio.
+        msg = (f"cluster monitor [p{self.pid} step {self.step}]: peer "
+               f"{peer} (last step {self._peer_step(peer)}) silent "
+               f"{age:.1f}s > peer_timeout_s={self.peer_timeout_s}s — dead "
+               f"host inside a collective; exiting {EXIT_CLUSTER_FAILED}\n")
+        try:
+            os.write(2, msg.encode())
+        except OSError:
+            pass
+        os._exit(EXIT_CLUSTER_FAILED)

@@ -23,6 +23,12 @@ from picotron_tpu.utils import log0
 EXIT_PREEMPTED = 75
 
 _LAST: Optional["PreemptionGuard"] = None
+# was_preempted() after the guard is gone: uninstall() snapshots the
+# verdict here (train's finally uninstalls BEFORE main reads the exit
+# code) and clears _LAST — a later run in the same process (pytest,
+# notebooks) must not read a dead guard's stale verdict, so install()
+# AND every uninstall() overwrite it with the current guard's state.
+_LAST_VERDICT = False
 
 
 class PreemptionGuard:
@@ -35,9 +41,11 @@ class PreemptionGuard:
         self._prev: dict = {}
         self.triggered = False
         self.signame: Optional[str] = None
+        self._adopted = False
 
     def install(self) -> "PreemptionGuard":
-        global _LAST
+        global _LAST, _LAST_VERDICT
+        _LAST_VERDICT = False
         for s in self._signals:
             try:
                 self._prev[s] = signal.signal(s, self._handle)
@@ -51,11 +59,27 @@ class PreemptionGuard:
         return self
 
     def uninstall(self) -> None:
+        global _LAST, _LAST_VERDICT
         for s, prev in self._prev.items():
             signal.signal(s, prev)
         self._prev.clear()
+        # Snapshot THIS run's verdict and drop the module reference: a
+        # dead guard must answer was_preempted() for its own run's exit
+        # code, but never leak a stale True into the next run in the same
+        # process. A never-installed guard (handle_signals=False) records
+        # False here for the same reason.
+        _LAST_VERDICT = self.triggered
+        if _LAST is self:
+            _LAST = None
 
     def _handle(self, signum, frame) -> None:
+        if self._adopted:
+            # triggered was set synthetically from a peer's verdict; this
+            # host's own first REAL signal is the expected pod-wide delivery,
+            # not the operator's escalation — record it and keep flushing
+            self._adopted = False
+            self.signame = signal.Signals(signum).name
+            return
         if self.triggered:
             # second signal: the grace period is over — restore defaults and
             # surface an interrupt so even a wedged loop dies
@@ -63,6 +87,18 @@ class PreemptionGuard:
             raise KeyboardInterrupt(f"second {signal.Signals(signum).name}")
         self.triggered = True
         self.signame = signal.Signals(signum).name
+
+    def adopt(self, signame: str = "PEER-PREEMPT") -> None:
+        """Adopt a preemption verdict learned out-of-band (cluster
+        consensus: a PEER was signaled). Sets ``triggered`` so the loop
+        breaks for the coordinated save, but keeps this host's own first
+        real signal benign — providers SIGTERM every host of a preempted
+        pod, so the local copy is usually still in flight and must not
+        read as a 'second signal' escalation that would interrupt the
+        collective emergency save."""
+        self.triggered = True
+        self.signame = signame
+        self._adopted = True
 
     def __enter__(self) -> "PreemptionGuard":
         return self.install()
@@ -106,6 +142,9 @@ class PreemptionGuard:
 
 
 def was_preempted() -> bool:
-    """Whether the most recently installed guard caught a signal — the
-    entry point (``train.main``) keys its exit code off this."""
-    return _LAST is not None and _LAST.triggered
+    """Whether the current run's guard caught a signal — the entry point
+    (``train.main``) keys its exit code off this. Live guards answer
+    directly; after uninstall the snapshotted verdict of the most recently
+    finished run answers (and is reset by the next install/uninstall, so
+    it can never go stale across runs in one process)."""
+    return _LAST.triggered if _LAST is not None else _LAST_VERDICT

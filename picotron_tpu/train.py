@@ -33,10 +33,21 @@ from typing import Optional
 
 def _ensure_devices(cfg) -> None:
     """use_cpu runs (the reference's Gloo path, train.py:83) need the virtual
-    CPU device count pinned before a backend exists."""
+    CPU device count pinned before a backend exists. On a CPU pod (the
+    supervisor's --num-procs exports the rendezvous env) the world is split
+    across processes: each rank hosts world/nproc of the virtual devices,
+    or the global mesh would see nproc * world."""
     if cfg.distributed.use_cpu:
+        n_local = cfg.world_size
+        nproc = int(os.environ.get("JAX_NUM_PROCESSES", "1") or 1)
+        if os.environ.get("JAX_COORDINATOR_ADDRESS") and nproc > 1:
+            if cfg.world_size % nproc:
+                raise ValueError(
+                    f"world_size {cfg.world_size} is not divisible by the "
+                    f"pod's JAX_NUM_PROCESSES={nproc}")
+            n_local = cfg.world_size // nproc
         os.environ["XLA_FLAGS"] = (
-            f"--xla_force_host_platform_device_count={cfg.world_size} "
+            f"--xla_force_host_platform_device_count={n_local} "
             + os.environ.get("XLA_FLAGS", "")
         )
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -55,6 +66,11 @@ def _maybe_init_distributed() -> None:
         return
     import jax
 
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
+        # CPU pods (the reference's Gloo path): without this, any program
+        # spanning processes fails with "Multiprocess computations aren't
+        # implemented on the CPU backend"
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=addr,
         num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
@@ -115,6 +131,7 @@ def train(cfg, max_steps_override: Optional[int] = None,
     from picotron_tpu.models import llama
     from picotron_tpu.resilience.anomaly import AnomalyAbort, LossAnomalyDetector
     from picotron_tpu.resilience.chaos import ChaosInjector
+    from picotron_tpu.resilience.cluster import ClusterCoordinator, ClusterMonitor
     from picotron_tpu.resilience.preemption import PreemptionGuard
     from picotron_tpu.topology import topology_from_config
 
@@ -126,11 +143,34 @@ def train(cfg, max_steps_override: Optional[int] = None,
 
     guard = PreemptionGuard().install() if r.handle_signals \
         else PreemptionGuard()  # not installed: .triggered stays False
+    # Pod control plane (resilience/cluster.py): consensus turns ANY host's
+    # SIGTERM into the same coordinated break on every host; the monitor is
+    # the wedge escape when a host dies outright. Both are inert on a
+    # single process.
+    coord = (ClusterCoordinator(r.consensus_interval)
+             if r.consensus_interval > 0 else None)
+    monitor = None
+    if r.peer_timeout_s > 0 and jax.process_count() > 1:
+        cluster_dir = r.cluster_dir or (
+            os.path.join(c.save_dir, "_cluster") if c.save_dir else "")
+        if cluster_dir:
+            monitor = ClusterMonitor(
+                cluster_dir, jax.process_index(), jax.process_count(),
+                peer_timeout_s=r.peer_timeout_s,
+                lease_interval_s=r.lease_interval_s).start()
+        else:
+            utils.log0("cluster monitor disabled: peer_timeout_s set but "
+                       "no cluster_dir and no checkpoint.save_dir to "
+                       "derive one from")
     chaos = ChaosInjector(r, save_dir=c.save_dir)
     detector = LossAnomalyDetector(
         ema_beta=r.anomaly_ema_beta, zscore=r.anomaly_zscore,
         warmup_steps=r.anomaly_warmup_steps)
-    heartbeat = r.heartbeat_path or os.environ.get("PICOTRON_HEARTBEAT", "")
+    # The supervisor's export wins over a static config path: it names the
+    # exact file its stall detector watches (PER-RANK in pod mode —
+    # <hb>.p<i>); a config path carried over from single-host use would
+    # leave the watched files untouched and stall-kill a healthy pod.
+    heartbeat = os.environ.get("PICOTRON_HEARTBEAT", "") or r.heartbeat_path
 
     # state the finally below may touch — defined before anything can raise
     manager = None
@@ -217,7 +257,21 @@ def train(cfg, max_steps_override: Optional[int] = None,
 
         rollbacks = 0
         while step < max_steps and (t.max_tokens is None or trained_tokens < t.max_tokens):
-            if guard.triggered:
+            # Preemption check. With consensus on, the decision is collective:
+            # every process all-reduces its local flag at the same boundaries,
+            # so a SIGTERM delivered to ONE host becomes the same break — and
+            # the same collective emergency save — on ALL hosts. A locally-
+            # set flag between rounds waits for the next round; breaking
+            # alone would tear the collective save.
+            preempt = (coord.preempt_now(step, guard.triggered)
+                       if coord is not None else guard.triggered)
+            if preempt:
+                if not guard.triggered:
+                    # a peer's signal, learned via consensus: adopt it so the
+                    # emergency-save path and the exit code behave exactly
+                    # like a locally-signaled host (this host's OWN copy of
+                    # the pod-wide SIGTERM stays benign, not an escalation)
+                    guard.adopt()
                 utils.log0(f"preemption: {guard.signame} received; flushing "
                            f"checkpoint at step {step} and exiting "
                            f"{resilience.EXIT_PREEMPTED}", flush=True)
@@ -250,7 +304,7 @@ def train(cfg, max_steps_override: Optional[int] = None,
                 tokens, targets = ts.shard_batch_stack(
                     [next(loader) for _ in range(k)], topo)
                 params, opt_state, loss_arr = step_fn(params, opt_state, tokens, targets)
-                losses = [float(x) for x in jax.block_until_ready(loss_arr)]
+                losses = [float(x) for x in utils.host_values(loss_arr)]
             else:
                 tokens, targets = ts.shard_batch(next(loader), topo)
                 if poisoned:
@@ -264,7 +318,7 @@ def train(cfg, max_steps_override: Optional[int] = None,
                     fn = step_fn_single
                 params, opt_state, loss_arr = fn(
                     params, opt_state, tokens, targets)
-                losses = [float(jax.block_until_ready(loss_arr))]
+                losses = [float(utils.host_values(loss_arr))]
             dt_call = time.perf_counter() - t_start
 
             # Throughput is per dispatch (identical for every step in the group);
@@ -331,6 +385,8 @@ def train(cfg, max_steps_override: Optional[int] = None,
                              zero1=z1, data_meta=loader.state_meta(step))
                 last_saved_step = step
 
+            if monitor is not None:
+                monitor.notify_step(step)
             chaos.after_step(step, manager=manager)
 
             if do_rollback:
@@ -387,6 +443,12 @@ def train(cfg, max_steps_override: Optional[int] = None,
                     manager.close()  # drains any in-flight async save
                 except Exception as e:
                     utils.log0(f"checkpoint manager close failed: {e!r}")
+            if monitor is not None:
+                # Stopped only AFTER the final (collective) flush: a peer
+                # dying mid-save still needs the wedge escape. Mark done
+                # only on clean/coordinated exits — a crash's stale lease
+                # is exactly how the peers learn this host is gone.
+                monitor.stop(mark_done=sys.exc_info()[0] is None)
             if wandb is not None:
                 wandb.finish()
     return step, trained_tokens, loss

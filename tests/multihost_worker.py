@@ -1,33 +1,68 @@
-"""Worker for tests/test_multihost.py: one JAX process of a 2-process CPU
-"pod" (4 virtual devices each, 8 global). Runs the real library path —
-jax.distributed.initialize, global mesh over all 8 devices, shard_batch's
-multi-process placement, the jitted 4D train step — and writes its loss
-trajectory (and which processes printed) to a JSON file.
+"""Worker for the multi-host suites: one JAX process of an N-process CPU
+"pod" running real ``jax.distributed`` + gloo collectives on localhost.
 
-Usage: python multihost_worker.py <process_id> <port> <out_json> [features]
+Two modes:
+
+**Lockstep mode** (tests/test_multihost.py) — 4 virtual devices each, 8
+global: runs the library path (global mesh, shard_batch's multi-process
+placement, the jitted 4D train step) step by step and writes its loss
+trajectory (and which process printed) to a JSON file::
+
+    python multihost_worker.py <process_id> <port> <out_json> [features]
+
 ``features`` is a comma-separated flag list; "zero1" turns on dp-sharded
 optimizer state, whose reduce-scatter/all-gather then cross the process
 boundary (dp is the outermost axis); "fsdp" rests the layer params
-dp-sharded, so every layer's just-in-time param all-gather (and its
-grad reduce-scatter transpose) crosses the boundary instead.
+dp-sharded, so every layer's just-in-time param all-gather (and its grad
+reduce-scatter transpose) crosses the boundary instead.
+
+**Train mode** (tests/test_cluster_pod.py, ``make chaos-pod-smoke``) — runs
+the REAL ``train()`` loop from a config JSON, with checkpoints, preemption
+consensus, the cluster monitor, and rank-targeted chaos all live::
+
+    python multihost_worker.py train <config.json> <port> <out_prefix>
+
+The rank comes from ``$JAX_PROCESS_ID`` and the pod size from
+``$JAX_NUM_PROCESSES`` (both exported by ``tools/supervise.py --num-procs``,
+so the SAME command line serves every rank and every restart). Each run
+APPENDS one JSON line — ``{"rank", "hist": [[step, loss], ...], "rc"}`` —
+to ``<out_prefix>.p<rank>.jsonl``, so a supervised sequence of runs leaves
+the full stitched trajectory behind, and exits with the code
+``train.main`` would: 0 done, 75 preempted-with-checkpoint, 76 anomaly
+abort (a chaos SIGKILL obviously writes nothing — the missing record IS
+the evidence of the dead incarnation).
 """
 
 import json
 import os
 import sys
 
+# runnable as a bare script from any cwd (the pod supervisor relaunches it
+# with the original argv): the repo root is this file's parent's parent
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def main():
-    pid, port, out = int(sys.argv[1]), sys.argv[2], sys.argv[3]
-    feats = sys.argv[4].split(",") if len(sys.argv) > 4 else []
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+def _init_jax(process_id: int, port: str, num_processes: int,
+              local_devices: int):
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}")
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # without gloo, any jitted program spanning processes fails with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
-        coordinator_address=f"localhost:{port}", num_processes=2,
-        process_id=pid)
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_processes, process_id=process_id)
+    return jax
+
+
+def main_lockstep():
+    pid, port, out = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    feats = sys.argv[4].split(",") if len(sys.argv) > 4 else []
+    jax = _init_jax(pid, port, num_processes=2, local_devices=4)
     assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
 
     from picotron_tpu import train_step as ts
@@ -61,12 +96,47 @@ def main():
     for _ in range(4):
         tokens, targets = ts.shard_batch(next(loader), topo)
         params, opt_state, loss = step(params, opt_state, tokens, targets)
-        losses.append(float(jax.block_until_ready(loss)))
+        # the replicated loss spans both processes; read the local copy
+        losses.append(float(utils.host_values(loss)))
 
     with open(out, "w") as f:
         json.dump({"process": pid, "losses": losses,
                    "is_main": utils.is_main_process()}, f)
 
 
+def main_train():
+    cfg_path, port, out_prefix = sys.argv[2], sys.argv[3], sys.argv[4]
+    pid = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    nproc = int(os.environ.get("JAX_NUM_PROCESSES", "2"))
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    d = raw.get("distributed", {})
+    world = (d.get("dp_size", 1) * d.get("pp_size", 1)
+             * d.get("cp_size", 1) * d.get("tp_size", 1))
+    assert world % nproc == 0, (world, nproc)
+    _init_jax(pid, port, num_processes=nproc, local_devices=world // nproc)
+
+    from picotron_tpu import resilience
+    from picotron_tpu.config import Config
+    from picotron_tpu.resilience.anomaly import AnomalyAbort
+    from picotron_tpu.train import train
+
+    cfg = Config.from_dict(raw)
+    hist: list = []
+    rc = 0
+    try:
+        train(cfg, loss_history=hist)
+    except AnomalyAbort:
+        rc = resilience.EXIT_ANOMALY
+    if resilience.was_preempted():
+        rc = resilience.EXIT_PREEMPTED
+    with open(f"{out_prefix}.p{pid}.jsonl", "a") as f:
+        f.write(json.dumps({"rank": pid, "hist": hist, "rc": rc}) + "\n")
+    sys.exit(rc)
+
+
 if __name__ == "__main__":
-    main()
+    if sys.argv[1] == "train":
+        main_train()
+    else:
+        main_lockstep()

@@ -1,0 +1,404 @@
+"""Cluster control plane units (picotron_tpu/resilience/cluster.py).
+
+Fast tier-1 coverage for the pieces the slow 2-process pod drills
+(tests/test_cluster_pod.py, ``make chaos-pod-smoke``) exercise end to end:
+the preemption-consensus coordinator's scheduling/verdict logic, the
+peer-liveness monitor's lease/done/birth accounting, the ``"RANK:STEP"``
+pod-chaos parsing + one-shot-with-marker firing discipline, and the
+``was_preempted()`` staleness regression from the satellite list.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from picotron_tpu import resilience
+from picotron_tpu.config import parse_rank_at_step
+from picotron_tpu.resilience.chaos import ChaosInjector
+from picotron_tpu.resilience.cluster import (
+    EXIT_CLUSTER_FAILED,
+    ClusterCoordinator,
+    ClusterMonitor,
+)
+from picotron_tpu.resilience.preemption import PreemptionGuard, was_preempted
+
+from conftest import make_config
+
+_TINY = dict(
+    num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+    hidden_size=16, intermediate_size=32, vocab_size=64,
+    max_position_embeddings=64, rope_theta=10000.0, dtype="float32",
+    attention_impl="sdpa")
+
+
+def _res_cfg(save_dir="", **kw):
+    cfg = make_config(_TINY)
+    cfg.checkpoint.save_dir = save_dir
+    for k, v in kw.items():
+        setattr(cfg.resilience, k, v)
+    return cfg
+
+
+# --------------------------------------------------------------------------- #
+# ClusterCoordinator: consensus scheduling + verdict
+# --------------------------------------------------------------------------- #
+
+
+def test_coordinator_inert_on_single_process():
+    """With one JAX process the local flag IS the global truth: no rounds,
+    no collectives, every step checked — byte-identical to pre-cluster
+    behavior."""
+    c = ClusterCoordinator(interval=3, process_count=1)
+    assert not c.active
+    assert c.due(0) and c.due(7)  # every boundary, regardless of interval
+    assert c.preempt_now(0, False) is False
+    assert c.preempt_now(1, True) is True
+    assert c.rounds == 0  # never evaluated a collective round
+
+
+def test_coordinator_interval_gates_rounds():
+    """An active coordinator holds its first round at the first boundary,
+    then every ``interval`` steps; between rounds even a RAISED local flag
+    waits (breaking alone would tear the collective save)."""
+    c = ClusterCoordinator(interval=3, process_count=2)
+    assert c.active
+    assert c.preempt_now(0, False) is False and c.rounds == 1
+    # flag raised between rounds: deferred, not evaluated
+    assert c.preempt_now(1, True) is False and c.rounds == 1
+    assert c.preempt_now(2, True) is False and c.rounds == 1
+    # next due boundary: the round runs and the flag comes back
+    assert c.preempt_now(3, True) is True and c.rounds == 2
+
+
+def test_coordinator_all_reduce_propagates_flag():
+    """The jitted jnp.max round returns exactly the OR of the contributed
+    flags (here one process contributes for the whole 'pod', proving the
+    device-mesh plumbing; the 2-process truth table is the slow suite)."""
+    c = ClusterCoordinator(interval=1, process_count=2)
+    assert c.preempt_now(0, False) is False
+    assert c.preempt_now(1, True) is True
+    assert c.preempt_now(2, False) is False  # verdict is per-round, not latched
+    assert c.rounds == 3
+
+
+def test_coordinator_interval_floor():
+    assert ClusterCoordinator(interval=0, process_count=1).interval == 1
+
+
+def test_coordinator_schedule_restarts_after_rollback():
+    """An anomaly rollback rewinds the step counter on EVERY process at
+    once; the consensus schedule must restart there — gating on the old
+    high-water mark would leave the whole replay deaf to preemptions."""
+    c = ClusterCoordinator(interval=4, process_count=2)
+    assert c.preempt_now(10, False) is False and c.rounds == 1
+    # rollback restored step 3; a preemption during the replay must be
+    # seen at the next boundary, not at step >= 14
+    assert c.due(3)
+    assert c.preempt_now(3, True) is True and c.rounds == 2
+
+
+# --------------------------------------------------------------------------- #
+# ClusterMonitor: lease/done/birth accounting
+# --------------------------------------------------------------------------- #
+
+
+def _monitor(tmp_path, pid=0, nproc=2, timeout=5.0, **kw):
+    m = ClusterMonitor(str(tmp_path), pid, nproc, peer_timeout_s=timeout,
+                       **kw)
+    os.makedirs(m.dir, exist_ok=True)
+    now = time.time()
+    m._births = {p: now for p in range(nproc) if p != pid}
+    return m
+
+
+def _backdate(path, by_s):
+    old = time.time() - by_s
+    os.utime(path, (old, old))
+
+
+def test_monitor_fresh_lease_is_alive(tmp_path):
+    m = _monitor(tmp_path)
+    with open(m.lease_path(1), "w") as f:
+        f.write("3")
+    assert m.check_peers() is None
+
+
+def test_monitor_stale_lease_is_dead(tmp_path):
+    m = _monitor(tmp_path, timeout=5.0)
+    m._births = {1: time.time() - 60.0}  # the pod has been up a while
+    with open(m.lease_path(1), "w") as f:
+        f.write("3")
+    _backdate(m.lease_path(1), 30.0)
+    peer, age = m.check_peers()
+    assert peer == 1 and age > 5.0
+    assert m._peer_step(1) == "3"  # the post-mortem names the last step
+
+
+def test_monitor_ignores_previous_incarnations_files(tmp_path):
+    """The pod supervisor relaunches every rank over the SAME cluster_dir.
+    A dead incarnation's lease must not read as an instant timeout before
+    its owner's reset() runs (startup skew), and its done marker must not
+    blind this incarnation to that rank's next death."""
+    m = _monitor(tmp_path, timeout=5.0)  # births = now: just (re)started
+    # leftover lease from the previous incarnation, 30s old
+    with open(m.lease_path(1), "w") as f:
+        f.write("3")
+    _backdate(m.lease_path(1), 30.0)
+    assert m.check_peers() is None  # silence counts from OUR start, not 30s
+    # leftover done marker: ignored — the peer is still being watched...
+    with open(m.done_path(1), "w") as f:
+        f.write("6")
+    _backdate(m.done_path(1), 30.0)
+    assert m.check_peers() is None
+    assert 1 not in m._done
+    # ...so its death THIS incarnation is still detected
+    m._births[1] = time.time() - 60.0
+    os.remove(m.done_path(1))
+    peer, _ = m.check_peers()
+    assert peer == 1
+
+
+def test_monitor_never_leased_peer_counts_from_birth(tmp_path):
+    """A host that fails to come up at all never writes a lease; its
+    silence is aged from OUR start, so the pod still unwedges."""
+    m = _monitor(tmp_path, timeout=5.0)
+    m._births[1] = time.time() - 30.0
+    peer, age = m.check_peers()
+    assert peer == 1 and age > 5.0
+
+
+def test_monitor_done_marker_suppresses_death_verdict(tmp_path):
+    """A rank that finished cleanly (or took the coordinated preemption
+    exit) marks done; its silence afterwards is natural, not a dead host."""
+    m = _monitor(tmp_path, timeout=5.0)
+    m._births = {1: time.time() - 60.0}
+    with open(m.lease_path(1), "w") as f:
+        f.write("6")
+    _backdate(m.lease_path(1), 30.0)  # silent past timeout — but done
+    with open(m.done_path(1), "w") as f:
+        f.write("6")
+    assert m.check_peers() is None
+    # and the verdict is cached: a later unlink of the done file (pod
+    # restart cleanup) must not resurrect the death sentence mid-check
+    os.remove(m.done_path(1))
+    assert m.check_peers() is None
+
+
+def test_monitor_stop_marks_done_only_when_asked(tmp_path):
+    m = _monitor(tmp_path)
+    m.notify_step(4)
+    m.stop(mark_done=True)
+    with open(m.done_path(0)) as f:
+        assert f.read() == "4"
+    os.remove(m.done_path(0))
+    m2 = _monitor(tmp_path)
+    m2.stop(mark_done=False)  # a crash path: the stale lease must speak
+    assert not os.path.exists(m2.done_path(0))
+
+
+def test_monitor_reset_clears_own_stale_markers(tmp_path):
+    """A pod restart reuses cluster_dir: leftover done/lease files from the
+    previous incarnation would blind peers (done) or read as an instant
+    timeout (stale lease)."""
+    m = _monitor(tmp_path)
+    for p in (m.lease_path(0), m.done_path(0)):
+        with open(p, "w") as f:
+            f.write("9")
+    m.reset()
+    assert not os.path.exists(m.lease_path(0))
+    assert not os.path.exists(m.done_path(0))
+
+
+def test_monitor_renew_writes_step_content(tmp_path):
+    m = _monitor(tmp_path)
+    m.notify_step(7)
+    m._renew()
+    with open(m.lease_path(0)) as f:
+        assert f.read() == "7"
+
+
+def test_monitor_thread_exits_on_dead_peer(tmp_path):
+    """End to end through the real thread: a peer that never leases trips
+    the (injected) exit_fn within a couple of timeout windows."""
+    hit = threading.Event()
+    verdicts = []
+
+    def fake_exit(peer, age):
+        verdicts.append((peer, age))
+        hit.set()
+
+    m = ClusterMonitor(str(tmp_path), 0, 2, peer_timeout_s=0.3,
+                       lease_interval_s=0.05, exit_fn=fake_exit)
+    m.start()
+    try:
+        assert hit.wait(timeout=5.0), "monitor never flagged the dead peer"
+    finally:
+        m.stop(mark_done=False)
+    assert verdicts and verdicts[0][0] == 1 and verdicts[0][1] > 0.3
+    # our own lease was being renewed the whole time
+    assert os.path.exists(m.lease_path(0))
+
+
+def test_monitor_thread_quiet_with_live_peer(tmp_path):
+    """Two monitors in one process watching each other: both renew, neither
+    dies, and a clean stop leaves both done markers."""
+    boom = lambda peer, age: pytest.fail(f"false death verdict: {peer}")
+    ms = [ClusterMonitor(str(tmp_path), p, 2, peer_timeout_s=1.0,
+                         lease_interval_s=0.05, exit_fn=boom).start()
+          for p in range(2)]
+    time.sleep(1.5)  # several full timeout windows
+    for m in ms:
+        m.stop(mark_done=True)
+    assert all(os.path.exists(m.done_path(m.pid)) for m in ms)
+
+
+def test_exit_code_ladder_distinct():
+    assert EXIT_CLUSTER_FAILED == 77
+    assert len({0, resilience.EXIT_PREEMPTED, resilience.EXIT_ANOMALY,
+                resilience.EXIT_CLUSTER_FAILED}) == 4
+
+
+# --------------------------------------------------------------------------- #
+# "RANK:STEP" parsing + config validation
+# --------------------------------------------------------------------------- #
+
+
+def test_parse_rank_at_step():
+    assert parse_rank_at_step("f", "") == (-1, 0)
+    assert parse_rank_at_step("f", "1:3") == (1, 3)
+    assert parse_rank_at_step("f", "0:1") == (0, 1)
+    for bad in ("3", "a:b", "-1:2", "1:0", "1:", ":3", "1:2:3"):
+        with pytest.raises(ValueError, match="RANK:STEP"):
+            parse_rank_at_step("chaos_kill_rank_at_step", bad)
+
+
+def test_config_validates_pod_chaos_and_cluster_fields():
+    _res_cfg("/tmp/ck", chaos_preempt_rank_at_step="1:3").validate()
+    _res_cfg(peer_timeout_s=10.0, lease_interval_s=2.0).validate()
+    with pytest.raises(ValueError, match="chaos_kill_rank_at_step"):
+        _res_cfg("/tmp/ck", chaos_kill_rank_at_step="oops").validate()
+    # rank chaos without a save_dir would re-trip on every pod relaunch
+    # (no fired marker, no checkpoint past the step) — refuse loudly
+    with pytest.raises(ValueError, match="save_dir"):
+        _res_cfg(chaos_kill_rank_at_step="1:3").validate()
+    with pytest.raises(ValueError, match="consensus_interval"):
+        _res_cfg(consensus_interval=-1).validate()
+    with pytest.raises(ValueError, match="lease_interval_s"):
+        _res_cfg(lease_interval_s=0.0).validate()
+    # a timeout inside the renewal cadence would kill healthy pods
+    with pytest.raises(ValueError, match="peer_timeout_s"):
+        _res_cfg(peer_timeout_s=3.0, lease_interval_s=2.0).validate()
+    # round trip
+    from picotron_tpu.config import Config
+
+    cfg = _res_cfg("/tmp/ck", chaos_kill_rank_at_step="0:2",
+                   peer_timeout_s=9.0)
+    cfg2 = Config.from_dict(cfg.to_dict())
+    assert cfg2.resilience.chaos_kill_rank_at_step == "0:2"
+    assert cfg2.resilience.peer_timeout_s == 9.0
+
+
+# --------------------------------------------------------------------------- #
+# rank-targeted chaos: fires once, on the right rank, marker survives restart
+# --------------------------------------------------------------------------- #
+
+
+def _injector(tmp_path, rank, **res):
+    cfg = _res_cfg(**res)
+    return ChaosInjector(cfg.resilience, save_dir=str(tmp_path), rank=rank)
+
+
+def test_rank_chaos_fires_only_on_target_rank(tmp_path):
+    spec = dict(chaos_stall_rank_at_step="1:3", chaos_stall_rank_s=0.0)
+    hit = _injector(tmp_path / "a", rank=1, **spec)
+    miss = _injector(tmp_path / "b", rank=0, **spec)
+    assert hit.active and miss.active
+    assert not hit._fire_rank_once("stall", 1, 3, 2)  # wrong step
+    assert hit._fire_rank_once("stall", 1, 3, 3)
+    assert not hit._fire_rank_once("stall", 1, 3, 3)  # once per process
+    assert not miss._fire_rank_once("stall", 1, 3, 3)  # wrong rank
+    # only the targeted rank leaves a marker
+    assert os.path.exists(hit._marker_path("stall", 1, 3))
+    assert not os.path.exists(miss._marker_path("stall", 1, 3))
+
+
+def test_rank_chaos_marker_survives_pod_restart(tmp_path):
+    """A SIGKILL drill leaves no checkpoint past the chaos step, so the
+    restarted pod REPLAYS it: the fired marker under save_dir is what keeps
+    the fault from re-tripping every incarnation."""
+    spec = dict(chaos_kill_rank_at_step="0:2")
+    first = _injector(tmp_path, rank=0, **spec)
+    assert first._fire_rank_once("kill", 0, 2, 2)
+    relaunched = _injector(tmp_path, rank=0, **spec)  # same save_dir
+    assert not relaunched._fire_rank_once("kill", 0, 2, 2)
+
+
+def test_rank_chaos_preempt_delivers_sigterm_to_guard(tmp_path):
+    """after_step drives the real signal path: the targeted rank SIGTERMs
+    itself and its PreemptionGuard records the preemption."""
+    inj = _injector(tmp_path, rank=0, chaos_preempt_rank_at_step="0:2")
+    guard = PreemptionGuard().install()
+    try:
+        inj.after_step(1)
+        assert not guard.triggered
+        inj.after_step(2)
+        assert guard.triggered and guard.signame == "SIGTERM"
+    finally:
+        guard.uninstall()
+
+
+def test_rank_chaos_inactive_by_default(tmp_path):
+    inj = _injector(tmp_path, rank=0)
+    assert not inj.active
+
+
+# --------------------------------------------------------------------------- #
+# satellite regression: was_preempted() must not go stale across runs
+# --------------------------------------------------------------------------- #
+
+
+def test_was_preempted_not_stale_after_uninstall():
+    g = PreemptionGuard(signals=(signal.SIGUSR1,)).install()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert g.triggered and was_preempted()
+    finally:
+        g.uninstall()
+    # train's finally uninstalls before main reads the exit code: the
+    # JUST-finished run's verdict must survive its guard...
+    assert was_preempted()
+    # ...but the next run in the same process (pytest, notebooks) must
+    # start from a clean verdict, not the dead guard's
+    g2 = PreemptionGuard(signals=(signal.SIGUSR1,)).install()
+    try:
+        assert not was_preempted()
+    finally:
+        g2.uninstall()
+    assert not was_preempted()
+
+
+def test_adopted_verdict_keeps_own_first_signal_benign():
+    """Pod-wide preemption: a host that adopted a PEER's verdict via
+    consensus still has its OWN copy of the provider's SIGTERM in flight.
+    That first real signal must not read as the 'second signal' escalation
+    (KeyboardInterrupt would tear the collective emergency save mid-flush);
+    only a genuine second delivery escalates."""
+    g = PreemptionGuard(signals=(signal.SIGUSR1,)).install()
+    try:
+        g.adopt()
+        assert g.triggered and g.signame == "PEER-PREEMPT"
+        os.kill(os.getpid(), signal.SIGUSR1)  # own copy of the pod SIGTERM
+        assert g.triggered and g.signame == "SIGUSR1"  # no interrupt raised
+        with pytest.raises(KeyboardInterrupt):  # a REAL second signal still
+            os.kill(os.getpid(), signal.SIGUSR1)  # means "die now"
+    finally:
+        g.uninstall()
+
+
+def test_was_preempted_false_for_never_installed_guard():
+    g = PreemptionGuard(signals=(signal.SIGUSR1,))  # handle_signals=False path
+    g.uninstall()
+    assert not was_preempted()

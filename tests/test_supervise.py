@@ -1,0 +1,436 @@
+"""Supervisor suite (picotron_tpu/tools/supervise.py).
+
+The watchdog is the outermost resilience layer, so its accounting bugs cost
+real runs: a budget that never replenishes kills a weeks-long job over daily
+hiccups, a deleted heartbeat silently disables stall detection, a signal
+death propagated as a bare negative number confuses every scheduler. Each
+of those (the ISSUE 8 satellites) gets a pinned test here, plus the pod
+mode the cluster control plane (resilience/cluster.py) relies on: the pod
+lives and dies together, restarts are budgeted once per pod, and per-host
+supervisors coordinate through the shared restart-epoch file.
+
+Children are real subprocesses; the loops run in-process with tiny
+backoffs, so the whole file stays tier-1 fast.
+"""
+
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from picotron_tpu.tools.supervise import (
+    EXIT_CLUSTER_FAILED,
+    EXIT_PREEMPTED,
+    _bump_epoch,
+    _heartbeat_age,
+    _pod_exit_code,
+    _read_epoch,
+    _RestartBudget,
+    _shell_code,
+    main,
+    run_pod,
+    run_supervised,
+)
+
+
+def _script(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+# --------------------------------------------------------------------------- #
+# exit-code plumbing
+# --------------------------------------------------------------------------- #
+
+
+def test_shell_code_signal_convention():
+    assert _shell_code(0) == 0
+    assert _shell_code(7) == 7
+    assert _shell_code(-15) == 143  # SIGTERM
+    assert _shell_code(-9) == 137  # SIGKILL
+
+
+def test_pod_exit_code_ladder():
+    # a real crash wins over 75; 75 over a stall kill; clean is clean
+    assert _pod_exit_code([0, 7], stalled=False) == 7
+    assert _pod_exit_code([-9, EXIT_PREEMPTED], stalled=False) == 137
+    assert _pod_exit_code([EXIT_CLUSTER_FAILED, EXIT_PREEMPTED],
+                          stalled=False) == EXIT_CLUSTER_FAILED
+    assert _pod_exit_code([EXIT_PREEMPTED, 0], stalled=False) == EXIT_PREEMPTED
+    assert _pod_exit_code([0, 0], stalled=True) == 1
+    assert _pod_exit_code([0, 0], stalled=False) == 0
+    # a reaped straggler's SIGTERM (-15) must not mask the root cause:
+    # the child's own verdict wins regardless of rank order
+    assert _pod_exit_code([-15, EXIT_CLUSTER_FAILED],
+                          stalled=False) == EXIT_CLUSTER_FAILED
+    assert _pod_exit_code([-15, 76], stalled=False) == 76
+
+
+def test_signal_death_propagates_shell_code(tmp_path):
+    """A child dying to an uncaught signal must surface as 128+sig — the
+    convention every scheduler keys on — not a bare negative returncode."""
+    script = _script(tmp_path, "die.py", """
+        import os, signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    """)
+    rc = run_supervised([sys.executable, script], max_restarts=0,
+                        backoff=0.01, poll_interval=0.02)
+    assert rc == 137
+
+
+# --------------------------------------------------------------------------- #
+# restart budget: replenishment + spot-quota ladder
+# --------------------------------------------------------------------------- #
+
+
+def test_budget_exhausts_without_replenishment():
+    b = _RestartBudget(max_restarts=2, backoff=1.0, backoff_max=60.0,
+                       healthy_reset=0.0)  # legacy: attempt only grows
+    assert b.record(uptime=1e6) is not None  # even a long run charges
+    assert b.record(uptime=1e6) is not None
+    assert b.record(uptime=1e6) is None
+
+
+def test_budget_replenishes_after_healthy_uptime():
+    """The ISSUE satellite: a long run that fails once a day must not be
+    killed by arithmetic after max_restarts days."""
+    b = _RestartBudget(max_restarts=2, backoff=1.0, backoff_max=60.0,
+                       healthy_reset=600.0)
+    assert b.record(uptime=5.0)[0] == "restart 1/2"
+    assert b.record(uptime=5.0)[0] == "restart 2/2"
+    # a healthy day of uptime: the counter resets, the ladder restarts
+    kind, delay = b.record(uptime=86400.0)
+    assert kind == "restart 1/2" and delay == 1.0
+    assert b.record(uptime=5.0)[0] == "restart 2/2"
+    assert b.record(uptime=5.0) is None
+
+
+def test_budget_quota_ladder_spares_restart_budget():
+    b = _RestartBudget(max_restarts=1, backoff=1.0, backoff_max=60.0,
+                       quota_window=10.0, quota_backoff=30.0,
+                       quota_backoff_max=100.0, max_launch_retries=3)
+    # fast deaths: the long doubling ladder, capped, no budget charge
+    assert b.record(uptime=0.5) == ("launch failure 1/3", 30.0)
+    assert b.record(uptime=0.5) == ("launch failure 2/3", 60.0)
+    assert b.record(uptime=0.5) == ("launch failure 3/3", 100.0)  # capped
+    assert b.attempt == 0
+    assert b.record(uptime=0.5) is None  # retries bounded too
+    # a real run resets the consecutive-failure count
+    b2 = _RestartBudget(max_restarts=2, backoff=1.0, backoff_max=60.0,
+                        quota_window=10.0, max_launch_retries=3)
+    assert b2.record(uptime=0.5)[0].startswith("launch failure 1")
+    assert b2.record(uptime=50.0)[0] == "restart 1/2"
+    assert b2.launch_failures == 0
+    assert b2.record(uptime=0.5)[0].startswith("launch failure 1")
+
+
+def test_budget_stalled_runs_never_replenish_or_read_as_quota():
+    """A stall kill's uptime is mostly DEAD time: with stall_timeout >=
+    healthy_reset it must not reset the budget (a permanently wedged
+    trainer would relaunch forever), and with stall_timeout < quota_window
+    it must not ride the no-charge launch-failure ladder."""
+    b = _RestartBudget(max_restarts=1, backoff=1.0, backoff_max=60.0,
+                       healthy_reset=10.0, quota_window=100.0)
+    assert b.record(uptime=50.0, stalled=True)[0] == "restart 1/1"
+    assert b.launch_failures == 0  # held capacity: not a quota failure
+    assert b.record(uptime=50.0, stalled=True) is None  # no replenish
+
+
+def test_stalled_run_exhausts_budget_through_run_supervised(tmp_path):
+    """Call-site regression: run_supervised must pass its stall verdict to
+    the budget — with healthy_reset below the stall uptime, a dropped
+    ``stalled=`` flag replenishes every cycle and relaunches the wedged
+    trainer forever (the bug: record() had the logic, no caller used it)."""
+    log = tmp_path / "launches"
+    script = _script(tmp_path, "hang3.py", f"""
+        import sys, time
+        with open({str(log)!r}, "a") as f:
+            f.write("x")
+        if len(open({str(log)!r}).read()) >= 3:
+            sys.exit(0)  # regression backstop: never loop forever
+        time.sleep(60)
+    """)
+    rc = run_supervised([sys.executable, script], max_restarts=1,
+                        backoff=0.01, heartbeat=str(tmp_path / "hb"),
+                        stall_timeout=0.6, term_grace=2.0,
+                        poll_interval=0.05, healthy_reset=0.3)
+    assert rc == 143
+    assert log.read_text() == "xx"  # launch + ONE budgeted restart, done
+
+
+def test_budget_preempted_fast_death_is_not_quota():
+    """A preemption can land seconds after launch, but the run HELD
+    capacity and checkpointed: it must take the normal restart path, not
+    the half-hour quota ladder."""
+    b = _RestartBudget(max_restarts=3, backoff=1.0, backoff_max=60.0,
+                       quota_window=10.0)
+    kind, delay = b.record(uptime=0.5, preempted=True)
+    assert kind == "restart 1/3" and delay == 1.0 and b.launch_failures == 0
+
+
+# --------------------------------------------------------------------------- #
+# heartbeat / stall detection
+# --------------------------------------------------------------------------- #
+
+
+def test_heartbeat_age_counts_missing_file_from_launch(tmp_path):
+    """The ISSUE satellite: the old code returned 0.0 ("perfectly fresh")
+    on OSError forever, so deleting the heartbeat file mid-run silently
+    disabled stall detection."""
+    hb = tmp_path / "hb"
+    hb.write_text("")
+    assert _heartbeat_age(str(hb), time.time() - 100) < 5.0
+    os.remove(hb)
+    assert _heartbeat_age(str(hb), time.time() - 100) > 95.0
+
+
+def test_deleted_heartbeat_still_trips_stall_kill(tmp_path):
+    script = _script(tmp_path, "rm_hb.py", """
+        import os, time
+        os.remove(os.environ["PICOTRON_HEARTBEAT"])
+        time.sleep(60)
+    """)
+    rc = run_supervised([sys.executable, script], max_restarts=0,
+                        heartbeat=str(tmp_path / "hb"), stall_timeout=1.0,
+                        term_grace=2.0, poll_interval=0.05)
+    assert rc == 143
+
+
+def test_stall_kill_counts_as_restart(tmp_path):
+    """A stall kill consumes the restart budget like any failure — a
+    permanently wedged run must not be relaunched forever. Previously
+    untested (the existing test uses max_restarts=0)."""
+    log = tmp_path / "launches"
+    script = _script(tmp_path, "hang.py", f"""
+        import time
+        with open({str(log)!r}, "a") as f:
+            f.write("x")
+        time.sleep(60)
+    """)
+    rc = run_supervised([sys.executable, script], max_restarts=1,
+                        backoff=0.01, heartbeat=str(tmp_path / "hb"),
+                        stall_timeout=0.7, term_grace=2.0,
+                        poll_interval=0.05)
+    assert rc == 143
+    assert log.read_text() == "xx"  # launch + exactly one budgeted restart
+
+
+# --------------------------------------------------------------------------- #
+# pod mode: N local ranks, one fate
+# --------------------------------------------------------------------------- #
+
+# each rank records "<rank>" per incarnation; reads pod env vars or dies
+_POD_OK = """
+    import os, sys
+    rank = os.environ["PICOTRON_POD_RANK"]
+    assert os.environ["JAX_PROCESS_ID"] == rank
+    assert os.environ["JAX_NUM_PROCESSES"] == "2"
+    with open(sys.argv[1], "a") as f:
+        f.write(rank)
+"""
+
+
+def test_pod_clean_exit_and_env(tmp_path):
+    log = tmp_path / "log"
+    script = _script(tmp_path, "ok.py", _POD_OK)
+    rc = run_pod([sys.executable, script, str(log)], num_procs=2,
+                 max_restarts=0, poll_interval=0.02)
+    assert rc == 0
+    assert sorted(log.read_text()) == ["0", "1"]
+
+
+def test_pod_one_crash_restarts_whole_pod(tmp_path):
+    """Rank 1 crashes once; rank 0 would happily sleep on — the supervisor
+    must terminate the straggler and relaunch BOTH ranks (a half-restarted
+    pod can never re-form its collectives)."""
+    log = tmp_path / "log"
+    marker = tmp_path / "crashed_once"
+    script = _script(tmp_path, "crashy_pod.py", f"""
+        import os, sys, time
+        rank = os.environ["PICOTRON_POD_RANK"]
+        with open({str(log)!r}, "a") as f:
+            f.write(rank)
+        if rank == "1" and not os.path.exists({str(marker)!r}):
+            open({str(marker)!r}, "w").close()
+            sys.exit(7)
+        if not os.path.exists({str(marker)!r}):
+            time.sleep(60)  # healthy rank: would outlive the crash alone
+    """)
+    rc = run_pod([sys.executable, script, str(log)], num_procs=2,
+                 max_restarts=1, backoff=0.01, term_grace=1.0,
+                 poll_interval=0.02)
+    assert rc == 0
+    # both ranks launched twice: crash incarnation + the clean relaunch
+    assert sorted(log.read_text()) == ["0", "0", "1", "1"]
+
+
+def test_pod_preemption_restarts_as_resumable(tmp_path):
+    """All ranks exiting 0/75 is a coordinated preemption (the consensus
+    path): restart normally — and never misread the fast death as a quota
+    failure."""
+    log = tmp_path / "log"
+    marker = tmp_path / "preempted_once"
+    script = _script(tmp_path, "preempt_pod.py", f"""
+        import os, sys
+        with open({str(log)!r}, "a") as f:
+            f.write(os.environ["PICOTRON_POD_RANK"])
+        if not os.path.exists({str(marker)!r}):
+            if os.environ["PICOTRON_POD_RANK"] == "1":
+                open({str(marker)!r}, "w").close()
+            sys.exit(75)
+    """)
+    rc = run_pod([sys.executable, script, str(log)], num_procs=2,
+                 max_restarts=1, backoff=0.01, term_grace=1.0,
+                 poll_interval=0.02, quota_window=30.0, quota_backoff=60.0)
+    assert rc == 0  # a quota misread would still be sleeping its hour out
+    assert sorted(log.read_text()) == ["0", "0", "1", "1"]
+
+
+def test_pod_stall_kills_and_propagates(tmp_path):
+    script = _script(tmp_path, "hang.py", "import time; time.sleep(60)")
+    rc = run_pod([sys.executable, script], num_procs=2, max_restarts=0,
+                 heartbeat=str(tmp_path / "hb"), stall_timeout=0.7,
+                 term_grace=1.0, poll_interval=0.05)
+    assert rc == 143  # the stall-killed ranks' SIGTERM deaths
+
+
+def test_pod_stall_exhausts_budget_like_run_supervised(tmp_path):
+    """The pod call site must pass its stall verdict to the shared budget
+    too — same regression as the single-process path."""
+    log = tmp_path / "launches"
+    script = _script(tmp_path, "hang4.py", f"""
+        import os, sys, time
+        with open({str(log)!r}, "a") as f:
+            f.write(os.environ["PICOTRON_POD_RANK"])
+        if len(open({str(log)!r}).read()) >= 5:
+            sys.exit(0)  # regression backstop: never loop forever
+        time.sleep(60)
+    """)
+    rc = run_pod([sys.executable, script], num_procs=2, max_restarts=1,
+                 backoff=0.01, heartbeat=str(tmp_path / "hb"),
+                 stall_timeout=0.6, term_grace=2.0, poll_interval=0.05,
+                 healthy_reset=0.3)
+    assert rc == 143
+    assert sorted(log.read_text()) == ["0", "0", "1", "1"]
+
+
+def test_pod_budget_exhaustion_propagates_crash_code(tmp_path):
+    script = _script(tmp_path, "die.py", "import sys; sys.exit(9)")
+    rc = run_pod([sys.executable, script], num_procs=2, max_restarts=1,
+                 backoff=0.01, term_grace=1.0, poll_interval=0.02)
+    assert rc == 9
+
+
+# --------------------------------------------------------------------------- #
+# per-host pods: the shared restart-epoch file
+# --------------------------------------------------------------------------- #
+
+
+def test_epoch_file_round_trip(tmp_path):
+    path = str(tmp_path / "epoch")
+    assert _read_epoch(path) == 0  # missing file is epoch 0
+    _bump_epoch(path, 0)
+    assert _read_epoch(path) == 1
+    _bump_epoch(path, 5)  # bump must advance PAST what the host observed
+    assert _read_epoch(path) == 6
+
+
+def test_local_failure_bumps_epoch_for_peers(tmp_path):
+    """A failing host's supervisor must tell the other hosts to restart
+    too, even when its own budget is spent."""
+    epoch = tmp_path / "epoch"
+    script = _script(tmp_path, "die.py", "import sys; sys.exit(7)")
+    rc = run_supervised([sys.executable, script], max_restarts=0,
+                        backoff=0.01, poll_interval=0.02,
+                        epoch_file=str(epoch))
+    assert rc == 7
+    assert _read_epoch(str(epoch)) == 1
+
+
+def test_pod_wide_failure_bumps_epoch_exactly_once(tmp_path):
+    """When a peer already bumped the epoch for this incarnation (a
+    coordinated preemption lands every host's failure within seconds),
+    our failure must FOLLOW that restart — on the peer's budget, without
+    compounding the bump (each compound would SIGTERM peers' freshly
+    resumed trainers)."""
+    epoch = tmp_path / "epoch"
+    log = tmp_path / "launches"
+    # first incarnation: "a peer host" bumps the shared epoch while we are
+    # failing too; second incarnation succeeds
+    script = _script(tmp_path, "fail_with_peer.py", f"""
+        import sys
+        with open({str(log)!r}, "a") as f:
+            f.write("x")
+        if len(open({str(log)!r}).read()) == 1:
+            with open({str(epoch)!r}, "w") as f:
+                f.write("1")
+            sys.exit(75)
+    """)
+    rc = run_supervised([sys.executable, script], max_restarts=0,
+                        backoff=0.01, poll_interval=0.05,
+                        epoch_file=str(epoch))
+    assert rc == 0
+    # max_restarts=0: the relaunch happened on the peer's budget, and the
+    # epoch stayed at the peer's bump — we did not advance it again
+    assert log.read_text() == "xx"
+    assert _read_epoch(str(epoch)) == 1
+
+
+def test_peer_epoch_bump_restarts_without_budget_charge(tmp_path):
+    """A peer-initiated pod restart terminates the local child and
+    relaunches — on the PEER's budget: with max_restarts=0 the relaunch
+    must still happen."""
+    epoch = tmp_path / "epoch"
+    log = tmp_path / "launches"
+    script = _script(tmp_path, "follow.py", f"""
+        import os, time
+        with open({str(log)!r}, "a") as f:
+            f.write("x")
+        if len(open({str(log)!r}).read()) == 1:
+            time.sleep(60)  # first incarnation waits to be peer-restarted
+    """)
+    result = {}
+
+    def drive():
+        result["rc"] = run_supervised(
+            [sys.executable, script], max_restarts=0, backoff=0.01,
+            term_grace=1.0, poll_interval=0.05, epoch_file=str(epoch))
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not log.exists():
+        time.sleep(0.05)
+    _bump_epoch(str(epoch), 0)  # the "peer host" asks for a pod restart
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert result["rc"] == 0
+    assert log.read_text() == "xx"  # terminated + relaunched, no budget used
+
+
+# --------------------------------------------------------------------------- #
+# CLI plumbing
+# --------------------------------------------------------------------------- #
+
+
+def test_main_runs_single_command():
+    assert main(["--max-restarts", "0", "--backoff", "0.01", "--",
+                 sys.executable, "-c", "raise SystemExit(0)"]) == 0
+
+
+def test_main_rejects_conflicting_pod_modes():
+    with pytest.raises(SystemExit):
+        main(["--num-procs", "2", "--epoch-file", "/tmp/e", "--",
+              "true"])
+    with pytest.raises(SystemExit):
+        main(["--stall-timeout", "5", "--", "true"])  # needs --heartbeat
+    with pytest.raises(SystemExit):
+        main(["--max-restarts", "0"])  # no command
+    # pod mode without a rendezvous address would launch N DUPLICATE
+    # single-process trainers racing on one save_dir
+    with pytest.raises(SystemExit):
+        main(["--num-procs", "2", "--", "true"])
