@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -10,13 +10,25 @@ $(NATIVE_SO): $(NATIVE_SRC)
 	mkdir -p $(dir $@)
 	g++ -O3 -shared -fPIC -std=c++17 $< -o $@
 
-# Fast gate: skips the multi-minute equivalence/e2e matrices (marked
-# pytest.mark.slow) — ~6 min on one core. `make test-all` runs everything.
-test: native
+# Fast gate: picolint first (pure-AST, ~1s — a lock-discipline or
+# hot-path regression fails before any test imports jax), then the
+# not-slow test matrix — ~6 min on one core. `make test-all` runs
+# everything.
+test: native lint
 	python -m pytest tests/ -x -q -m "not slow"
 
-test-all: native
+test-all: native lint
 	python -m pytest tests/ -x -q
+
+# picolint static analysis (picotron_tpu/analysis/, docs/ANALYSIS.md):
+# JAX hot-path rules (host syncs on traced values, trace-time
+# nondeterminism, program_id-in-loop-body, jit-in-loop recompiles) +
+# concurrency rules (lock-order inversions, blocking under a lock,
+# unguarded shared mutation) over the whole package. Exit 1 on any
+# finding not in analysis/baseline.json. `--json` variant for trends:
+#   python -m picotron_tpu.tools.lint --json > lint.json
+lint:
+	python -m picotron_tpu.tools.lint --fail-on-new
 
 # One pytest process per test file: the XLA CPU runtime's in-process
 # collective rendezvous can abort the interpreter on rare races, and process

@@ -1,0 +1,16 @@
+"""picolint: AST-based static analysis for the two regression classes that
+actually bite this codebase — silent host syncs / recompiles on jitted hot
+paths (PICO-J rules) and lock-discipline bugs in the threaded serving
+stack (PICO-C rules).  Pure ``ast``: linting never imports the scanned
+code and needs no jax.  CLI: ``python -m picotron_tpu.tools.lint``;
+catalog + policy: docs/ANALYSIS.md; gate: tests/test_analysis.py.
+"""
+
+from picotron_tpu.analysis.findings import RULES, Finding, Suppressions
+from picotron_tpu.analysis.engine import (
+    DEFAULT_BASELINE, diff_baseline, load_baseline, run, run_suite)
+
+__all__ = [
+    "RULES", "Finding", "Suppressions", "DEFAULT_BASELINE",
+    "diff_baseline", "load_baseline", "run", "run_suite",
+]

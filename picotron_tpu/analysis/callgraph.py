@@ -1,0 +1,252 @@
+"""Project model for picolint: parsed sources, symbol tables, call resolution.
+
+Pure ``ast`` — scanning never imports the scanned code (so linting stays
+fast, side-effect free, and runnable on files whose dependencies are
+absent).  The model is deliberately shallow where Python is dynamic:
+
+- functions are registered by qualname (``Class.method``,
+  ``func.<locals>.inner``);
+- imports are resolved only far enough to follow **intra-project** calls
+  (``from picotron_tpu.models import llama; llama.decoder_layer(...)``);
+  calls into third-party code are opaque;
+- ``self.method()`` resolves within the lexically enclosing class.
+
+That is exactly the precision the analyzers need: the JAX analyzer walks
+the intra-package call graph from jitted entry points, the concurrency
+analyzer follows same-class/method calls while tracking held locks.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from picotron_tpu.analysis.findings import Suppressions
+
+
+@dataclass
+class FuncInfo:
+    """One function/method definition (including nested defs)."""
+
+    qualname: str  # e.g. "FrontEnd.submit", "f.<locals>.body"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    module: "ModuleInfo"
+    class_name: Optional[str] = None  # enclosing class, if a method
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def params(self) -> list:
+        a = self.node.args
+        names = [p.arg for p in
+                 getattr(a, "posonlyargs", []) + a.args + a.kwonlyargs]
+        for extra in (a.vararg, a.kwarg):
+            if extra is not None:
+                names.append(extra.arg)
+        return names
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file with its local symbol tables."""
+
+    modname: str  # dotted, scan-root-relative ("picotron_tpu.tools.serve")
+    rel: str  # posix relative path ("picotron_tpu/tools/serve.py")
+    path: str
+    tree: ast.Module
+    lines: list
+    suppressions: Suppressions
+    functions: dict = field(default_factory=dict)  # qualname -> FuncInfo
+    # local name -> dotted module it aliases ("llama" -> "...models.llama")
+    module_aliases: dict = field(default_factory=dict)
+    # local name -> (dotted module, attr) for `from mod import attr`
+    from_imports: dict = field(default_factory=dict)
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def _index_module(mod: ModuleInfo) -> None:
+    """Fill ``functions``/``module_aliases``/``from_imports`` for one file."""
+
+    def walk(node: ast.AST, prefix: str, class_name: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                mod.functions[qual] = FuncInfo(qual, child, mod, class_name)
+                walk(child, f"{qual}.<locals>.", class_name)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.", child.name)
+            else:
+                walk(child, prefix, class_name)
+
+    walk(mod.tree, "", None)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                # `import a.b.c` binds `a`; `import a.b.c as x` binds x->a.b.c
+                target = alias.name if alias.asname else alias.name.split(".")[0]
+                mod.module_aliases[local] = target
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:  # relative import: anchor at this module's package
+                pkg_parts = mod.modname.split(".")[: -node.level]
+                base = ".".join(pkg_parts + ([node.module]
+                                             if node.module else []))
+            for alias in node.names:
+                local = alias.asname or alias.name
+                mod.from_imports[local] = (base, alias.name)
+
+
+class Project:
+    """All scanned modules plus cross-module call resolution."""
+
+    def __init__(self, modules: Iterable[ModuleInfo]):
+        self.modules = {m.modname: m for m in modules}
+
+    # -- lookups ----------------------------------------------------------- #
+
+    def module_for(self, dotted: str) -> Optional[ModuleInfo]:
+        return self.modules.get(dotted)
+
+    def resolve_name(self, mod: ModuleInfo,
+                     name: str) -> Optional[FuncInfo]:
+        """A bare name used as a callable: module-level def, or a
+        ``from <project module> import f``."""
+        fi = mod.functions.get(name)
+        if fi is not None and fi.class_name is None and "." not in name:
+            return fi
+        if name in mod.from_imports:
+            src_mod, attr = mod.from_imports[name]
+            target = self.module_for(src_mod)
+            if target is not None:
+                return target.functions.get(attr)
+        return None
+
+    def resolve_call(self, mod: ModuleInfo, call: ast.Call,
+                     self_class: Optional[str] = None) -> Optional[FuncInfo]:
+        """Resolve a call's target to a scanned FuncInfo where possible:
+        bare names, ``module.func`` through project imports, and
+        ``self.method`` within ``self_class``."""
+        return self.resolve_callee_expr(mod, call.func, self_class)
+
+    def resolve_callee_expr(self, mod: ModuleInfo, func: ast.expr,
+                            self_class: Optional[str] = None
+                            ) -> Optional[FuncInfo]:
+        if isinstance(func, ast.Name):
+            return self.resolve_name(mod, func.id)
+        if isinstance(func, ast.Attribute):
+            value = func.value
+            if (isinstance(value, ast.Name) and value.id == "self"
+                    and self_class):
+                return mod.functions.get(f"{self_class}.{func.attr}")
+            dotted = dotted_name(func)
+            if dotted is None:
+                return None
+            root, rest = dotted[0], dotted[1:]
+            # alias for a scanned module (import picotron_tpu.x as y)
+            target_mod = mod.module_aliases.get(root)
+            if target_mod is None and root in mod.from_imports:
+                src, attr = mod.from_imports[root]
+                if self.module_for(f"{src}.{attr}") is not None:
+                    target_mod = f"{src}.{attr}"
+            if target_mod is None:
+                return None
+            # longest scanned-module prefix wins: with package __init__
+            # files in the scan, `pkg` AND `pkg.sub.mod` are both modules,
+            # and `pkg.sub.mod.f()` must resolve f in the deepest one
+            for i in range(len(rest) - 1, -1, -1):
+                target = self.module_for(".".join([target_mod] + rest[:i]))
+                if target is not None:
+                    remaining = rest[i:]
+                    if len(remaining) == 1:
+                        return target.functions.get(remaining[0])
+                    return None  # attribute chain past a function: opaque
+        return None
+
+
+def dotted_name(node: ast.expr) -> Optional[list]:
+    """``a.b.c`` -> ["a", "b", "c"]; None for non-name chains."""
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def enclosing_qualname(mod: ModuleInfo, target: ast.AST) -> str:
+    """Qualname of the innermost function containing ``target`` (by line
+    span), or "<module>"."""
+    best = None
+    for fi in mod.functions.values():
+        node = fi.node
+        if (getattr(node, "lineno", 1 << 30) <= target.lineno
+                <= getattr(node, "end_lineno", -1)):
+            if best is None or node.lineno > best.node.lineno:
+                best = fi
+    return best.qualname if best is not None else "<module>"
+
+
+# --------------------------------------------------------------------------- #
+# loading
+# --------------------------------------------------------------------------- #
+
+
+_PRUNE_DIRS = ("__pycache__", ".git", "_build")
+
+
+def iter_python_files(root: str) -> list:
+    """Every ``.py`` under ``root`` (sorted, ``_PRUNE_DIRS`` skipped) —
+    the one file walk shared by the engine and the CLI, so the prune
+    list cannot drift between them."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames) if d not in _PRUNE_DIRS]
+        out += [os.path.join(dirpath, fn) for fn in sorted(filenames)
+                if fn.endswith(".py")]
+    return out
+
+
+def load_project(root: str, files: Optional[list] = None) -> Project:
+    """Parse every ``.py`` under ``root`` (or just ``files``) into a
+    Project.  ``root`` should be the directory CONTAINING the package so
+    module names come out fully dotted (``picotron_tpu.tools.serve``)."""
+    root = os.path.abspath(root)
+    if files is not None:
+        # an explicit-but-empty list means "scan nothing" (the caller
+        # resolved a scope with no .py files), NOT "fall back to root"
+        paths = [os.path.abspath(f) for f in files]
+    else:
+        paths = iter_python_files(root)
+    modules = []
+    for path in paths:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            tree = ast.parse(text, filename=path)
+        except (OSError, SyntaxError):
+            # unparseable files are someone else's problem (and a broken
+            # scan must not mask every OTHER file's findings)
+            continue
+        modname = rel[:-3].replace("/", ".")
+        if modname.endswith(".__init__"):
+            modname = modname[: -len(".__init__")]
+        mod = ModuleInfo(
+            modname=modname, rel=rel, path=path, tree=tree,
+            lines=text.splitlines(),
+            suppressions=Suppressions.parse(text))
+        _index_module(mod)
+        modules.append(mod)
+    return Project(modules)

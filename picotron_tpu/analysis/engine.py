@@ -1,0 +1,191 @@
+"""picolint suite driver: run the analyzers, apply suppressions, diff the
+baseline, format output.
+
+The baseline (``analysis/baseline.json``) is the contract that makes the
+suite enforceable in tier-1 **today** without blocking on a perfectly
+clean history: only findings *not* in the baseline fail the run.  Policy
+(docs/ANALYSIS.md): every true positive gets **fixed**, never baselined;
+a baseline entry is only for a documented false positive and must carry a
+non-empty ``reason``.  Entries match findings by fingerprint
+(rule + path + enclosing qualname + normalized source line), so ordinary
+edits elsewhere in the file don't invalidate them — but editing the
+flagged line itself re-opens the finding, which is the point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from picotron_tpu.analysis import concurrency_rules, jax_rules
+from picotron_tpu.analysis.callgraph import load_project
+from picotron_tpu.analysis.findings import RULES, Finding, _norm
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def run_suite(root: str, files: Optional[list] = None) -> list:
+    """All findings (suppression comments already applied), sorted by
+    (path, line, rule).  ``root`` is the directory containing the code to
+    scan — for the self-scan, the repo root with files limited to
+    ``picotron_tpu/``."""
+    project = load_project(root, files)
+    findings = jax_rules.analyze(project) + concurrency_rules.analyze(project)
+    out = []
+    for f in findings:
+        mod = next((m for m in project.modules.values() if m.rel == f.path),
+                   None)
+        if mod is not None and mod.suppressions.silences(f):
+            continue
+        out.append(f)
+    # dedup exact duplicates (a nested def reachable two ways, etc.)
+    seen: set = set()
+    uniq = []
+    for f in sorted(out, key=Finding.sort_key):
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq
+
+
+# --------------------------------------------------------------------------- #
+# baseline
+# --------------------------------------------------------------------------- #
+
+
+def load_baseline(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        entries = data.get("findings")
+        if not isinstance(entries, list):
+            raise ValueError(
+                f"baseline {path}: expected a {{'findings': [...]}} "
+                f"object (keys: {sorted(data)})")
+    elif isinstance(data, list):
+        entries = data
+    else:
+        raise ValueError(
+            f"baseline {path}: expected an object or a list, "
+            f"got {type(data).__name__}")
+    for e in entries:
+        for key in ("rule", "path", "context", "snippet"):
+            if key not in e:
+                raise ValueError(
+                    f"baseline entry missing {key!r}: {e}")
+    return entries
+
+
+def entry_fingerprint(e: dict) -> tuple:
+    return (e["rule"], e["path"], e["context"], _norm(e["snippet"]))
+
+
+def diff_baseline(findings: list, baseline: list,
+                  scanned_paths: Optional[set] = None) -> tuple:
+    """(new_findings, matched_findings, stale_entries).  Fingerprints are
+    counted, not just set-matched: two identical new findings against one
+    baseline entry leave one of them new.  ``scanned_paths`` (rel paths)
+    limits STALE detection to files the scan actually covered — a
+    partial scan not firing on an unscanned file is no evidence its
+    entry is dead."""
+    budget: dict = {}
+    for e in baseline:
+        budget[entry_fingerprint(e)] = budget.get(entry_fingerprint(e), 0) + 1
+    new, matched = [], []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = []
+    for e in baseline:
+        if scanned_paths is not None and e["path"] not in scanned_paths:
+            continue
+        fp = entry_fingerprint(e)
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            stale.append(e)
+    return new, matched, stale
+
+
+def undocumented_entries(baseline: list) -> list:
+    """Baseline entries whose ``reason`` is empty or a placeholder — the
+    self-scan test turns these into failures (the baseline is for
+    *documented* false positives only)."""
+    bad = []
+    for e in baseline:
+        reason = str(e.get("reason", "")).strip()
+        if not reason or reason.upper().startswith(("TODO", "FIXME")):
+            bad.append(e)
+    return bad
+
+
+def baseline_entry(f: Finding, reason: str = "") -> dict:
+    return {"rule": f.rule, "path": f.path, "context": f.context,
+            "snippet": f.snippet, "reason": reason}
+
+
+# --------------------------------------------------------------------------- #
+# reporting
+# --------------------------------------------------------------------------- #
+
+
+def report_json(findings: list, new: list, matched: list, stale: list,
+                elapsed_s: float) -> dict:
+    return {
+        "tool": "picolint",
+        "rules": {rid: {"title": r.title, "rationale": r.rationale}
+                  for rid, r in sorted(RULES.items())},
+        "elapsed_s": round(elapsed_s, 3),
+        "counts": {"total": len(findings), "new": len(new),
+                   "baselined": len(matched), "stale_baseline": len(stale)},
+        "findings": [f.to_dict() for f in findings],
+        "new": [f.to_dict() for f in new],
+        "stale_baseline": stale,
+    }
+
+
+def report_text(findings: list, new: list, matched: list, stale: list,
+                elapsed_s: float) -> str:
+    lines = []
+    new_set = {id(f) for f in new}
+    for f in findings:
+        tag = "NEW " if id(f) in new_set else "base"
+        lines.append(f"[{tag}] {f.render()}")
+    for e in stale:
+        lines.append(f"[stale baseline] {e['rule']} {e['path']} "
+                     f"[{e['context']}] — no longer fires; remove the entry")
+    lines.append(
+        f"picolint: {len(findings)} finding(s) — {len(new)} new, "
+        f"{len(matched)} baselined, {len(stale)} stale baseline "
+        f"entr{'y' if len(stale) == 1 else 'ies'} ({elapsed_s:.2f}s)")
+    return "\n".join(lines)
+
+
+def run(root: str, files: Optional[list] = None,
+        baseline_path: str = DEFAULT_BASELINE) -> dict:
+    """One-call API for tests and the CLI: scan + baseline diff.
+    Returns the ``report_json`` dict plus the raw finding lists under
+    private keys."""
+    t0 = time.monotonic()
+    findings = run_suite(root, files)
+    baseline = load_baseline(baseline_path)
+    scanned = None
+    if files is not None:
+        absroot = os.path.abspath(root)
+        scanned = {os.path.relpath(os.path.abspath(f), absroot)
+                   .replace(os.sep, "/") for f in files}
+    new, matched, stale = diff_baseline(findings, baseline, scanned)
+    out = report_json(findings, new, matched, stale,
+                      time.monotonic() - t0)
+    out["_findings"], out["_new"], out["_stale"] = findings, new, stale
+    out["_matched"] = matched
+    out["_baseline"] = baseline
+    return out

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import warnings
 from functools import partial
 from typing import Any, Optional
@@ -145,7 +146,12 @@ class CheckpointManager:
         self.mirror_dir = os.path.abspath(mirror_dir) if mirror_dir else ""
         self._mirror_mgr = None
         self._mirror_q = None  # lazily-started worker's step queue
+        # _mirror_errs is appended by the mirror worker thread and swapped
+        # out by _join_mirror, which readers (restore fallback, close) AND
+        # the emergency-save thread can reach concurrently with the worker
+        # — the list needs its own lock (picolint PICO-C004)
         self._mirror_errs: list = []
+        self._mirror_mu = threading.Lock()
         self._max_to_keep = max_to_keep
         # retrying I/O (resilience): transient NFS/GCS flakes on save/restore
         # are retried with exponential backoff before surfacing
@@ -268,23 +274,31 @@ class CheckpointManager:
                             f"checkpoint mirror replication of step {step} "
                             f"failed ({type(e).__name__}: {e}); the mirror "
                             f"tier is stale", RuntimeWarning)
-                        if len(self._mirror_errs) < 8:
-                            self._mirror_errs.append(e)
+                        self._record_mirror_err(e)
             except BaseException as e:  # noqa: BLE001 - the worker must live
                 # e.g. warnings promoted to errors (-W error): a dead worker
                 # would strand queued entries and deadlock every later
                 # _mirror_q.join() (readers, close()) — record and continue
-                if len(self._mirror_errs) < 8:
-                    self._mirror_errs.append(e)
+                self._record_mirror_err(e)
             finally:
                 for _ in batch:
                     self._mirror_q.task_done()
+
+    def _record_mirror_err(self, e: BaseException) -> None:
+        """Retain one replication failure (bounded) for the next reader
+        join — under the list's lock: the worker appends here while
+        _join_mirror swaps the list out from a reader (or the
+        emergency-save) thread."""
+        with self._mirror_mu:
+            if len(self._mirror_errs) < 8:
+                self._mirror_errs.append(e)
 
     def _join_mirror(self) -> None:
         if self._mirror_q is None:
             return
         self._mirror_q.join()
-        errs, self._mirror_errs = self._mirror_errs, []
+        with self._mirror_mu:
+            errs, self._mirror_errs = self._mirror_errs, []
         for err in errs:
             warnings.warn(
                 f"checkpoint mirror replication failed "

@@ -52,6 +52,18 @@ across grid instances); on CPU the kernel runs in Pallas interpret mode
 exercise it. Dense remains the serving default (``inference.attend_impl``)
 until the kernel is A/B'd on a chip, the same staging discipline the
 ``bshd`` flash layout went through.
+
+**The program_id trap (picolint rule PICO-J003).** ``pl.program_id`` must
+be read ONCE, outside the ``fori_loop`` body: the jax 0.4.37 Pallas
+interpreter cannot resolve grid ids inside a loop body's sub-jaxpr, so a
+kernel that reads ``pl.program_id`` under ``fori_loop``/``while_loop``
+traces fine on TPU but fails (or silently misindexes) on the interpret
+path every CPU test runs. This kernel hit exactly that during PR 5 — the
+fix is the ``b``/``h`` reads at the top of ``_flash_decode_kernel``,
+before ``body`` closes over them. The hazard is now enforced
+mechanically: ``python -m picotron_tpu.tools.lint`` flags any
+``program_id`` read inside a loop-body closure as PICO-J003
+(picotron_tpu/analysis/jax_rules.py; catalog: docs/ANALYSIS.md#pico-j003).
 """
 
 from __future__ import annotations
@@ -110,7 +122,8 @@ def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized, paged):
         (q_ref, k_ref, v_ref, o_ref, kbuf, vbuf, sems) = refs
         ks_ref = vs_ref = ksbuf = vsbuf = None
     # program ids are read ONCE here: the 0.4.37 interpreter cannot resolve
-    # pl.program_id inside the fori_loop body's sub-jaxpr
+    # pl.program_id inside the fori_loop body's sub-jaxpr (enforced as
+    # picolint PICO-J003 — see the module docstring)
     b = pl.program_id(0)
     h = pl.program_id(1)
     L = len_ref[0]  # this slot's live token count

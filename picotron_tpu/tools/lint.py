@@ -1,0 +1,147 @@
+"""picolint CLI: run the static-analysis suite over the package.
+
+    python -m picotron_tpu.tools.lint               # scan picotron_tpu/
+    python -m picotron_tpu.tools.lint --json        # machine-readable
+    python -m picotron_tpu.tools.lint path/to/file.py path/to/dir
+
+Exit codes: 0 = clean (every finding baselined), 1 = new non-baselined
+findings, 2 = bad invocation.  ``--fail-on-new`` is the default contract
+(kept as an explicit flag so `make lint` reads as policy); pass
+``--no-fail-on-new`` for an advisory run.
+
+The scan is pure AST — no jax import, no code execution — so the full
+package lints in a couple of seconds on CPU.  Rule catalog, baseline
+policy, and suppression syntax: docs/ANALYSIS.md.
+
+``--write-baseline`` appends the current NEW findings to the baseline
+with a placeholder reason.  The self-scan test
+(tests/test_analysis.py::test_baseline_reasons_documented) fails on
+placeholder reasons, so the written entries must be documented (or the
+finding fixed) before they can ship — baselining is for documented false
+positives, never a parking lot for real bugs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from picotron_tpu.analysis import engine
+from picotron_tpu.analysis.callgraph import iter_python_files
+from picotron_tpu.analysis.findings import _canon, validate_rule_ids
+
+
+def _scan_spec(paths: list) -> tuple:
+    """(root, files|None) for the engine: default is the repo checkout
+    scanning the picotron_tpu package; explicit paths are resolved
+    relative to cwd and scanned under their common root."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_root = os.path.dirname(pkg_dir)
+    if not paths:
+        return repo_root, iter_python_files(pkg_dir)
+    files, anchors = [], []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            files += iter_python_files(p)
+            anchors.append(p)
+        elif os.path.isfile(p):
+            files.append(p)
+            anchors.append(os.path.dirname(p))
+        else:
+            raise SystemExit(f"lint: no such path: {p}")
+    # keep module names package-rooted when the paths live in the repo;
+    # outside it, root on the ARGUMENTS (a dir arg is its own root), not
+    # on commonpath(files) — `lint proj` and `lint proj/bad.py` must
+    # fingerprint the same file identically or baselines go stale with
+    # the invocation shape
+    common = os.path.commonpath(anchors)
+    in_repo = os.path.commonpath([common, repo_root]) == repo_root
+    root = repo_root if in_repo else common
+    return root, files
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="picolint",
+        description="JAX/Pallas hot-path + host-concurrency static "
+                    "analysis (docs/ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: picotron_tpu/)")
+    ap.add_argument("--baseline", default=engine.DEFAULT_BASELINE,
+                    help="baseline file (default: analysis/baseline.json)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report on stdout")
+    ap.add_argument("--fail-on-new", dest="fail_on_new",
+                    action="store_true", default=True,
+                    help="exit 1 on any non-baselined finding (default)")
+    ap.add_argument("--no-fail-on-new", dest="fail_on_new",
+                    action="store_false",
+                    help="advisory run: report, always exit 0")
+    ap.add_argument("--rules", nargs="*", default=None,
+                    help="restrict the printed report to these rule IDs "
+                         "(the exit-code gate still considers every rule)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="append current NEW findings to the baseline "
+                         "with a placeholder reason (document them!)")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        # same spelling rules as suppression comments: bare suffixes
+        # ("J001") canonicalize, "*"/"all" means every rule (no filter)
+        args.rules = [r for r in (_canon(r) for r in args.rules) if r]
+        bad = validate_rule_ids(args.rules)
+        if bad is not None:
+            print(f"lint: unknown rule id {bad}", file=sys.stderr)
+            return 2
+        if "*" in args.rules:
+            args.rules = None
+
+    try:
+        root, files = _scan_spec(args.paths)
+    except SystemExit as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    try:
+        out = engine.run(root, files, baseline_path=args.baseline)
+    except ValueError as e:  # malformed baseline file
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    findings, new, stale = out["_findings"], out["_new"], out["_stale"]
+    matched = out["_matched"]
+    all_new = new  # the gate and --write-baseline see every rule;
+    if args.rules:  # --rules narrows the REPORT only
+        keep = set(args.rules)
+        findings = [f for f in findings if f.rule in keep]
+        new = [f for f in new if f.rule in keep]
+        matched = [f for f in matched if f.rule in keep]
+
+    if args.write_baseline and all_new:
+        baseline = out["_baseline"] + [
+            engine.baseline_entry(
+                f, reason="TODO: document why this is a false positive "
+                          "(or fix it)") for f in all_new]
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump({"findings": baseline}, f, indent=2)
+            f.write("\n")
+        print(f"lint: wrote {len(all_new)} new entr"
+              f"{'y' if len(all_new) == 1 else 'ies'} to {args.baseline} — "
+              f"fill in the reasons before shipping", file=sys.stderr)
+
+    if args.as_json:
+        print(json.dumps(engine.report_json(
+            findings, new, matched, stale, out["elapsed_s"]), indent=2))
+    else:
+        print(engine.report_text(findings, new, matched, stale,
+                                 out["elapsed_s"]))
+
+    if args.fail_on_new and all_new and not args.write_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,12 @@ class FrontEnd:
         self.rejections = {"queue_full": 0, "token_budget": 0,
                            "page_budget": 0, "draining": 0, "stalled": 0,
                            "dead": 0}
+        # leaf lock for the rejection counters: the "stalled" increment
+        # happens precisely when _mu could NOT be acquired, so the
+        # counters need their own guard (picolint PICO-C003 — concurrent
+        # timed-out handlers would lose increments). Always taken last
+        # (inside _mu where both are held), never while waiting on _mu.
+        self._rej_mu = threading.Lock()
         self._uid_seq = 0
         self._start_t = time.monotonic()
         self._progress_t = time.monotonic()
@@ -205,7 +211,7 @@ class FrontEnd:
         # stall the watchdog flags) admission SHEDS instead of parking
         # handler threads on the lock forever
         if not self._mu.acquire(timeout=10.0):
-            self.rejections["stalled"] += 1
+            self._reject("stalled")
             raise AdmissionError(
                 503, "dispatch stalled (admission unavailable)",
                 retry_after=10)
@@ -215,23 +221,23 @@ class FrontEnd:
                 # unexpected exception): nothing will ever serve this
                 # request — shed it instead of stranding the handler on a
                 # waiter no loop will complete
-                self.rejections["dead"] += 1
+                self._reject("dead")
                 raise AdmissionError(
                     503, "dispatch loop exited (restart required)",
                     retry_after=30)
             if self.draining:
-                self.rejections["draining"] += 1
+                self._reject("draining")
                 raise AdmissionError(
                     503, "draining (restart in progress)", retry_after=5)
             if self._batcher.queue_depth >= self.max_queue:
                 # the wait queue is bounded: past it, queueing only grows
                 # the client's latency — shed instead
-                self.rejections["queue_full"] += 1
+                self._reject("queue_full")
                 raise AdmissionError(
                     503, f"wait queue full ({self.max_queue})",
                     retry_after=max(1, self.max_queue // 8))
             if self._batcher.token_load() + cost > self.token_budget:
-                self.rejections["token_budget"] += 1
+                self._reject("token_budget")
                 raise AdmissionError(
                     429, f"token budget exhausted ({self.token_budget})",
                     retry_after=1)
@@ -245,7 +251,7 @@ class FrontEnd:
                 load = self._batcher.page_load()
                 if load + need > usable:
                     deficit = load + need - usable
-                    self.rejections["page_budget"] += 1
+                    self._reject("page_budget")
                     raise AdmissionError(
                         429,
                         f"kv page pool exhausted (need {need} of "
@@ -268,6 +274,13 @@ class FrontEnd:
             self._mu.release()
         self._wake.set()
         return req.uid, waiter
+
+    def _reject(self, key: str) -> None:
+        """Count one shed under the counters' own leaf lock — reachable
+        both with and without ``_mu`` held (the "stalled" path fires
+        exactly because ``_mu`` was unavailable)."""
+        with self._rej_mu:
+            self.rejections[key] += 1
 
     def _next_uid(self) -> str:
         with self._uid_mu:
@@ -329,13 +342,20 @@ class FrontEnd:
                 self._on_drained()
 
     def _deliver(self, uid: str, res) -> None:
-        t0 = self._req_t.pop(uid, None)
+        # the pops happen under _mu: handler threads INSERT these entries
+        # under the same lock in submit(), and the duplicate-uid check
+        # reads _waiters there — an unlocked pop here races both (picolint
+        # PICO-C003). The log line and the waiter hand-off (a Queue put)
+        # stay outside: neither needs the lock, and the log is file I/O
+        # that must not stall admission (PICO-C002).
+        with self._mu:
+            t0 = self._req_t.pop(uid, None)
+            w = self._waiters.pop(uid, None)
         self._event(
             "request", uid=uid, finish_reason=res.finish_reason,
             prompt_tokens=len(res.prompt), new_tokens=len(res.tokens),
             queue_wait_s=_r(res.queue_wait_s), ttft_s=_r(res.ttft_s),
             total_s=_r(None if t0 is None else time.monotonic() - t0))
-        w = self._waiters.pop(uid, None)
         if w is not None:
             w.put_done(res)
 
@@ -385,7 +405,8 @@ class FrontEnd:
                 self._mu.release()
         else:
             d = {"snapshot": "partial (dispatch in progress)"}
-        d["rejected"] = dict(self.rejections)
+        with self._rej_mu:
+            d["rejected"] = dict(self.rejections)
         d["draining"] = self.draining
         d["dead"] = self.dead
         d["stalled"] = self.stalled

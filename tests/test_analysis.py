@@ -1,0 +1,1047 @@
+"""picolint suite (ISSUE 9; docs/ANALYSIS.md).
+
+Three layers, mirroring the suite's contract:
+
+1. **Fixture snippets per rule** — for each rule ID a positive snippet
+   (the seeded hazard MUST be caught by exactly that rule), a negative
+   snippet (the idiomatic near-miss MUST stay silent: precision is what
+   keeps the shipped baseline empty), and the suppression comment.
+2. **Baseline workflow** — fingerprint matching survives line drift but
+   re-opens when the flagged line changes; stale entries are reported;
+   undocumented reasons are rejected.
+3. **The tier-1 gate** — the repo's own package scans clean against the
+   checked-in baseline (every true positive fixed, the baseline reserved
+   for documented false positives), in well under the 30s budget, and the
+   CLI exit codes enforce it.
+
+The scan is pure ``ast`` — fixtures are never imported or executed, so
+they can reference jax/pallas APIs freely without a TPU or even jax.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from picotron_tpu.analysis import engine
+from picotron_tpu.analysis.findings import (
+    RULES, Suppressions, validate_rule_ids)
+from picotron_tpu.tools import lint
+
+
+def _scan(tmp_path, source, name="fix_mod.py"):
+    """Write one fixture module and run the full suite over it."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return engine.run_suite(str(tmp_path), [str(p)])
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --------------------------------------------------------------------------- #
+# PICO-J001: host sync on a traced value
+# --------------------------------------------------------------------------- #
+
+
+def test_j001_float_of_tracer_in_jitted_function(tmp_path):
+    found = _scan(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) + 1.0
+        """)
+    assert _rules(found) == ["PICO-J001"]
+    assert found[0].context == "f"
+    assert "float()" in found[0].message
+
+
+def test_j001_item_and_device_get_and_np_asarray(tmp_path):
+    found = _scan(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            a = x.item()
+            b = np.asarray(x)
+            c = jax.device_get(x)
+            return a, b, c
+        """)
+    assert _rules(found) == ["PICO-J001"]
+    assert len(found) == 3
+
+
+def test_j001_bool_coercion_of_array_in_if(tmp_path):
+    found = _scan(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            bad = jnp.any(x > 3)
+            if bad:
+                return x * 0
+            return x
+        """)
+    assert _rules(found) == ["PICO-J001"]
+    assert "bool coercion" in found[0].message
+
+
+def test_j001_negatives_static_idioms_stay_silent(tmp_path):
+    # the idioms jitted code legitimately uses: shape/dtype reads,
+    # identity tests on optionals, static config flags, host-scalar
+    # annotated params, and a float() on a TRANSITIVE helper's static arg
+    found = _scan(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x, scale):
+            return x * float(scale)  # scale is a static Python float here
+
+        @jax.jit
+        def f(x, cache=None, eps: float = 1e-6, use_flash: bool = False):
+            n = x.shape[0]
+            d = float(x.ndim + len(x.shape))
+            if cache is not None:
+                x = x + cache
+            if use_flash:
+                x = x * 2
+            return helper(x, 0.5) + n + d + float(eps)
+        """)
+    assert found == []
+
+
+def test_j001_negative_jax_numpy_aliased_as_np(tmp_path):
+    # regression: `import jax.numpy as np` rebinds the name — np.asarray
+    # is then a traced no-sync op, not host numpy
+    found = _scan(tmp_path, """
+        import jax
+        import jax.numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x) + 1
+        """)
+    assert found == []
+
+
+def test_j001_negative_subscript_index_stays_untainted(tmp_path):
+    # regression: `out[i] = jnp.sum(a)` taints the container `out`, not
+    # the host loop index `i` — `if last:` below is static control flow
+    found = _scan(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, n: int = 4):
+            out = {}
+            last = 0
+            for i in range(n):
+                out[i] = jnp.sum(x)
+                last = i
+            if last:
+                return out[0]
+            return out[0] * 2
+        """)
+    assert found == []
+
+
+# --------------------------------------------------------------------------- #
+# PICO-J002: host nondeterminism under trace
+# --------------------------------------------------------------------------- #
+
+
+def test_j002_time_and_np_random_under_trace(tmp_path):
+    found = _scan(tmp_path, """
+        import time
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            t = time.time()
+            r = np.random.rand()
+            return x + t + r
+        """)
+    assert _rules(found) == ["PICO-J002"]
+    assert len(found) == 2
+
+
+def test_j002_negative_host_code_and_jax_random(tmp_path):
+    found = _scan(tmp_path, """
+        import time
+        import jax
+        from jax import random
+
+        def host_loop():
+            return time.time()  # not traced: fine
+
+        @jax.jit
+        def f(x, key):
+            return x + random.normal(key, x.shape)  # jax.random: fine
+        """)
+    assert found == []
+
+
+def test_j002_through_dotted_import_with_package_init(tmp_path):
+    # regression: with pkg/__init__.py in the scan, `pkg` and
+    # `pkg.sub.mod` are BOTH scanned modules — `pkg.sub.mod.helper(x)`
+    # must resolve helper in the deepest one, not stall at `pkg` and
+    # drop the call-graph edge (hiding helper's trace-time hazard)
+    pkg = tmp_path / "pkg"
+    sub = pkg / "sub"
+    sub.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (sub / "__init__.py").write_text("")
+    (sub / "mod.py").write_text(textwrap.dedent("""
+        import time
+
+        def helper(x):
+            return x + time.time()
+        """))
+    main = tmp_path / "main.py"
+    main.write_text(textwrap.dedent("""
+        import jax
+        import pkg.sub.mod
+
+        @jax.jit
+        def f(x):
+            return pkg.sub.mod.helper(x)
+        """))
+    found = engine.run_suite(str(tmp_path), [
+        str(pkg / "__init__.py"), str(sub / "__init__.py"),
+        str(sub / "mod.py"), str(main)])
+    assert _rules(found) == ["PICO-J002"]
+    assert "time.time" in found[0].message
+
+
+# --------------------------------------------------------------------------- #
+# PICO-J003: pl.program_id inside a loop body
+# --------------------------------------------------------------------------- #
+
+
+def test_j003_program_id_inside_fori_loop_body(tmp_path):
+    found = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(o_ref):
+            def body(j, acc):
+                b = pl.program_id(0)  # the decode_attention.py trap
+                return acc + b
+            o_ref[0] = lax.fori_loop(0, 4, body, 0)
+        """)
+    assert _rules(found) == ["PICO-J003"]
+    assert "program_id" in found[0].message
+
+
+def test_j003_negative_read_before_the_loop(tmp_path):
+    # the fix PR 5 shipped: grid ids read once, the body closes over them
+    found = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(o_ref):
+            b = pl.program_id(0)
+
+            def body(j, acc):
+                return acc + b
+            o_ref[0] = lax.fori_loop(0, 4, body, 0)
+        """)
+    assert found == []
+
+
+def test_j003_lambda_body(tmp_path):
+    found = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(o_ref):
+            o_ref[0] = lax.fori_loop(
+                0, 4, lambda j, acc: acc + pl.program_id(0), 0)
+        """)
+    assert _rules(found) == ["PICO-J003"]
+
+
+# --------------------------------------------------------------------------- #
+# PICO-J004: jit/pallas_call constructed inside a loop
+# --------------------------------------------------------------------------- #
+
+
+def test_j004_jit_built_per_iteration(tmp_path):
+    found = _scan(tmp_path, """
+        import jax
+
+        def build(fns, x):
+            out = []
+            for fn in fns:
+                out.append(jax.jit(fn)(x))  # fresh callable every pass
+            return out
+        """)
+    assert _rules(found) == ["PICO-J004"]
+    assert "recompile" in found[0].message
+
+
+def test_j004_negative_jit_in_for_iterator_expression(tmp_path):
+    # regression: the iterator expression runs ONCE at loop setup —
+    # `for batch in loader_of(jax.jit(step)):` must not fire; a jit in
+    # a while TEST re-evaluates per pass and must
+    found = _scan(tmp_path, """
+        import jax
+
+        def loader_of(step):
+            return [step]
+
+        def run(step):
+            for batch in loader_of(jax.jit(step)):
+                batch()
+        """)
+    assert found == []
+    found = _scan(tmp_path, """
+        import jax
+
+        def run(step, x):
+            while jax.jit(step)(x):
+                x = x - 1
+        """)
+    assert _rules(found) == ["PICO-J004"]
+
+
+def test_j004_negative_hoisted_jit_and_def_in_loop(tmp_path):
+    found = _scan(tmp_path, """
+        import jax
+
+        def build(fns, xs):
+            jitted = [jax.jit(f) for f in fns]  # comprehension, not a loop stmt
+
+            def apply(x):
+                return jax.jit(step)(x)  # built per CALL, not per iteration
+
+            out = []
+            for x in xs:
+                out.append(jitted[0](x))
+            return out
+
+        def step(x):
+            return x
+        """)
+    assert found == []
+
+
+# --------------------------------------------------------------------------- #
+# PICO-C001: lock-order inversion
+# --------------------------------------------------------------------------- #
+
+_C001_FIXTURE = """
+    import threading
+
+    class Inverted:
+        def __init__(self):
+            self.a_mu = threading.Lock()
+            self.b_mu = threading.Lock()
+            self.x = 0
+
+        def one(self):
+            with self.a_mu:
+                with self.b_mu:
+                    self.x = 1
+
+        def two(self):
+            with self.b_mu:
+                with self.a_mu:
+                    self.x = 2
+    """
+
+
+def test_c001_lock_order_inversion(tmp_path):
+    found = _scan(tmp_path, _C001_FIXTURE)
+    assert _rules(found) == ["PICO-C001"]
+    assert len(found) == 1  # one inversion, reported once
+    assert "opposite" in found[0].message
+
+
+def test_c001_negative_consistent_order_and_transitive(tmp_path):
+    # same nesting everywhere — including through a same-class call — is
+    # a hierarchy, not an inversion
+    found = _scan(tmp_path, """
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self.a_mu = threading.Lock()
+                self.b_mu = threading.Lock()
+                self.x = 0
+
+            def one(self):
+                with self.a_mu:
+                    with self.b_mu:
+                        self.x = 1
+
+            def two(self):
+                with self.a_mu:
+                    self._locked_tail()
+
+            def _locked_tail(self):
+                with self.b_mu:
+                    self.x = 2
+        """)
+    assert found == []
+
+
+def test_c001_transitive_inversion_through_method_call(tmp_path):
+    # one path nests a->b lexically; the other holds b and CALLS a method
+    # that takes a — the deadlock picolint exists to catch (the PR 6
+    # _next_uid-under-_mu incident shape)
+    found = _scan(tmp_path, """
+        import threading
+
+        class Transitive:
+            def __init__(self):
+                self.a_mu = threading.Lock()
+                self.b_mu = threading.Lock()
+                self.x = 0
+
+            def one(self):
+                with self.a_mu:
+                    with self.b_mu:
+                        self.x = 1
+
+            def two(self):
+                with self.b_mu:
+                    self._take_a()
+
+            def _take_a(self):
+                with self.a_mu:
+                    self.x = 2
+        """)
+    assert "PICO-C001" in _rules(found)
+
+
+# --------------------------------------------------------------------------- #
+# PICO-C002: blocking call while holding a lock
+# --------------------------------------------------------------------------- #
+
+
+def test_c002_sleep_under_lock(tmp_path):
+    found = _scan(tmp_path, """
+        import threading
+        import time
+
+        class Sleeper:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def hold(self):
+                with self._mu:
+                    time.sleep(0.5)
+        """)
+    assert _rules(found) == ["PICO-C002"]
+    assert "time.sleep" in found[0].message
+
+
+def test_c002_blocking_io_and_join_under_lock(tmp_path):
+    found = _scan(tmp_path, """
+        import shutil
+        import threading
+
+        class Copier:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._worker = None
+
+            def hold(self, src, dst):
+                with self._mu:
+                    shutil.copytree(src, dst)
+                    self._worker.join()
+        """)
+    assert _rules(found) == ["PICO-C002"]
+    assert len(found) == 2
+
+
+def test_c002_negative_str_join_under_lock(tmp_path):
+    # regression: `sep.join(parts)` is string building (one iterable
+    # arg), not a thread join — `t.join(5)` (numeric timeout) still is
+    found = _scan(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.sep = ","
+                self.parts = []
+                self.worker = threading.Thread(target=self.render)
+
+            def render(self):
+                with self._mu:
+                    return self.sep.join(self.parts)
+
+            def stop(self):
+                with self._mu:
+                    self.worker.join(5)
+        """)
+    assert _rules(found) == ["PICO-C002"]
+    assert all("worker.join" in f.message for f in found)
+
+
+def test_c002_one_hop_propagation_and_negatives(tmp_path):
+    # sleep in a LOCK-FREE callee is fine alone, a hazard when the caller
+    # holds the lock across the call; str.join and os.path.join are not
+    # blocking calls
+    found = _scan(tmp_path, """
+        import os
+        import threading
+        import time
+
+        class Indirect:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def _backoff(self):
+                time.sleep(0.1)  # lock-free here: fine
+
+            def hold(self):
+                with self._mu:
+                    self._backoff()
+
+            def harmless(self, parts):
+                with self._mu:
+                    a = ",".join(str(p) for p in parts)
+                    return os.path.join(a, "x")
+        """)
+    assert _rules(found) == ["PICO-C002"]
+    assert len(found) == 1
+    assert "_backoff" in found[0].message
+
+
+# --------------------------------------------------------------------------- #
+# PICO-C003: guarded attribute mutated outside its lock
+# --------------------------------------------------------------------------- #
+
+_C003_FIXTURE = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self.count = 0
+
+        def locked_inc(self):
+            with self._mu:
+                self.count += 1
+
+        def unlocked_inc(self):
+            self.count += 1  # the serve.py rejections incident shape
+    """
+
+
+def test_c003_mutation_outside_the_guarding_lock(tmp_path):
+    found = _scan(tmp_path, _C003_FIXTURE)
+    assert _rules(found) == ["PICO-C003"]
+    assert found[0].context == "Counter.unlocked_inc"
+
+
+def test_c003_negatives_init_and_consistent_guarding(tmp_path):
+    # __init__ runs before any thread exists; queues/events are the
+    # sanctioned channels; consistently-guarded attrs are clean
+    found = _scan(tmp_path, """
+        import queue
+        import threading
+
+        class Clean:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.count = 0
+                self.inbox = queue.Queue()
+
+            def inc(self):
+                with self._mu:
+                    self.count += 1
+
+            def push(self, item):
+                self.inbox.put(item)
+        """)
+    assert found == []
+
+
+def test_c003_negative_thread_starting_method_is_exempt(tmp_path):
+    # regression: writes in the method that STARTS the worker thread
+    # happen-before Thread.start, same as __init__ (module docstring
+    # contract) — resetting state there needs no lock
+    found = _scan(tmp_path, """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                self.count = 0
+                threading.Thread(target=self._worker, daemon=True).start()
+
+            def _worker(self):
+                with self._mu:
+                    self.count += 1
+        """)
+    assert found == []
+
+
+# --------------------------------------------------------------------------- #
+# PICO-C004: cross-thread mutation with no lock anywhere
+# --------------------------------------------------------------------------- #
+
+
+def test_c004_worker_and_foreground_mutate_unlocked(tmp_path):
+    found = _scan(tmp_path, """
+        import threading
+
+        class Mirror:
+            def __init__(self):
+                self.errs = []
+
+            def start(self):
+                threading.Thread(target=self._worker, daemon=True).start()
+
+            def _worker(self):
+                self.errs.append("boom")  # the checkpoint.py incident shape
+
+            def drain(self):
+                out, self.errs = self.errs, []
+                return out
+        """)
+    assert _rules(found) == ["PICO-C004"]
+    assert "_worker" in found[0].context
+
+
+def test_c004_negative_lock_on_both_sides(tmp_path):
+    found = _scan(tmp_path, """
+        import threading
+
+        class Guarded:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.errs = []
+
+            def start(self):
+                threading.Thread(target=self._worker, daemon=True).start()
+
+            def _worker(self):
+                with self._mu:
+                    self.errs.append("boom")
+
+            def drain(self):
+                with self._mu:
+                    out, self.errs = self.errs, []
+                return out
+        """)
+    assert found == []
+
+
+# --------------------------------------------------------------------------- #
+# suppression comments
+# --------------------------------------------------------------------------- #
+
+
+def test_suppression_on_the_flagged_line(tmp_path):
+    found = _scan(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)  # picolint: disable=PICO-J001
+        """)
+    assert found == []
+
+
+def test_suppression_bare_suffix_and_file_scope(tmp_path):
+    found = _scan(tmp_path, """
+        # picolint: disable-file=C002
+        import threading
+        import time
+
+        class Sleeper:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def hold(self):
+                with self._mu:
+                    time.sleep(0.5)
+        """)
+    assert found == []
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    # disabling one rule must not swallow another rule's finding there
+    found = _scan(tmp_path, """
+        import jax
+        import time
+
+        @jax.jit
+        def f(x):
+            t = time.time() + float(x)  # picolint: disable=PICO-J002
+            return t
+        """)
+    assert _rules(found) == ["PICO-J001"]
+
+
+def test_suppression_parsing_and_rule_validation():
+    sup = Suppressions.parse(
+        "x = 1  # picolint: disable=J001, PICO-C002\n"
+        "# picolint: disable-file=all\n")
+    assert sup.by_line[1] == {"PICO-J001", "PICO-C002"}
+    assert sup.whole_file == {"*"}
+    assert validate_rule_ids(["PICO-J001", "*"]) is None
+    assert validate_rule_ids(["PICO-J001", "PICO-Z999"]) == "PICO-Z999"
+
+
+# --------------------------------------------------------------------------- #
+# baseline workflow
+# --------------------------------------------------------------------------- #
+
+
+def _write_baseline(path, entries):
+    path.write_text(json.dumps({"findings": entries}, indent=2))
+
+
+def test_baseline_matches_by_fingerprint_not_line(tmp_path):
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+        """
+    found = _scan(tmp_path, src)
+    assert len(found) == 1
+    bl = tmp_path / "baseline.json"
+    _write_baseline(bl, [engine.baseline_entry(
+        found[0], reason="fixture: demonstrating the baseline contract")])
+
+    # line drift above the finding does not re-open it
+    drifted = "# a new leading comment\n# another\n" + textwrap.dedent(src)
+    (tmp_path / "fix_mod.py").write_text(drifted)
+    out = engine.run(str(tmp_path), [str(tmp_path / "fix_mod.py")],
+                     baseline_path=str(bl))
+    assert out["counts"] == {"total": 1, "new": 0, "baselined": 1,
+                             "stale_baseline": 0}
+
+    # editing the FLAGGED line re-opens the finding and stales the entry
+    edited = drifted.replace("float(x)", "float(x * 2)")
+    (tmp_path / "fix_mod.py").write_text(edited)
+    out = engine.run(str(tmp_path), [str(tmp_path / "fix_mod.py")],
+                     baseline_path=str(bl))
+    assert out["counts"]["new"] == 1
+    assert out["counts"]["stale_baseline"] == 1
+
+
+def test_baseline_undocumented_reasons_are_rejected():
+    entries = [
+        {"rule": "PICO-J001", "path": "a.py", "context": "f",
+         "snippet": "x", "reason": "identity test on a static optional"},
+        {"rule": "PICO-J001", "path": "b.py", "context": "g",
+         "snippet": "y", "reason": ""},
+        {"rule": "PICO-J001", "path": "c.py", "context": "h",
+         "snippet": "z", "reason": "TODO: document why"},
+    ]
+    bad = engine.undocumented_entries(entries)
+    assert [e["path"] for e in bad] == ["b.py", "c.py"]
+
+
+def test_baseline_duplicate_fingerprints_are_counted(tmp_path):
+    # two identical findings against ONE baseline entry: one stays new
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x, flip=None):
+            if flip is None:
+                return float(x)
+            return float(x)
+        """
+    found = _scan(tmp_path, src)
+    assert len(found) == 2
+    assert found[0].fingerprint() == found[1].fingerprint()
+    new, matched, stale = engine.diff_baseline(
+        found, [engine.baseline_entry(found[0], reason="fixture")])
+    assert len(new) == 1 and len(matched) == 1 and stale == []
+
+
+# --------------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------------- #
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+        """))
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f(x):\n    return x\n")
+    bl = str(tmp_path / "baseline.json")
+
+    assert lint.main([str(bad), "--baseline", bl]) == 1
+    capsys.readouterr()
+    assert lint.main([str(clean), "--baseline", bl]) == 0
+    capsys.readouterr()
+    assert lint.main([str(bad), "--baseline", bl,
+                      "--no-fail-on-new"]) == 0
+    capsys.readouterr()
+
+    assert lint.main([str(bad), "--baseline", bl, "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["tool"] == "picolint"
+    assert report["counts"]["new"] == 1
+    assert report["new"][0]["rule"] == "PICO-J001"
+    assert set(report["rules"]) == set(RULES)
+
+    assert lint.main(["--rules", "PICO-NOPE"]) == 2
+    assert lint.main([str(tmp_path / "missing.py")]) == 2
+
+
+def test_cli_rules_narrows_report_not_the_gate(tmp_path, capsys):
+    # regression: --rules filters what is PRINTED; the exit-code gate
+    # still fails on new findings from every other rule
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+        """))
+    bl = str(tmp_path / "baseline.json")
+    assert lint.main([str(bad), "--baseline", bl,
+                      "--rules", "PICO-C002", "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["counts"]["new"] == 0  # J001 hidden from the report...
+    # ...but the run still failed, so --rules cannot launder a finding
+
+
+def test_cli_baselined_count_uses_the_budget_split(tmp_path, capsys):
+    # two findings with the SAME fingerprint (same snippet text +
+    # context, different lines) against one baseline entry: the CLI
+    # report must carry diff_baseline's budget split through — exactly
+    # one baselined, one new — not re-derive matched on its own
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = float(x)
+            y = float(x)
+            return y
+        """))
+    bl = tmp_path / "baseline.json"
+    findings = engine.run_suite(str(tmp_path), [str(bad)])
+    assert len(findings) == 2
+    assert findings[0].fingerprint() == findings[1].fingerprint()
+    bl.write_text(json.dumps(
+        {"findings": [engine.baseline_entry(
+            findings[0], reason="fixture: one of the two is baselined")]}))
+    assert lint.main([str(bad), "--baseline", str(bl), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["counts"]["baselined"] == 1
+    assert report["counts"]["new"] == 1
+
+
+def test_cli_malformed_baseline_is_a_usage_error(tmp_path, capsys):
+    # regression: a baseline object without "findings" must exit 2 with
+    # a descriptive message, not crash with a raw KeyError
+    bl = tmp_path / "baseline.json"
+    bl.write_text('{"entries": []}')
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f(x):\n    return x\n")
+    assert lint.main([str(clean), "--baseline", str(bl)]) == 2
+    assert "findings" in capsys.readouterr().err
+
+
+def test_cli_root_is_stable_across_invocation_shapes(tmp_path, capsys):
+    # regression: out-of-repo, `lint proj` and `lint proj/bad.py` must
+    # report the same file under the same relative path — fingerprints
+    # (and so baselines) would otherwise churn with the invocation shape
+    proj = tmp_path / "proj"
+    (proj / "pkg").mkdir(parents=True)
+    (proj / "pkg" / "other.py").write_text("def g(x):\n    return x\n")
+    (proj / "bad.py").write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+        """))
+    bl = str(tmp_path / "baseline.json")
+    paths = []
+    for spec in ([str(proj)], [str(proj / "bad.py")]):
+        assert lint.main(spec + ["--baseline", bl, "--json"]) == 1
+        report = json.loads(capsys.readouterr().out)
+        paths.append(report["new"][0]["path"])
+    assert paths[0] == paths[1] == "bad.py"
+
+
+def test_cli_partial_scan_does_not_stale_out_of_scope_entries(tmp_path,
+                                                              capsys):
+    # regression: a baseline entry for a file the scan did not cover is
+    # not evidence the entry is dead — only a scan that includes the
+    # file may call it stale
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+        """))
+    other = tmp_path / "other.py"
+    other.write_text("def g(x):\n    return x\n")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": [{
+        "rule": "PICO-C002", "path": "other.py", "context": "X.m",
+        "snippet": "time.sleep(1)",
+        "reason": "fixture: documented entry for an unscanned file"}]}))
+    assert lint.main([str(bad), "--baseline", str(bl), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["counts"]["stale_baseline"] == 0  # other.py not scanned
+    assert lint.main([str(bad), str(other), "--baseline", str(bl),
+                      "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["counts"]["stale_baseline"] == 1  # scanned and clean
+
+
+def test_cli_rules_canonicalize_like_suppressions(tmp_path, capsys):
+    # regression: `--rules j001` spells the same as a suppression
+    # comment; `--rules '*'` means every rule, not an empty report
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+        """))
+    bl = str(tmp_path / "baseline.json")
+    assert lint.main([str(bad), "--baseline", bl,
+                      "--rules", "j001", "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["counts"]["new"] == 1
+    assert lint.main([str(bad), "--baseline", bl,
+                      "--rules", "*", "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["counts"]["new"] == 1
+
+
+def test_cli_empty_scope_scans_nothing(tmp_path, capsys):
+    # regression: a directory with no .py files must scan ZERO files,
+    # not silently fall back to the whole repo
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    (empty / "README.txt").write_text("no python here")
+    assert lint.main([str(empty), "--baseline",
+                      str(tmp_path / "baseline.json"), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["counts"]["total"] == 0
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+        """))
+    bl = tmp_path / "baseline.json"
+    # --write-baseline records the finding (exit 0) with a placeholder
+    # reason that the documentation gate then rejects until filled in
+    assert lint.main([str(bad), "--baseline", str(bl),
+                      "--write-baseline"]) == 0
+    capsys.readouterr()
+    entries = engine.load_baseline(str(bl))
+    assert len(entries) == 1
+    assert engine.undocumented_entries(entries) == entries
+    # once baselined, the same scan is clean
+    assert lint.main([str(bad), "--baseline", str(bl)]) == 0
+
+
+# --------------------------------------------------------------------------- #
+# the tier-1 gate: the repo's own tree is clean
+# --------------------------------------------------------------------------- #
+
+
+def test_seeded_hazards_each_caught_by_exactly_their_rule(tmp_path):
+    """The acceptance fixtures from ISSUE 9, one rule each."""
+    cases = {
+        "PICO-J003": """
+            from jax import lax
+            from jax.experimental import pallas as pl
+
+            def kernel(o_ref):
+                def body(j, acc):
+                    return acc + pl.program_id(0)
+                o_ref[0] = lax.fori_loop(0, 4, body, 0)
+            """,
+        "PICO-J001": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+            """,
+        "PICO-C001": _C001_FIXTURE,
+        "PICO-C002": """
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def hold(self):
+                    with self._mu:
+                        time.sleep(1.0)
+            """,
+    }
+    for rule, src in cases.items():
+        found = _scan(tmp_path, src, name=f"{rule.lower().replace('-', '_')}.py")
+        assert _rules(found) == [rule], (
+            f"seeded {rule} fixture found {_rules(found)}")
+
+
+def test_repo_self_scan_is_clean_against_baseline():
+    """Every future PR is gated on this: the package has no new findings,
+    no stale baseline entries, every baseline entry documents WHY it is a
+    false positive, and the scan fits the <30s budget."""
+    root, files = lint._scan_spec([])
+    out = engine.run(root, files)
+    assert not out["_new"], "new picolint findings:\n" + "\n".join(
+        f.render() for f in out["_new"])
+    assert not out["_stale"], (
+        "stale baseline entries (the finding no longer fires — remove "
+        f"them): {out['_stale']}")
+    bad = engine.undocumented_entries(out["_baseline"])
+    assert not bad, f"baseline entries without a documented reason: {bad}"
+    assert out["elapsed_s"] < 30
+
+
+def test_cli_default_scan_exits_zero():
+    """`python -m picotron_tpu.tools.lint` — the `make lint` contract."""
+    assert lint.main(["--json"]) == 0
+
+
+def test_rule_catalog_is_stable():
+    """Rule IDs are API (baselines, suppressions, docs cross-links):
+    removing or renaming one breaks every consumer."""
+    assert set(RULES) == {
+        "PICO-J001", "PICO-J002", "PICO-J003", "PICO-J004",
+        "PICO-C001", "PICO-C002", "PICO-C003", "PICO-C004"}
+    for rule in RULES.values():
+        assert rule.title and rule.rationale

@@ -216,6 +216,43 @@ def test_mirror_worker_survives_warnings_as_errors(tmp_path):
         mgr.close()                  # the join re-surfaces it to readers
 
 
+def test_mirror_error_list_is_lock_guarded(tmp_path):
+    """``_mirror_errs`` is appended by the worker thread and swapped out
+    by ``_join_mirror`` from reader/emergency-save threads (picolint
+    PICO-C004: there was no ordering between the two at all). Both sides
+    must go through ``_mirror_mu``: an instrumented lock proves the
+    record and the swap each take it, the retention cap holds, and a
+    join surfaces every recorded error exactly once."""
+    import queue
+    import threading
+
+    mgr = ckpt.CheckpointManager(str(tmp_path / "c"), io_attempts=1,
+                                 mirror_dir=str(tmp_path / "m"))
+    real = mgr._mirror_mu
+    acquisitions = []
+
+    class _Spy:
+        def __enter__(self):
+            acquisitions.append(threading.current_thread().name)
+            return real.__enter__()
+
+        def __exit__(self, *a):
+            return real.__exit__(*a)
+
+    mgr._mirror_mu = _Spy()
+    for i in range(10):
+        mgr._record_mirror_err(RuntimeError(f"boom{i}"))
+    assert len(acquisitions) == 10
+    assert len(mgr._mirror_errs) == 8      # bounded retention
+    mgr._mirror_q = queue.Queue()          # join path, no live worker
+    with pytest.warns(RuntimeWarning, match="boom0"):
+        mgr._join_mirror()
+    assert len(acquisitions) == 11         # the swap held the lock too
+    assert mgr._mirror_errs == []          # drained exactly once
+    mgr._join_mirror()                     # nothing left to re-surface
+    mgr.close()
+
+
 def test_mirror_through_train_entry(tiny_model_kwargs, tmp_path):
     """The config key wires through train(): a run with ckpt_mirror_dir
     replicates every periodic save, and a resume whose primary is fully

@@ -439,6 +439,89 @@ def test_oversized_budget_is_window_capped_not_rejected():
         srv.drain_and_join(timeout=60)
 
 
+def test_stalled_rejections_count_under_their_own_lock():
+    """The "stalled" rejection fires exactly when ``_mu`` could NOT be
+    acquired, so the counter cannot be guarded by ``_mu`` — a dedicated
+    leaf lock (``_rej_mu``) guards every increment (picolint PICO-C003:
+    concurrent timed-out handlers were doing an unlocked read-modify-
+    write and losing updates). N handlers shedding concurrently against
+    a wedged dispatch must count exactly N."""
+    cfg, engine, params = _engine(slots=1)
+    front = serve.FrontEnd(engine, params, log=lambda *a, **k: None)
+
+    class _Wedged:  # a dispatch holding _mu forever: timed acquires fail
+        def acquire(self, timeout=None):
+            return False
+
+        def release(self):
+            raise AssertionError("never acquired")
+
+    front._mu = _Wedged()
+    n, statuses = 16, []
+
+    def handler():
+        try:
+            front.submit({"prompt": [1, 2], "max_new_tokens": 2})
+        except serve.AdmissionError as e:
+            statuses.append(e.status)
+
+    threads = [threading.Thread(target=handler) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert statuses == [503] * n
+    assert front.rejections["stalled"] == n
+    # stats() snapshots the counters under the same leaf lock (and takes
+    # the degraded no-_mu path here, like an operator mid-stall)
+    assert front.stats()["rejected"]["stalled"] == n
+
+
+def test_waiter_maps_only_mutated_under_mu():
+    """``_deliver`` pops ``_req_t``/``_waiters`` under ``_mu`` (picolint
+    PICO-C003): the dispatch thread used to pop them unlocked while
+    handler threads insert them — and check duplicate uids against them
+    — under the lock. Guarded dicts assert the lock is held at every
+    mutation; an unlocked pop kills the dispatch loop, which the
+    result/dead checks surface."""
+    cfg, engine, params = _engine(slots=2)
+    front = serve.FrontEnd(engine, params, log=lambda *a, **k: None)
+
+    class _Guarded(dict):
+        def __init__(self, lock):
+            super().__init__()
+            self._lock = lock
+
+        def __setitem__(self, k, v):
+            assert self._lock.locked(), "waiter-map mutation outside _mu"
+            dict.__setitem__(self, k, v)
+
+        def pop(self, *a):
+            assert self._lock.locked(), "waiter-map mutation outside _mu"
+            return dict.pop(self, *a)
+
+    front._waiters = _Guarded(front._mu)
+    front._req_t = _Guarded(front._mu)
+    front.start()
+    try:
+        _, waiter = front.submit({"prompt": [1, 2, 3],
+                                  "max_new_tokens": 4})
+        toks, res = [], None
+        while res is None:
+            kind, payload = waiter.events.get(timeout=30)
+            if kind == "done":
+                res = payload
+            else:
+                toks.append(payload)
+        assert res.finish_reason == "length" and res.tokens == toks
+        assert len(res.tokens) == 4
+        assert not front.dead
+    finally:
+        front.begin_drain()
+        front.join(timeout=30)
+    assert not front._waiters and not front._req_t
+
+
 def test_http_rejects_zero_budget_and_oversized_bodies():
     """max_new_tokens < 1 is a 400 at the door (a zero-budget request
     would hold a slot forever — no token ever completes it — and a
