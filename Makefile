@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke obs-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -19,6 +19,7 @@ test: native lint
 
 test-all: native lint
 	python -m pytest tests/ -x -q
+	$(MAKE) obs-smoke
 
 # picolint static analysis (picotron_tpu/analysis/, docs/ANALYSIS.md):
 # JAX hot-path rules (host syncs on traced values, trace-time
@@ -116,6 +117,20 @@ chaos-pod-smoke:
 # accounts. Exits nonzero on any malfunction.
 serve-smoke:
 	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.serve --smoke
+
+# Observability smoke (picotron_tpu/obs, docs/OBSERVABILITY.md): the
+# serve smoke drive with its telemetry checks — /metrics agreeing with
+# /statz, a timed /profilez capture — saving the drive's /tracez JSON,
+# then tools/trace_dump.py re-validates the saved trace from scratch and
+# requires a COMPLETE parented request chain (queue_wait -> prefill ->
+# every dispatch -> delivery). Runs inside `make test-all`.
+OBS_SMOKE_DIR := /tmp/picotron-obs-smoke
+obs-smoke:
+	rm -rf $(OBS_SMOKE_DIR)
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.serve --smoke \
+	  --obs-dump $(OBS_SMOKE_DIR)
+	python -m picotron_tpu.tools.trace_dump $(OBS_SMOKE_DIR)/trace.json \
+	  --require-request-chain
 
 # Serving chaos suite (tests/test_serving.py): dispatch-exception,
 # latency-spike, and poisoned-logits faults through the engine hooks —

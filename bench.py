@@ -21,6 +21,14 @@ import time
 import jax
 
 from picotron_tpu.bench_record import BENCH_METRICS, iter_metric_records
+from picotron_tpu.obs.metrics import MetricsRegistry
+
+# the last COMPLETED run's registry summary (picotron_tpu/obs): run()
+# times each call into a FRESH registry and publishes the snapshot here
+# only when the run finishes, so the final JSON's "obs" blob describes
+# exactly the run whose number it reports — OOM'd/descended sizes and a
+# losing flash-layout A/B leg never pollute it
+LAST_RUN_OBS: dict = {}
 
 
 def smollm_cfg(mbs: int, seq: int, on_tpu: bool, remat: str = "full"):
@@ -61,13 +69,22 @@ def run(cfg, calls=4, warmup=1, steps_per_call=16):
         [next(loader) for _ in range(steps_per_call)], topo)
 
     times = []
+    reg = MetricsRegistry()
+    call_hist = reg.histogram(
+        "bench_step_call_seconds",
+        f"one timed call ({steps_per_call} fused optimizer steps)")
     for _ in range(calls):
         t0 = time.perf_counter()
         params, opt_state, losses = step(params, opt_state, tokens, targets)
         jax.block_until_ready(losses)
         times.append(time.perf_counter() - t0)
+        call_hist.observe(times[-1])
     assert jax.numpy.isfinite(losses).all(), f"loss diverged: {losses}"
     mean_t = sum(times[warmup:]) / len(times[warmup:])
+    # publish only on completion — an aborted run's partial timings die
+    # with its local registry
+    LAST_RUN_OBS.clear()
+    LAST_RUN_OBS.update(reg.summary())
     return steps_per_call * cfg.tokens_per_step / mean_t
 
 
@@ -323,6 +340,7 @@ def try_flash_layout_ab(cfg, tok_s_folded, **run_kw):
     alt = "merged" if cfg.model.head_dim % LANE == 0 else "bshd"
     cfg2 = copy.deepcopy(cfg)
     cfg2.model.flash_layout = alt
+    folded_obs = dict(LAST_RUN_OBS)  # the winning folded run's snapshot
     jax.clear_caches()
     gc.collect()
     try:
@@ -339,6 +357,10 @@ def try_flash_layout_ab(cfg, tok_s_folded, **run_kw):
         return cfg2, tok_s
     print(f"# flash_layout={alt} slower: {tok_s:.0f} vs {tok_s_folded:.0f} "
           f"tok/s; keeping folded", file=sys.stderr)
+    # the published number is the folded run's — restore its obs snapshot
+    # over the losing alt leg's
+    LAST_RUN_OBS.clear()
+    LAST_RUN_OBS.update(folded_obs)
     return cfg, tok_s_folded
 
 
@@ -686,13 +708,15 @@ def inner_main():
     if peak is None:  # CPU: report raw throughput, no MFU baseline claim
         print(json.dumps({"metric": "tokens_per_sec_cpu_smoke",
                           "value": round(tok_s, 1), "unit": "tokens/s",
-                          "vs_baseline": 0.0}))
+                          "vs_baseline": 0.0,
+                          "obs": dict(LAST_RUN_OBS)}))
         return
     mfu = get_mfu(tok_s, n_params, m.num_hidden_layers, m.hidden_size,
                   cfg.training.seq_length, peak)
     print(json.dumps({"metric": BENCH_METRICS["bench"],
                       "value": round(mfu, 2), "unit": "%",
-                      "vs_baseline": round(mfu / 50.0, 3)}))
+                      "vs_baseline": round(mfu / 50.0, 3),
+                      "obs": dict(LAST_RUN_OBS)}))
     print(f"# mbs={cfg.training.micro_batch_size} seq={cfg.training.seq_length} "
           f"remat={cfg.training.remat} flash={cfg.model.flash_layout} "
           f"tokens/s/chip={tok_s:.0f} "

@@ -180,9 +180,13 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
         t0 = time.perf_counter()
         for _ in range(steps):
             key, sub = jax.random.split(key)
+            td = time.perf_counter()
             cache, toks, _ = engine.decode_step(params, cache, toks, sub,
                                                 temp, top_k, top_p)
             toks = np.asarray(toks)  # the host feedback every real server pays
+            # per-dispatch wall (incl. the sync above) into the registry
+            # histogram the JSON record snapshots
+            engine.observe_dispatch("decode", time.perf_counter() - td)
         dt = time.perf_counter() - t0
         dispatches = steps
         last = toks
@@ -195,10 +199,12 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
                 key, sub = jax.random.split(key)
                 subs.append(np.asarray(sub))
             budget = np.full(slots, block_len, np.int32)
+            td = time.perf_counter()
             cache, out, counts = engine.decode_block(
                 params, cache, toks, np.stack(subs), eos, budget,
                 temp, top_k, top_p)
             out = np.asarray(out)  # one host sync per block, not per token
+            engine.observe_dispatch("decode", time.perf_counter() - td)
             assert np.all(np.asarray(counts) == block_len)
             return cache, out[:, -1], key
 
@@ -274,10 +280,12 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
             tokens[s, 0] = toks[s]
             tokens[s, 1:] = drafter.propose(hist[s], spec_len)
         key, sub = jax.random.split(key)
+        td = time.perf_counter()
         cache, emitted, counts, accepted = engine.verify(
             params, cache, tokens, sub, eos, budget, temp, top_k, top_p)
         emitted = np.asarray(emitted)  # ONE host sync per dispatch
         counts = np.asarray(counts)
+        engine.observe_dispatch("verify", time.perf_counter() - td)
         for s in np.flatnonzero(counts):
             hist[s].extend(int(t) for t in emitted[s, : counts[s]])
             toks[s] = emitted[s, counts[s] - 1]
@@ -421,6 +429,7 @@ def main(argv=None) -> None:
               # kv_bytes/attend_impl deltas are layout facts and hold
               # either way; tokens/s only means hardware when validated
               "validated": tpu}
+    reg = engine.obs.registry
     if engine.paged is not None:
         # capacity story next to the bytes story: pool occupancy at the
         # end of the timed window + prefix-cache effectiveness (the bench
@@ -433,11 +442,21 @@ def main(argv=None) -> None:
             kv_pages_live=p["kv_pages_live"],
             kv_pool_utilization=p["kv_pool_utilization"],
             prefix_hit_rate=p["prefix_hit_rate"])
+        # ...and into the registry, so the obs snapshot below is complete
+        reg.gauge("picotron_kv_pool_utilization").set(
+            p["kv_pool_utilization"])
+        reg.gauge("picotron_prefix_hit_rate").set(
+            p["prefix_hit_rate"] or 0.0)
     if not tpu:
         record["preflight"] = preflight_note
     if args.spec_len > 0:
         record["spec_len"] = args.spec_len
         record["accept_rate"] = round(accept, 4)
+        reg.gauge("picotron_accept_rate").set(accept)
+    # the engine registry's compact snapshot (dispatch count/latency
+    # histograms, pool/accept gauges) rides along — one structured blob
+    # instead of growing the hand-picked field list forever
+    record["obs"] = reg.summary()
     print(json.dumps(record))
 
 

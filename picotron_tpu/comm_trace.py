@@ -8,7 +8,11 @@ jit executes exactly as traced, so logging each collective once at trace
 time (op, mesh axis, shape, dtype) reproduces the information content of the
 reference's per-call prints without a host callback in the hot path.
 
-- ``PICOTRON_VERBOSE=1``: one stderr line per collective per trace.
+- ``PICOTRON_VERBOSE=1``: one stderr line per collective per trace, and
+  one instant event (``comm/<op>``) in the process span ring
+  (picotron_tpu/obs) — so a Chrome-trace dump (``obs.trace_path``,
+  ``GET /tracez``, ``tools/trace_dump.py``) shows which collectives each
+  traced program carries alongside the step/request spans.
 - ``PICOTRON_VERBOSE=2``: additionally injects ``jax.debug.print`` so every
   *execution* logs the op tag (slow — debugging only; runs per device under
   shard_map, the closest analogue of the reference's per-rank prints).
@@ -50,6 +54,14 @@ def log(op: str, axis, x, extra: str = ""):
     if extra:
         msg += f" {extra}"
     print(msg, file=sys.stderr)
+    # the same record, structured: an instant event in the process span
+    # ring (this runs at TRACE time, host-side — never inside compiled
+    # code, so the wall clock here is legal)
+    from picotron_tpu.obs import GLOBAL_TRACER
+
+    GLOBAL_TRACER.instant(f"comm/{op}", axis=str(axis), shape=str(shape),
+                          dtype=str(dtype),
+                          **({"extra": extra} if extra else {}))
     if lvl >= 2:
         import jax
 

@@ -413,6 +413,39 @@ class InferenceConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Observability knobs (picotron_tpu/obs/, docs/OBSERVABILITY.md).
+    The default is ON: recording counters/spans costs nanoseconds per
+    event and never touches stdout, so smoke output is unchanged either
+    way; ``enabled: false`` swaps in null instruments for a zero-
+    bookkeeping hot path. Scope: the switch governs the engine/batcher/
+    serve/train instruments built from THIS config; ``comm_trace``'s
+    per-collective instant spans are debug output gated by
+    ``PICOTRON_VERBOSE>=1`` alone (off by default, and already paying a
+    stderr line per collective when on)."""
+
+    enabled: bool = True
+    # Finished spans the process trace ring retains (oldest dropped).
+    span_ring: int = 4096
+    # Raw samples each histogram keeps for exact /statz percentiles.
+    sample_window: int = 4096
+    # Per-step training metrics JSONL path ("" = off). The supervisor/
+    # scheduler export $PICOTRON_METRICS_JSONL next to the run log, which
+    # wins over this field — same precedence as the heartbeat path.
+    # Controller process only; extract_metrics.py prefers this file over
+    # regex-scraping the log.
+    metrics_jsonl: str = ""
+    # Chrome-trace JSON dumped from the span ring when train() exits
+    # ("" = off). Validate/inspect with tools/trace_dump.py.
+    trace_path: str = ""
+    # On-demand profiler captures (SIGUSR2 on the CLIs, POST /profilez on
+    # the serving front end): jax.profiler traces land here, each capture
+    # timed at profile_seconds.
+    profile_dir: str = "profiles"
+    profile_seconds: float = 5.0
+
+
+@dataclass
 class LoggingConfig:
     use_wandb: bool = False
     run_name: str = "picotron-tpu"
@@ -445,6 +478,7 @@ class Config:
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     @property
     def world_size(self) -> int:
@@ -742,6 +776,13 @@ class Config:
                 "chaos_dispatch_fail_slot must be >= -1 (-1 = off)")
         if r.chaos_latency_s < 0:
             raise ValueError("chaos_latency_s must be >= 0")
+        o = self.obs
+        if o.span_ring < 1:
+            raise ValueError("obs.span_ring must be >= 1")
+        if o.sample_window < 1:
+            raise ValueError("obs.sample_window must be >= 1")
+        if o.profile_seconds <= 0:
+            raise ValueError("obs.profile_seconds must be > 0")
         if chaos_on and t.steps_per_call != 1:
             # chaos fires at exact host-visible step boundaries (and NaN
             # injection swaps in a poisoned single-step program for exactly
@@ -775,6 +816,7 @@ class Config:
             logging=build(LoggingConfig, raw.get("logging", {})),
             resilience=build(ResilienceConfig, raw.get("resilience", {})),
             inference=build(InferenceConfig, raw.get("inference", {})),
+            obs=build(ObsConfig, raw.get("obs", {})),
         )
         cfg.validate()
         return cfg

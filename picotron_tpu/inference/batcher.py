@@ -63,6 +63,20 @@ never a server death. ``finish()`` accounting is tracked in ``counters``
 (admitted/completed/expired/errored/shed) with queue-wait and
 time-to-first-token samples surfaced by ``stats()`` — the ``/statz``
 payload of tools/serve.py.
+
+**Telemetry** (picotron_tpu/obs, docs/OBSERVABILITY.md): the batcher
+records into the ENGINE's metrics registry — ``counters`` is a
+``CounterDict`` view over ``picotron_requests_total{state}``, the
+queue-wait/TTFT percentile windows live in registry histograms (the same
+instruments ``GET /metrics`` renders, so ``/statz`` and Prometheus can
+never disagree), and every dispatch's wall/host-sync time lands in
+``picotron_dispatch_seconds{kind}``. Spans make one request traceable
+end-to-end: a ``request`` root opens at submit; ``queue_wait``,
+``prefill`` (radix-hit/dispatch counts), one ``decode``/``verify`` child
+per dispatch round (draft len, accepted, host-sync time), and the serve
+front end's ``delivery`` all parent to it — ``GET /tracez`` or
+``tools/trace_dump.py`` shows the chain. ``obs.enabled: false`` swaps
+all of it for no-ops.
 """
 
 from __future__ import annotations
@@ -78,6 +92,11 @@ import numpy as np
 from picotron_tpu.inference import sampling
 from picotron_tpu.resilience.retry import retry
 from picotron_tpu.utils import log0
+
+
+def _sid(span) -> Optional[int]:
+    """A span's exportable id (None for no span / the null span's 0)."""
+    return span.span_id or None if span is not None else None
 
 
 def _log_dispatch_failure(kind: str, ident, e: BaseException) -> None:
@@ -113,6 +132,10 @@ class GenerationResult:
     finish_reason: str
     queue_wait_s: Optional[float] = None  # submit -> admit (None: never admitted)
     ttft_s: Optional[float] = None  # submit -> first token
+    # the request's root span in the process trace ring (None with obs
+    # off): late children — the serve front end's delivery span — parent
+    # onto it after the batcher has already retired the slot
+    span_id: Optional[int] = None
 
 
 @dataclass
@@ -123,16 +146,6 @@ class _Slot:
     submit_t: Optional[float] = None  # clock() at submit (stats)
     queue_wait_s: Optional[float] = None
     ttft_s: Optional[float] = None
-
-
-def _percentiles(samples: list) -> Optional[dict]:
-    """{p50, p95, p99, n} of a sample list (seconds), or None when empty."""
-    if not samples:
-        return None
-    a = np.asarray(samples, np.float64)
-    p50, p95, p99 = np.percentile(a, [50, 95, 99])
-    return {"p50": float(p50), "p95": float(p95), "p99": float(p99),
-            "n": int(a.size)}
 
 
 class ContinuousBatcher:
@@ -149,10 +162,15 @@ class ContinuousBatcher:
     """
 
     def __init__(self, engine, params, seed: int = 0, clock=time.monotonic,
-                 drafter=None, on_token: Optional[Callable] = None):
+                 drafter=None, on_token: Optional[Callable] = None,
+                 obs=None):
         self.engine = engine
         self.params = params
         self._clock = clock  # injectable so deadline tests are deterministic
+        # telemetry rides on the engine's bundle unless injected: one
+        # registry (and the process span ring) covers engine + batcher +
+        # front end, so /metrics is a single coherent page
+        self.obs = obs if obs is not None else engine.obs
         self._key = jax.random.PRNGKey(seed)
         # streaming hook: called as on_token(uid, token) for every token a
         # request emits, from inside step()/run() — the serve front end
@@ -186,12 +204,32 @@ class ContinuousBatcher:
         # request accounting: every submitted request lands in exactly one
         # terminal counter (completed = eos|length, expired = timeout,
         # errored = dispatch failure, shed = dropped unstarted) — the
-        # serve-chaos acceptance sums these against submissions
-        self.counters = {"admitted": 0, "completed": 0, "expired": 0,
-                         "errored": 0, "shed": 0}
+        # serve-chaos acceptance sums these against submissions. A
+        # CounterDict: plain-dict reads/compares, writes mirrored into
+        # the registry as picotron_requests_total{state}.
+        reg = self.obs.registry
+        self.counters = reg.counter_dict(
+            "picotron_requests_total",
+            ("admitted", "completed", "expired", "errored", "shed"),
+            help="request accounting by terminal state (+ admitted)")
         self._submit_t: dict = {}  # uid -> clock() at submit
-        self._queue_waits: list = []  # submit -> admit samples (seconds)
-        self._ttfts: list = []  # submit -> first-token samples (seconds)
+        # latency windows (the /statz percentile payloads AND the
+        # /metrics histograms — one instrument, two renderings)
+        self._queue_wait_hist = reg.histogram(
+            "picotron_queue_wait_seconds", "submit -> admit")
+        self._ttft_hist = reg.histogram(
+            "picotron_ttft_seconds", "submit -> first token")
+        self._tokens_total = reg.counter(
+            "picotron_generated_tokens_total", "tokens emitted to streams")
+        self._draft_proposed_total = reg.counter(
+            "picotron_draft_proposed_total",
+            "draft tokens proposed (speculative engines)")
+        self._draft_accepted_total = reg.counter(
+            "picotron_draft_accepted_total",
+            "draft tokens accepted into emitted streams")
+        self._req_spans: dict = {}  # uid -> live request root span
+        self._last_prefill: dict = {}  # scratch: dispatch/radix-hit counts
+        self._host_sync_s = 0.0  # scratch: last dispatch's host-sync time
         self._retry = dict(
             attempts=engine.cfg.resilience.dispatch_attempts,
             backoff=engine.cfg.resilience.dispatch_backoff,
@@ -234,6 +272,12 @@ class ContinuousBatcher:
                 f"leaves no room to generate under max_seq_len "
                 f"{self.engine.max_seq_len}")
         self._submit_t[req.uid] = self._clock()
+        # the request's root span: every later stage (queue wait, prefill,
+        # per-dispatch decode/verify, the front end's delivery) parents to
+        # it, so one request reads as one tree in a trace dump
+        self._req_spans[req.uid] = self.obs.tracer.begin(
+            "request", uid=req.uid, prompt_tokens=len(req.prompt),
+            max_new_tokens=req.max_new_tokens)
         self._pending.append(req)
 
     @property
@@ -312,8 +356,15 @@ class ContinuousBatcher:
             req = self._pending.popleft()
             self._submit_t.pop(req.uid, None)
             self.counters["shed"] += 1
-            self._results[req.uid] = GenerationResult(
-                req.uid, list(req.prompt), [], "shed")
+            self._results[req.uid] = self._shed_result(req)
+
+    def _shed_result(self, req: Request) -> GenerationResult:
+        """Terminal "shed" result + its ended root span."""
+        span = self._req_spans.pop(req.uid, None)
+        if span is not None:
+            self.obs.tracer.end(span, finish_reason="shed")
+        return GenerationResult(
+            req.uid, list(req.prompt), [], "shed", span_id=_sid(span))
 
     def run(self, requests=None) -> dict:
         """Submit ``requests`` (optional) and step until every submitted
@@ -324,21 +375,38 @@ class ContinuousBatcher:
             self.step()
         return self.take_results()
 
+    def refresh_gauges(self) -> tuple:
+        """Re-read live occupancy into the registry gauges; returns
+        ``(queued, active)``. Called by ``stats()`` AND by the serve
+        front end's ``/metrics`` render, so a Prometheus scraper that
+        never touches ``/statz`` still sees current depth/occupancy.
+        Safe from any thread: a deque ``len`` and one pass over the
+        fixed-size slot list, no batcher state mutated."""
+        queued = len(self._pending)
+        active = sum(s is not None for s in self._slots)
+        reg = self.obs.registry
+        reg.gauge("picotron_queue_depth",
+                  "requests waiting for a slot").set(queued)
+        reg.gauge("picotron_active_slots",
+                  "slots holding a live request").set(active)
+        return queued, active
+
     def stats(self) -> dict:
         """Serving counters + latency percentiles (the ``/statz`` payload):
         request accounting (admitted/completed/expired/errored/shed),
         dispatch/throughput counters, live occupancy, and queue-wait /
         time-to-first-token percentiles over the retained samples."""
+        queued, active = self.refresh_gauges()
         d = dict(self.counters)
         d.update(
             decode_dispatches=self.decode_dispatches,
             prefill_dispatches=self.prefill_dispatches,
             generated_tokens=self.generated_tokens,
-            queued=len(self._pending),
-            active_slots=sum(s is not None for s in self._slots),
+            queued=queued,
+            active_slots=active,
             slots=len(self._slots),
-            queue_wait_s=_percentiles(self._queue_waits),
-            ttft_s=_percentiles(self._ttfts),
+            queue_wait_s=self._queue_wait_hist.percentiles(),
+            ttft_s=self._ttft_hist.percentiles(),
         )
         if self.draft_proposed:
             d["accept_rate"] = self.accept_rate
@@ -361,9 +429,14 @@ class ContinuousBatcher:
     def _finish(self, i: int, reason: str) -> None:
         s = self._slots[i]
         self.counters[self._REASON_COUNTER[reason]] += 1
+        span = self._req_spans.pop(s.req.uid, None)
+        if span is not None:
+            self.obs.tracer.end(span, finish_reason=reason,
+                                tokens=len(s.generated))
         self._results[s.req.uid] = GenerationResult(
             s.req.uid, list(s.req.prompt), list(s.generated), reason,
-            queue_wait_s=s.queue_wait_s, ttft_s=s.ttft_s)
+            queue_wait_s=s.queue_wait_s, ttft_s=s.ttft_s,
+            span_id=_sid(span))
         self._slots[i] = None
         self._cache = self.engine.release(self._cache, i)
         self._last_tok[i] = 0
@@ -388,9 +461,10 @@ class ContinuousBatcher:
         s = self._slots[i]
         s.generated.append(tok)
         self.generated_tokens += 1
+        self._tokens_total.inc()
         if s.ttft_s is None and s.submit_t is not None:
             s.ttft_s = self._clock() - s.submit_t
-            self._sample(self._ttfts, s.ttft_s)
+            self._ttft_hist.observe(s.ttft_s)
         if self.on_token is not None:
             self.on_token(s.req.uid, tok)
         r = s.req
@@ -402,14 +476,6 @@ class ContinuousBatcher:
         else:
             self._last_tok[i] = tok
 
-    @staticmethod
-    def _sample(samples: list, v: float, cap: int = 4096) -> None:
-        """Retain a latency sample, dropping the oldest past ``cap`` (the
-        percentile window stays recent and the list stays bounded)."""
-        samples.append(v)
-        if len(samples) > cap:
-            del samples[: len(samples) - cap]
-
     def _prefill_into(self, req: Request, i: int):
         """Prefill ``req`` into slot ``i`` (one-shot or chunked) and return
         its last-token logits. Mutates the cache/dispatch counters. On the
@@ -418,9 +484,10 @@ class ContinuousBatcher:
         the suffix prefills."""
         if self.paged is not None:
             self.paged.priced[i] = self.page_commitment(req)
-            self._cache, logits, n, _cached = self.engine.prefill_paged(
+            self._cache, logits, n, cached = self.engine.prefill_paged(
                 self.params, self._cache, req.prompt, i)
             self.prefill_dispatches += n
+            self._last_prefill = {"dispatches": n, "cached_tokens": cached}
             return logits
         if len(req.prompt) > self.engine.prefill_chunk:
             # long prompt: fixed-width chunks straight into the slot —
@@ -429,11 +496,13 @@ class ContinuousBatcher:
             self._cache, logits = self.engine.prefill_chunked(
                 self.params, self._cache, req.prompt, i)
             self.prefill_dispatches += n_chunks
+            self._last_prefill = {"dispatches": n_chunks}
         else:
             kv, logits = self.engine.prefill(self.params, req.prompt)
             self._cache = self.engine.insert(
                 self._cache, kv, i, len(req.prompt))
             self.prefill_dispatches += 1
+            self._last_prefill = {"dispatches": 1}
         return logits
 
     def _pages_admit(self) -> bool:
@@ -452,8 +521,7 @@ class ContinuousBatcher:
                 self._pending.popleft()
                 self._submit_t.pop(req.uid, None)
                 self.counters["shed"] += 1
-                self._results[req.uid] = GenerationResult(
-                    req.uid, list(req.prompt), [], "shed")
+                self._results[req.uid] = self._shed_result(req)
                 continue
             return self.paged.can_admit(need)
         return False
@@ -468,17 +536,33 @@ class ContinuousBatcher:
                 return
             req = self._pending.popleft()
             submit_t = self._submit_t.pop(req.uid, None)
+            root = self._req_spans.get(req.uid)
+            t_admit = self._clock()
+            if submit_t is not None:
+                # the wait is over the moment the slot is assigned: the
+                # span chain's first link, parented to the request root
+                self.obs.tracer.record("queue_wait", submit_t, t_admit,
+                                       parent=root)
             try:
+                pf_span = self.obs.tracer.begin(
+                    "prefill", parent=root, uid=req.uid,
+                    prompt_tokens=len(req.prompt))
                 logits = retry(lambda: self._prefill_into(req, i),
                                **self._retry)
+                self.obs.tracer.end(pf_span, **self._last_prefill)
             except Exception as e:  # noqa: BLE001 - isolated to this request
                 # the failure costs only THIS request: it never held a slot,
                 # so release frees whatever partial prefill state landed and
                 # everyone already admitted keeps decoding
+                self.obs.tracer.end(pf_span, error=type(e).__name__)
                 self.counters["admitted"] += 1
                 self.counters["errored"] += 1
+                span = self._req_spans.pop(req.uid, None)
+                if span is not None:
+                    self.obs.tracer.end(span, finish_reason="error")
                 self._results[req.uid] = GenerationResult(
-                    req.uid, list(req.prompt), [], "error")
+                    req.uid, list(req.prompt), [], "error",
+                    span_id=_sid(span))
                 _log_dispatch_failure("prefill", req.uid, e)
                 if self._cache_ok():
                     # free whatever partial prefill state landed in the slot
@@ -492,8 +576,11 @@ class ContinuousBatcher:
                         if req.timeout_s is not None else None)
             slot = _Slot(req, deadline=deadline, submit_t=submit_t)
             if submit_t is not None:
+                # measured at the original point (post-prefill), so the
+                # /statz percentile semantics are unchanged; the span
+                # above ends at slot assignment (the actual queue time)
                 slot.queue_wait_s = now - submit_t
-                self._sample(self._queue_waits, slot.queue_wait_s)
+                self._queue_wait_hist.observe(slot.queue_wait_s)
             self._slots[i] = slot
             self._temp[i] = req.temperature
             self._top_k[i] = req.top_k
@@ -533,6 +620,7 @@ class ContinuousBatcher:
         for i, s in enumerate(self._slots):
             self._budget[i] = self._remaining(i) if s is not None else 0
         budget = self._budget.copy()
+        t_round = self._clock()
         if self.engine.spec_len > 0:
             toks, counts, failed = self._spec_round(budget)
         else:
@@ -541,13 +629,25 @@ class ContinuousBatcher:
                              for _ in range(block)])
 
             def dispatch(b):
+                t0 = self._clock()
                 self._cache, toks, counts = self.engine.decode_block(
                     self.params, self._cache, self._last_tok, keys,
                     self._eos, b, self._temp, self._top_k, self._top_p)
                 self.decode_dispatches += 1
-                return np.asarray(toks), np.asarray(counts), None
+                t_sync = self._clock()
+                out = np.asarray(toks), np.asarray(counts), None
+                t1 = self._clock()
+                self._host_sync_s = t1 - t_sync
+                self.engine.observe_dispatch("decode", t1 - t0,
+                                             host_sync_s=self._host_sync_s)
+                self.obs.tracer.record(
+                    "dispatch/decode", t0, t1,
+                    slots=int(np.count_nonzero(np.asarray(b) > 0)),
+                    host_sync_s=round(self._host_sync_s, 6))
+                return out
 
             toks, counts, _, failed = self._guarded_round(dispatch, budget)
+            self._slot_spans("decode", t_round, budget, counts, failed)
         for i in failed:
             if self._slots[i] is not None:
                 self._finish(i, "error")
@@ -561,6 +661,26 @@ class ContinuousBatcher:
                 if self._slots[i] is None:  # device/host rule mismatch guard
                     break
                 self._token_done(i, int(t))
+
+    def _slot_spans(self, kind: str, t0: float, budget, counts,
+                    failed, extra=None) -> None:
+        """Mirror one dispatch round into a child span PER REQUEST (the
+        shared engine dispatch serves many slots; Chrome traces have no
+        multi-parent events, so each request's chain gets its own copy of
+        the round window). ``extra(i) -> dict`` adds per-slot args (the
+        verify round's draft/accept counts)."""
+        t1 = self._clock()
+        for i, s in enumerate(self._slots):
+            if s is None or budget[i] <= 0:
+                continue
+            args = {"tokens": int(counts[i])}
+            if i in failed:
+                args["error"] = "dispatch_failed"
+            if extra is not None:
+                args.update(extra(i))
+            self.obs.tracer.record(kind, t0, t1,
+                                   parent=self._req_spans.get(s.req.uid),
+                                   **args)
 
     # ---- dispatch fault recovery ------------------------------------------
 
@@ -649,28 +769,49 @@ class ContinuousBatcher:
         through ``_token_done`` exactly like a decode block's."""
         g = self.engine.spec_len
         n = len(self._slots)
+        t_round = self._clock()
         tokens = np.zeros((n, g + 1), np.int32)
-        for i, s in enumerate(self._slots):
-            if s is None:
-                continue
-            tokens[i, 0] = self._last_tok[i]
-            hist = np.asarray(list(s.req.prompt) + s.generated, np.int32)
-            tokens[i, 1:] = self.drafter.propose(hist, g)
+        with self.obs.tracer.span("draft", spec_len=g):
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    continue
+                tokens[i, 0] = self._last_tok[i]
+                hist = np.asarray(list(s.req.prompt) + s.generated,
+                                  np.int32)
+                tokens[i, 1:] = self.drafter.propose(hist, g)
         key = self._split()
 
         def dispatch(b):
+            t0 = self._clock()
             self._cache, emitted, counts, accepted = self.engine.verify(
                 self.params, self._cache, tokens, key, self._eos,
                 b, self._temp, self._top_k, self._top_p)
             self.decode_dispatches += 1
-            return (np.asarray(emitted), np.asarray(counts),
-                    np.asarray(accepted))
+            t_sync = self._clock()
+            out = (np.asarray(emitted), np.asarray(counts),
+                   np.asarray(accepted))
+            t1 = self._clock()
+            self._host_sync_s = t1 - t_sync
+            self.engine.observe_dispatch("verify", t1 - t0,
+                                         host_sync_s=self._host_sync_s)
+            self.obs.tracer.record(
+                "dispatch/verify", t0, t1,
+                slots=int(np.count_nonzero(np.asarray(b) > 0)),
+                draft_len=g, host_sync_s=round(self._host_sync_s, 6))
+            return out
 
         emitted, counts, accepted, failed = self._guarded_round(
             dispatch, budget)
         for i, s in enumerate(self._slots):
             if s is not None and i not in failed and budget[i] > 0:
                 self.draft_proposed += g
+                self._draft_proposed_total.inc(g)
                 if accepted is not None:
                     self.draft_accepted += int(accepted[i])
+                    self._draft_accepted_total.inc(int(accepted[i]))
+        self._slot_spans(
+            "verify", t_round, budget, counts, failed,
+            extra=lambda i: {"draft_len": g,
+                             "accepted": (int(accepted[i])
+                                          if accepted is not None else 0)})
         return emitted, counts, failed

@@ -64,6 +64,7 @@ from jax.sharding import PartitionSpec as P
 
 from picotron_tpu.config import Config
 from picotron_tpu.inference import kv_cache, paged_kv, sampling
+from picotron_tpu.obs import Obs
 from picotron_tpu.models import llama
 from picotron_tpu.ops.rope import precompute_rope, rope_at_positions
 from picotron_tpu.parallel.tp import tp_gather
@@ -168,6 +169,12 @@ class InferenceEngine:
                  "this engine starts on 'dense' (inference.attend_fallback)")
             inf.attend_impl = "dense"
         self.attend_impl = inf.attend_impl
+        # Telemetry (picotron_tpu/obs, docs/OBSERVABILITY.md): every
+        # engine owns a fresh metrics registry (counters start at zero
+        # per server) and shares the process span ring. The batcher and
+        # serve front end reuse this bundle, so one /metrics page covers
+        # the whole serving stack. obs.enabled: false swaps in no-ops.
+        self.obs = Obs.from_config(self.cfg.obs)
         # dispatch hooks (fault injection / observation): an object with
         # before_dispatch(kind, active_slots) — may raise or sleep — and
         # poison_logits(kind) -> bool (route this dispatch through the
@@ -326,12 +333,32 @@ class InferenceEngine:
 
     def _hook(self, kind: str, budget=None) -> None:
         """Fire the before-dispatch hook with the active slot indices
-        (``budget > 0`` rows; dispatches without a budget report none)."""
+        (``budget > 0`` rows; dispatches without a budget report none)
+        and count the dispatch in the metrics registry."""
+        self.obs.registry.counter(
+            "picotron_dispatch_total",
+            "engine dispatches by kind", kind=kind).inc()
         if self.hooks is None:
             return
         slots = ([] if budget is None
                  else np.flatnonzero(np.asarray(budget) > 0).tolist())
         self.hooks.before_dispatch(kind, slots)
+
+    def observe_dispatch(self, kind: str, seconds: float,
+                         host_sync_s: Optional[float] = None) -> None:
+        """Record one dispatch's end-to-end wall time (submit through the
+        caller's host sync) into the registry. Callers that pay the sync
+        — the batcher's round closures, the benches — report here; the
+        engine itself never blocks on its own async dispatches just to
+        time them."""
+        reg = self.obs.registry
+        reg.histogram("picotron_dispatch_seconds",
+                      "dispatch wall time incl. host sync, by kind",
+                      kind=kind).observe(seconds)
+        if host_sync_s is not None:
+            reg.histogram("picotron_host_sync_seconds",
+                          "host blocked on device results, by kind",
+                          kind=kind).observe(host_sync_s)
 
     def _poison(self, kind: str) -> bool:
         return self.hooks is not None and self.hooks.poison_logits(kind)

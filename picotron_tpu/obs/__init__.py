@@ -1,0 +1,87 @@
+"""Unified telemetry: metrics registry, span tracer, export surfaces.
+
+One observability plane shared by training and serving
+(docs/OBSERVABILITY.md). Three layers:
+
+- ``obs.metrics`` — thread-safe counters/gauges/histograms behind a
+  registry; Prometheus text exposition; ``CounterDict`` dict-semantics
+  views for the pre-existing counter maps.
+- ``obs.tracing`` — begin/end spans with parent links in a bounded ring,
+  exported as Chrome-trace JSON (``tools/trace_dump.py``,
+  ``GET /tracez``).
+- ``obs.profiler`` — on-demand timed ``jax.profiler`` captures
+  (SIGUSR2 / ``POST /profilez``).
+
+Ownership model:
+
+- each ``InferenceEngine`` (and each ``train()`` run) owns a FRESH
+  registry via ``Obs.from_config(cfg.obs)`` — counters start at zero per
+  server/run, so ``GET /metrics`` agrees with that server's ``/statz``
+  even when several engines share a process (tests);
+- ``GLOBAL_REGISTRY`` holds process-wide counters owned by no run in
+  particular (resilience retries, emergency saves) — export surfaces
+  render it alongside the local registry;
+- ``GLOBAL_TRACER`` is the one process span ring (like the logging
+  root): engine, batcher, serve, train, and ``comm_trace`` all record
+  into it, so a trace dump interleaves every subsystem on one timeline.
+  ``obs.enabled: false`` swaps in null instruments — every record call
+  no-ops and the hot paths carry zero bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from picotron_tpu.obs.metrics import (  # noqa: F401 - public surface
+    Counter,
+    CounterDict,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NullRegistry,
+    percentiles_of,
+)
+from picotron_tpu.obs.jsonl import MetricsJsonl  # noqa: F401
+from picotron_tpu.obs.profiler import ProfileCapture, install_sigusr2  # noqa: F401
+from picotron_tpu.obs.tracing import NullTracer, Span, SpanTracer  # noqa: F401
+
+# Process-wide surfaces (see module docstring).
+GLOBAL_REGISTRY = MetricsRegistry()
+GLOBAL_TRACER = SpanTracer()
+_NULL_TRACER = NullTracer()
+
+
+class Obs:
+    """The bundle a subsystem carries: its registry + the shared tracer,
+    with one ``enabled`` flag gating both."""
+
+    def __init__(self, enabled: bool = True,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[SpanTracer] = None):
+        self.enabled = bool(enabled)
+        if not self.enabled:
+            self.registry = registry or NullRegistry()
+            self.tracer = tracer or _NULL_TRACER
+        else:
+            self.registry = registry or MetricsRegistry()
+            self.tracer = tracer or GLOBAL_TRACER
+
+    @classmethod
+    def from_config(cls, ocfg) -> "Obs":
+        """Build from a config ``obs`` section (config.ObsConfig)."""
+        if not ocfg.enabled:
+            return cls(enabled=False)
+        GLOBAL_TRACER.resize(ocfg.span_ring)
+        return cls(enabled=True,
+                   registry=MetricsRegistry(
+                       sample_window=ocfg.sample_window))
+
+
+def null_obs() -> Obs:
+    return Obs(enabled=False)
+
+
+def global_counter(name: str, help: str = "", **labels) -> Counter:
+    """A counter on the process-wide registry (resilience retries,
+    emergency saves, ... — owned by no single run)."""
+    return GLOBAL_REGISTRY.counter(name, help, **labels)

@@ -1,0 +1,74 @@
+"""Per-step training metrics as JSONL — the structured replacement for
+regex-scraping the per-step log line.
+
+``picotron_tpu.train`` appends one JSON object per optimizer step
+(controller process only) to the path named by ``$PICOTRON_METRICS_JSONL``
+(the supervisor/scheduler export — lands next to the run log) or
+``obs.metrics_jsonl``; ``tools/extract_metrics.py`` prefers this file over
+the legacy log regex. Rows carry the exact fields the regex used to
+recover — ``step``, ``loss``, ``tokens_per_sec``, ``tokens_per_sec_per_chip``,
+``trained_tokens``, ``mfu_pct``, ``memory_gb`` (the last two null except on
+log-frequency steps, where they are actually computed) — plus a wall
+timestamp. A final ``{"event": "summary", "metrics": ...}`` row embeds the
+run's registry snapshot; row consumers key on ``"step"`` and skip it.
+
+Writes are line-buffered and flushed per row so a preempted/killed run
+keeps every completed step; a write error disables the writer with one
+warning instead of ever failing a training step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+class MetricsJsonl:
+    """Append-only JSONL metrics writer (never raises out of write())."""
+
+    def __init__(self, path: str, log=None):
+        self.path = path
+        self._log = log
+        self._f = None
+        try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(path, "a")
+        except OSError as e:
+            self._warn(f"metrics jsonl: cannot open {path!r} ({e}); "
+                       f"per-step metrics disabled")
+
+    def _warn(self, msg: str) -> None:
+        if self._log is not None:
+            self._log(msg)
+
+    def write(self, row: dict) -> None:
+        if self._f is None:
+            return
+        try:
+            self._f.write(json.dumps(row) + "\n")
+            self._f.flush()
+        except (OSError, ValueError, TypeError) as e:
+            self._warn(f"metrics jsonl: write failed ({e}); disabling")
+            self.close()
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+
+def resolve_path(ocfg) -> Optional[str]:
+    """The effective JSONL path: the supervisor/scheduler's
+    ``$PICOTRON_METRICS_JSONL`` export wins over the config field (same
+    precedence as the heartbeat path); None when neither is set or obs
+    is disabled."""
+    if not ocfg.enabled:
+        return None
+    return (os.environ.get("PICOTRON_METRICS_JSONL", "")
+            or ocfg.metrics_jsonl) or None

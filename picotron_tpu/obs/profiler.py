@@ -1,0 +1,113 @@
+"""On-demand timed ``jax.profiler`` capture (SIGUSR2 / ``POST /profilez``).
+
+The training loop's windowed profiler (``logging.profile_start/stop``)
+answers "profile steps N..M of a run I am about to launch"; this module
+answers the production question — "this process is slow RIGHT NOW, grab a
+trace" — for a live server or trainer without restarting it:
+
+- ``ProfileCapture.start()`` begins ``jax.profiler.start_trace(dir)`` and
+  arms a daemon timer that stops it after ``seconds``;
+- ``install_sigusr2(capture)`` makes ``kill -USR2 <pid>`` trigger exactly
+  that (the serve CLI and the train CLI both install it);
+- the serving front end exposes the same start as ``POST /profilez``.
+
+One capture at a time: a start while one is running reports busy instead
+of tripping jax's double-start error. The signal handler only flips an
+event and spawns the worker — nothing slow runs on the signal path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+
+class ProfileCapture:
+    """Timed profiler window manager. ``start()`` is safe from any
+    thread (and from a signal handler via ``request()``)."""
+
+    def __init__(self, out_dir: str, seconds: float = 5.0, log=None):
+        self.out_dir = out_dir
+        self.seconds = float(seconds)
+        self._mu = threading.Lock()
+        self._running = False
+        self._count = 0
+        self._log = log
+
+    @property
+    def running(self) -> bool:
+        with self._mu:
+            return self._running
+
+    @property
+    def captures(self) -> int:
+        with self._mu:
+            return self._count
+
+    def _say(self, msg: str) -> None:
+        if self._log is not None:
+            self._log(msg)
+
+    def start(self, out_dir: Optional[str] = None,
+              seconds: Optional[float] = None) -> dict:
+        """Begin one timed capture. Returns ``{"ok": True, "dir",
+        "seconds"}`` or ``{"ok": False, "error"}`` when one is already
+        running (or jax refuses to start a trace)."""
+        d = out_dir or self.out_dir
+        s = float(seconds if seconds is not None else self.seconds)
+        if s <= 0:
+            return {"ok": False, "error": f"seconds must be > 0, got {s}"}
+        with self._mu:
+            if self._running:
+                return {"ok": False, "error": "capture already running"}
+            self._running = True
+        try:
+            import jax
+
+            os.makedirs(d, exist_ok=True)
+            jax.profiler.start_trace(d)
+        except Exception as e:  # noqa: BLE001 - reported, never fatal
+            with self._mu:
+                self._running = False
+            return {"ok": False,
+                    "error": f"profiler start failed: {e}"}
+        t = threading.Thread(target=self._stop_after, args=(s,),
+                             name="obs-profile-stop", daemon=True)
+        t.start()
+        self._say(f"profiler: capturing {s:.3g}s into {d}")
+        return {"ok": True, "dir": d, "seconds": s}
+
+    def _stop_after(self, seconds: float) -> None:
+        time.sleep(seconds)
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 - reported, never fatal
+            self._say(f"profiler: stop failed: {e}")
+        finally:
+            with self._mu:
+                self._running = False
+                self._count += 1
+        self._say("profiler: capture done")
+
+    def request(self) -> None:
+        """Signal-handler-safe trigger: hand the start to a worker thread
+        so the handler never touches jax or the filesystem."""
+        threading.Thread(target=self.start, name="obs-profile-start",
+                         daemon=True).start()
+
+
+def install_sigusr2(capture: ProfileCapture) -> bool:
+    """SIGUSR2 -> one timed capture. Returns False off the main thread
+    (embedded runs: the signal surface is simply unavailable there)."""
+    import signal
+
+    try:
+        signal.signal(signal.SIGUSR2,
+                      lambda signum, frame: capture.request())
+        return True
+    except ValueError:
+        return False

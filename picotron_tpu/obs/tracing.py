@@ -1,0 +1,214 @@
+"""Span tracer: begin/end spans with parent links in a bounded ring.
+
+The per-request / per-step timeline complement of the metrics registry
+(docs/OBSERVABILITY.md). Spans are cheap host-side records — name, wall
+window, thread, parent id, small args dict — appended to a bounded ring
+when they END (an unfinished span costs nothing but its object). The ring
+is the export surface: ``chrome_trace()`` renders the retained spans as
+Chrome-trace/Perfetto ``traceEvents`` JSON (``tools/trace_dump.py``
+validates and queries it; ``GET /tracez`` on the serving front end dumps
+it live), with each event's ``args`` carrying ``id``/``parent`` so a
+request's whole chain — queue wait -> prefill -> every dispatch ->
+delivery — reads as one parented tree.
+
+Three record styles:
+
+- ``with tracer.span("prefill", parent=root, prompt_tokens=n):`` — the
+  common scoped form;
+- ``begin()`` / ``end()`` — for windows that open and close in different
+  call frames (a request's root span lives from submit to finish);
+- ``record(name, t0, t1, parent=...)`` — retroactive: one engine dispatch
+  serves many slots, so the batcher mirrors the dispatch window into one
+  child span PER REQUEST after the fact, which is what makes every
+  request's chain complete without multi-parent events.
+
+``instant()`` records zero-duration marks (trace-time collective logs
+from ``comm_trace``).
+
+Thread-safety: one leaf lock guards the id counter and ring; nothing else
+is shared. The clock is ``time.monotonic`` (one timebase across threads);
+timestamps are exported in microseconds as Chrome expects.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+DEFAULT_RING = 4096
+
+
+class Span:
+    """One timed window. ``t1 is None`` until ended/recorded."""
+
+    __slots__ = ("name", "span_id", "parent_id", "t0", "t1", "tid", "args")
+
+    def __init__(self, name: str, span_id: int, parent_id: Optional[int],
+                 t0: float, tid: int, args: dict):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.t1: Optional[float] = None
+        self.tid = tid
+        self.args = args
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        return None if self.t1 is None else self.t1 - self.t0
+
+
+class _NullSpan(Span):
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__("", 0, None, 0.0, 0, {})
+
+
+NULL_SPAN = _NullSpan()
+
+
+def _parent_id(parent) -> Optional[int]:
+    if parent is None:
+        return None
+    pid = parent.span_id if isinstance(parent, Span) else int(parent)
+    return pid or None  # the null span's id 0 means "no parent"
+
+
+class SpanTracer:
+    """Bounded ring of finished spans (oldest dropped past ``ring``)."""
+
+    def __init__(self, ring: int = DEFAULT_RING, clock=time.monotonic):
+        self._mu = threading.Lock()
+        self._clock = clock
+        self._next_id = 1
+        self._ring: deque = deque(maxlen=max(1, int(ring)))
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    def resize(self, ring: int) -> None:
+        """Grow (never shrink) the ring — config-driven sizing of the
+        shared process tracer without discarding retained spans."""
+        ring = int(ring)
+        with self._mu:
+            if ring > (self._ring.maxlen or 0):
+                self._ring = deque(self._ring, maxlen=ring)
+
+    # ---- record surface ----------------------------------------------------
+
+    def begin(self, name: str, parent=None, **args) -> Span:
+        with self._mu:
+            sid = self._next_id
+            self._next_id += 1
+        return Span(name, sid, _parent_id(parent), self._clock(),
+                    threading.get_ident(), args)
+
+    def end(self, span: Span, **args) -> Span:
+        if span.span_id == 0:  # null span
+            return span
+        span.t1 = self._clock()
+        if args:
+            span.args = {**span.args, **args}
+        with self._mu:
+            self._ring.append(span)
+        return span
+
+    class _Scoped:
+        __slots__ = ("_tracer", "_span")
+
+        def __init__(self, tracer: "SpanTracer", span: Span):
+            self._tracer = tracer
+            self._span = span
+
+        def __enter__(self) -> Span:
+            return self._span
+
+        def __exit__(self, exc_type, exc, tb) -> None:
+            if exc_type is not None:
+                self._span.args = {**self._span.args,
+                                   "error": exc_type.__name__}
+            self._tracer.end(self._span)
+
+    def span(self, name: str, parent=None, **args) -> "_Scoped":
+        return self._Scoped(self, self.begin(name, parent=parent, **args))
+
+    def record(self, name: str, t0: float, t1: float, parent=None,
+               **args) -> Span:
+        """Retroactively record a finished window."""
+        s = self.begin(name, parent=parent, **args)
+        s.t0 = t0
+        s.t1 = t1
+        with self._mu:
+            self._ring.append(s)
+        return s
+
+    def instant(self, name: str, **args) -> Span:
+        t = self._clock()
+        return self.record(name, t, t, **args)
+
+    # ---- read side ---------------------------------------------------------
+
+    def spans(self) -> list:
+        with self._mu:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._ring.clear()
+
+    def chrome_trace(self) -> dict:
+        """Chrome-trace JSON ("traceEvents" array format): one complete
+        ("X") event per span — instants (t0 == t1) render as "i" — with
+        ``args.id``/``args.parent`` carrying the chain links."""
+        pid = os.getpid()
+        events = []
+        for s in self.spans():
+            args = {"id": s.span_id}
+            if s.parent_id:
+                args["parent"] = s.parent_id
+            args.update(s.args)
+            ev = {"name": s.name, "cat": "picotron", "pid": pid,
+                  "tid": s.tid, "ts": round(s.t0 * 1e6, 3), "args": args}
+            if s.t1 is not None and s.t1 > s.t0:
+                ev["ph"] = "X"
+                ev["dur"] = round((s.t1 - s.t0) * 1e6, 3)
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "p"
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump_chrome(self, path: str) -> None:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+
+
+class NullTracer(SpanTracer):
+    """``obs.enabled: false``: the whole record surface no-ops and hands
+    back the shared null span (parenting off it is a no-op too)."""
+
+    def __init__(self):
+        super().__init__(ring=1)
+
+    @property
+    def enabled(self) -> bool:
+        return False
+
+    def begin(self, name, parent=None, **args) -> Span:
+        return NULL_SPAN
+
+    def end(self, span, **args) -> Span:
+        return span
+
+    def record(self, name, t0, t1, parent=None, **args) -> Span:
+        return NULL_SPAN
+
+    def spans(self) -> list:
+        return []

@@ -131,13 +131,24 @@ class PreemptionGuard:
         t = threading.Thread(target=run, name="emergency-save", daemon=True)
         t.start()
         t.join(timeout_s if timeout_s and timeout_s > 0 else None)
+        from picotron_tpu.obs import global_counter
+
         if t.is_alive():
             log0(f"emergency save still running after {timeout_s}s "
                  f"deadline; exiting without it (the last periodic "
                  f"checkpoint stands)", flush=True)
+            global_counter("picotron_emergency_saves_total",
+                           "emergency checkpoint flushes by outcome",
+                           outcome="abandoned").inc()
             return False
         if "err" in state:
+            global_counter("picotron_emergency_saves_total",
+                           "emergency checkpoint flushes by outcome",
+                           outcome="failed").inc()
             raise state["err"]
+        global_counter("picotron_emergency_saves_total",
+                       "emergency checkpoint flushes by outcome",
+                       outcome="completed").inc()
         return True
 
 

@@ -37,10 +37,18 @@ def retry(
     """
     if attempts < 1:
         raise ValueError(f"retry needs attempts >= 1, got {attempts}")
+    from picotron_tpu.obs import global_counter
+
     for attempt in range(1, attempts + 1):
         try:
             return fn()
         except retry_on as e:
+            # process-wide resilience counter (docs/OBSERVABILITY.md):
+            # retry() has no per-run registry to hand its numbers to, so
+            # failed attempts count globally, labeled by call site
+            global_counter("picotron_retries_total",
+                           "failed attempts absorbed by retry()",
+                           desc=desc or "unnamed").inc()
             if attempt == attempts:
                 raise
             delay = backoff * (2 ** (attempt - 1)) * (1.0 + jitter * rng())

@@ -1,13 +1,22 @@
-"""Scrape per-step training logs into CSV benchmark tables.
+"""Extract per-step training metrics into CSV benchmark tables.
 
-Re-build of the reference's ``extract_metrics.py`` (:1-210): regex-parse the
-throughput fields out of each run's log, drop the first 3 steps as compile/
-cache warmup and average the rest (:82-89), write a per-run ``metrics.csv``
-and a sweep-level ``global_metrics.csv`` whose topology columns are parsed
-from the run-folder naming convention ``...dp2_tp4_pp2_cp1_mbs1_ga8_sl2048...``
-(:8-23,:147-195). The log-line grammar is what ``picotron_tpu.train`` prints
-(train.py log line; reference train.py:247-259) — ``Tokens/s/chip`` instead
-of ``Tokens/s/GPU``, plus optional ``MFU:`` and ``Memory usage:`` fields.
+Re-build of the reference's ``extract_metrics.py`` (:1-210): recover the
+throughput fields from each run, drop the first 3 steps as compile/cache
+warmup and average the rest (:82-89), write a per-run ``metrics.csv`` and a
+sweep-level ``global_metrics.csv`` whose topology columns are parsed from
+the run-folder naming convention ``...dp2_tp4_pp2_cp1_mbs1_ga8_sl2048...``
+(:8-23,:147-195).
+
+Two sources per run dir, structured preferred (docs/OBSERVABILITY.md):
+
+1. ``metrics.jsonl`` — the per-step JSONL ``picotron_tpu.train`` writes
+   (``$PICOTRON_METRICS_JSONL`` / ``obs.metrics_jsonl``): parsed directly,
+   no regex, field names already ours.
+2. The legacy log scrape — regex over the per-step log line
+   (train.py log line; reference train.py:247-259) — ``Tokens/s/chip``
+   instead of ``Tokens/s/GPU``, plus optional ``MFU:`` and
+   ``Memory usage:`` fields. Kept for logs from runs that predate the
+   JSONL (or had obs disabled).
 """
 
 from __future__ import annotations
@@ -15,6 +24,7 @@ from __future__ import annotations
 import argparse
 import csv
 import glob
+import json
 import os
 import re
 from typing import Optional
@@ -115,6 +125,49 @@ def find_log(run_dir: str) -> Optional[str]:
     return None
 
 
+JSONL_NAME = "metrics.jsonl"
+
+_ROW_KEYS = ("loss", "tokens_per_sec", "tokens_per_sec_per_chip",
+             "mfu_pct", "memory_gb")
+
+
+def find_metrics_jsonl(run_dir: str) -> Optional[str]:
+    """The structured per-step metrics file, when the run wrote one."""
+    path = os.path.join(run_dir, JSONL_NAME)
+    return path if os.path.isfile(path) else None
+
+
+def parse_jsonl_file(path: str) -> list[dict]:
+    """Rows in exactly ``parse_log_file``'s shape, read from the per-step
+    JSONL instead of the log regex. Rows without a ``step`` (the terminal
+    registry-summary row, future event rows) and unparseable lines are
+    skipped — a truncated last line from a killed run must not lose the
+    steps before it."""
+    rows = []
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict) or "step" not in rec:
+                continue
+            try:
+                row = {"step": int(rec["step"])}
+                for k in _ROW_KEYS:
+                    v = rec.get(k)
+                    row[k] = None if v is None else float(v)
+            except (TypeError, ValueError):
+                continue
+            if row["loss"] is None:
+                continue
+            rows.append(row)
+    return rows
+
+
 def _write_csv(path: str, rows: list[dict]) -> None:
     if not rows:
         return
@@ -129,10 +182,15 @@ def extract(inp_dir: str) -> list[dict]:
     global_metrics.csv with one summary row per run (reference :147-195)."""
     global_rows = []
     for root, _dirs, files in sorted(os.walk(inp_dir)):
-        has_log = find_log(root)
-        if not has_log:
-            continue
-        rows = parse_log_file(has_log)
+        # structured source first: a run that wrote the per-step JSONL is
+        # parsed without the regex path (and without needing a log at all)
+        jsonl = find_metrics_jsonl(root)
+        rows = parse_jsonl_file(jsonl) if jsonl else []
+        if not rows:
+            # legacy path: regex-scrape the log (runs predating the
+            # JSONL, obs disabled, or an empty/corrupt JSONL)
+            has_log = find_log(root)
+            rows = parse_log_file(has_log) if has_log else []
         if not rows:
             continue
         _write_csv(os.path.join(root, "metrics.csv"), rows)

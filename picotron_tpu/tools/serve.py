@@ -29,6 +29,17 @@ API (all bodies JSON):
 - ``GET /statz`` — the batcher's ``stats()`` (terminal-state counters,
   queue-wait / time-to-first-token percentiles) plus the server's
   admission-rejection counters and drain/stall state.
+- ``GET /metrics`` — Prometheus text exposition of the engine/batcher/
+  front-end registry plus the process-wide resilience counters
+  (picotron_tpu/obs, docs/OBSERVABILITY.md). The counters are the SAME
+  instruments ``/statz`` reads, so the two surfaces cannot disagree.
+- ``GET /tracez`` — the process span ring as Chrome-trace JSON: each
+  request's queue-wait -> prefill -> per-dispatch -> delivery chain,
+  parented. Validate/query with ``tools/trace_dump.py``.
+- ``POST /profilez`` — start one timed ``jax.profiler`` capture
+  (``{"seconds", "dir"}`` optional; defaults from ``obs.profile_dir`` /
+  ``obs.profile_seconds``); 409 while one is running. The CLI wires
+  SIGUSR2 to the same capture.
 
 Admission control (checked atomically at POST time):
 
@@ -111,9 +122,15 @@ class FrontEnd:
                  watchdog_poll_s: float = 0.25,
                  log=print):
         from picotron_tpu.inference import ContinuousBatcher
+        from picotron_tpu.obs import ProfileCapture
         from picotron_tpu.resilience.preemption import PreemptionGuard
 
         self.engine = engine
+        self.obs = engine.obs  # one registry across engine/batcher/front end
+        ocfg = engine.cfg.obs
+        self.profiler = ProfileCapture(
+            ocfg.profile_dir, ocfg.profile_seconds,
+            log=lambda m: self._event("profiler", note=m))
         self.max_queue = int(max_queue)
         self.token_budget = int(token_budget if token_budget is not None
                                 else engine.slots * engine.max_seq_len)
@@ -135,9 +152,14 @@ class FrontEnd:
         self.dead = False  # loop died on an exception (vs clean drain)
         self.stalled = False
         self.stalls = 0  # stall episodes the watchdog flagged
-        self.rejections = {"queue_full": 0, "token_budget": 0,
-                           "page_budget": 0, "draining": 0, "stalled": 0,
-                           "dead": 0}
+        # a CounterDict: plain-dict reads (tests, /statz) with every
+        # write mirrored into picotron_rejections_total{reason} — the
+        # /metrics rendering of the same numbers
+        self.rejections = self.obs.registry.counter_dict(
+            "picotron_rejections_total",
+            ("queue_full", "token_budget", "page_budget", "draining",
+             "stalled", "dead"),
+            help="admission sheds by reason", label="reason")
         # leaf lock for the rejection counters: the "stalled" increment
         # happens precisely when _mu could NOT be acquired, so the
         # counters need their own guard (picolint PICO-C003 — concurrent
@@ -356,8 +378,15 @@ class FrontEnd:
             prompt_tokens=len(res.prompt), new_tokens=len(res.tokens),
             queue_wait_s=_r(res.queue_wait_s), ttft_s=_r(res.ttft_s),
             total_s=_r(None if t0 is None else time.monotonic() - t0))
+        td = time.monotonic()
         if w is not None:
             w.put_done(res)
+        # the chain's last link: hand-off to the waiting handler thread,
+        # parented onto the request's (already-ended) root span
+        if getattr(res, "span_id", None):
+            self.obs.tracer.record("delivery", td, time.monotonic(),
+                                   parent=res.span_id, uid=uid,
+                                   finish_reason=res.finish_reason)
 
     def _watchdog(self) -> None:
         """Dispatch-stall detector, the in-process mirror of
@@ -387,6 +416,24 @@ class FrontEnd:
         """One structured (JSON) log line per server event."""
         self._log(json.dumps({"evt": evt, "t": round(time.time(), 3),
                               **fields}), flush=True)
+
+    def metrics_text(self) -> str:
+        """Prometheus text: the server's registry (engine + batcher +
+        front end — the same instruments ``/statz`` reads) followed by
+        the process-wide resilience counters (retries, emergency saves —
+        obs.GLOBAL_REGISTRY). No lock is needed: every instrument
+        snapshots under its own leaf lock, and the gauge refresh only
+        reads the batcher's occupancy."""
+        from picotron_tpu.obs import GLOBAL_REGISTRY
+
+        # depth/occupancy gauges are point-in-time reads: refresh them so
+        # a scraper that never touches /statz still sees current values
+        self._batcher.refresh_gauges()
+        return self.obs.registry.prometheus() + GLOBAL_REGISTRY.prometheus()
+
+    def trace_json(self) -> dict:
+        """The process span ring as Chrome-trace JSON."""
+        return self.obs.tracer.chrome_trace()
 
     def healthy(self) -> bool:
         return not (self.stalled or self.dead)
@@ -457,11 +504,39 @@ class _Handler(BaseHTTPRequestHandler):
                         "stalled": f.stalled, "dead": f.dead})
         elif self.path == "/statz":
             self._json(200, f.stats())
+        elif self.path == "/metrics":
+            body = f.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/tracez":
+            self._json(200, f.trace_json())
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
+    def _profilez(self, spec: dict) -> None:
+        f = self.front
+        try:
+            seconds = (float(spec["seconds"]) if "seconds" in spec
+                       else None)
+        except (TypeError, ValueError) as e:
+            self._json(400, {"error": f"bad profilez field: {e}"})
+            return
+        if seconds is not None and seconds <= 0:
+            # a malformed request is the CLIENT's bug: 400, never the
+            # 409 that means "a capture is already running"
+            self._json(400, {"ok": False,
+                             "error": f"seconds must be > 0, got {seconds}"})
+            return
+        out = f.profiler.start(out_dir=spec.get("dir") or None,
+                               seconds=seconds)
+        self._json(200 if out["ok"] else 409, out)
+
     def do_POST(self) -> None:
-        if self.path != "/generate":
+        if self.path not in ("/generate", "/profilez"):
             self._json(404, {"error": f"unknown path {self.path}"})
             return
         try:
@@ -487,6 +562,9 @@ class _Handler(BaseHTTPRequestHandler):
             # valid JSON that is not an object ('[]', 'null', '3') must be
             # a 400, not an AttributeError-dropped connection
             self._json(400, {"error": "request body must be a JSON object"})
+            return
+        if self.path == "/profilez":
+            self._profilez(spec)
             return
         try:
             uid, waiter = self.front.submit(spec)
@@ -639,9 +717,38 @@ def _get(port: int, path: str):
     return out
 
 
-def _smoke(server: Server) -> int:
+def _profilez_post(port: int, spec: dict):
+    """POST /profilez (stdlib client): (status, parsed body)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("POST", "/profilez", json.dumps(spec),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read() or b"{}"))
+    conn.close()
+    return out
+
+
+def _get_text(port: int, path: str):
+    """GET a non-JSON surface (/metrics): (status, text)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    out = (resp.status, resp.read().decode("utf-8", errors="replace"))
+    conn.close()
+    return out
+
+
+def _smoke(server: Server, obs_dump: str = "") -> int:
     """The `make serve-smoke` drive: health, one POST, one streamed POST,
-    SIGTERM drain with full accounting. Returns an exit code."""
+    the observability surfaces (/metrics agreeing with /statz, a complete
+    request chain in /tracez, one timed /profilez capture), then a
+    SIGTERM drain with full accounting. ``obs_dump`` saves the trace and
+    metrics page there for `make obs-smoke`'s trace_dump gate. Returns an
+    exit code."""
     import os
     import signal
 
@@ -667,6 +774,40 @@ def _smoke(server: Server) -> int:
     check("stream", st == 200 and len(done) == 1
           and done[0]["tokens"] == toks
           and done[0]["tokens"] == body["tokens"])  # greedy: deterministic
+
+    # ---- observability surfaces (docs/OBSERVABILITY.md) ----
+    from picotron_tpu.obs.metrics import parse_prometheus
+    from picotron_tpu.tools import trace_dump
+
+    st, stats = _get(port, "/statz")
+    mst, mtext = _get_text(port, "/metrics")
+    prom = parse_prometheus(mtext)
+    check("metrics_agrees_with_statz",
+          mst == 200
+          and prom.get('picotron_requests_total{state="completed"}')
+          == stats.get("completed")
+          and prom.get('picotron_generated_tokens_total')
+          == stats.get("generated_tokens"))
+    tst, trace = _get(port, "/tracez")
+    chains = trace_dump.request_chains(trace)
+    check("tracez_request_chain",
+          tst == 200 and not trace_dump.validate(trace)
+          and any(c["complete"] for c in chains.values()))
+    if obs_dump:
+        os.makedirs(obs_dump, exist_ok=True)
+        with open(os.path.join(obs_dump, "trace.json"), "w") as f:
+            json.dump(trace, f)
+        with open(os.path.join(obs_dump, "metrics.txt"), "w") as f:
+            f.write(mtext)
+    prof_dir = os.path.join(obs_dump or "/tmp", "serve-smoke-profile")
+    pst, pbody = _profilez_post(port, {"seconds": 0.2, "dir": prof_dir})
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and server.front.profiler.running:
+        time.sleep(0.05)
+    check("profilez",
+          pst == 200 and pbody.get("ok")
+          and server.front.profiler.captures >= 1
+          and os.path.isdir(prof_dir) and os.listdir(prof_dir))
 
     # drain: one slow request in flight + SIGTERM -> it finishes, the
     # server stops admitting, and the exit is clean
@@ -735,6 +876,11 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="built-in tiny CPU model + scripted client drive "
                          "(the `make serve-smoke` target)")
+    ap.add_argument("--obs-dump", default="",
+                    help="smoke only: save the drive's /tracez JSON and "
+                         "/metrics page into this dir (the `make "
+                         "obs-smoke` target validates them with "
+                         "tools/trace_dump.py)")
     args = ap.parse_args(argv)
 
     cfg, engine, params = _build_engine_and_params(args)
@@ -746,8 +892,13 @@ def main(argv=None) -> int:
         default_timeout_s=args.default_timeout_s,
         stall_timeout_s=args.stall_timeout)
     # SIGTERM/SIGINT -> graceful drain (the PreemptionGuard pattern: first
-    # signal is cooperative, second aborts). Installed on the main thread.
+    # signal is cooperative, second aborts). SIGUSR2 -> one timed
+    # jax.profiler capture into obs.profile_dir (the POST /profilez
+    # trigger without a client). Installed on the main thread.
     server.front.guard.install()
+    from picotron_tpu.obs import install_sigusr2
+
+    install_sigusr2(server.front.profiler)
     server.start()
     server.front._event(
         "serving", port=server.port, slots=engine.slots,
@@ -758,7 +909,7 @@ def main(argv=None) -> int:
         tp=engine.topo.tp_size)
 
     if args.smoke:
-        rc = _smoke(server)
+        rc = _smoke(server, obs_dump=args.obs_dump)
         print(f"serve-smoke: {'PASS' if rc == 0 else 'FAIL'}", flush=True)
         return rc
 

@@ -197,7 +197,7 @@ def run_supervised(cmd, max_restarts: int = 3, backoff: float = 1.0,
                    quota_window: float = 0.0, quota_backoff: float = 30.0,
                    quota_backoff_max: float = 1800.0,
                    max_launch_retries: int = 120, epoch_file: str = "",
-                   sleep=time.sleep) -> int:
+                   metrics_jsonl: str = "", sleep=time.sleep) -> int:
     """Run ``cmd`` under supervision; returns the exit code to propagate.
     ``stall_timeout`` <= 0 disables stall detection; ``epoch_file`` joins a
     per-host pod (see the module docstring). Importable so the chaos suite
@@ -205,6 +205,11 @@ def run_supervised(cmd, max_restarts: int = 3, backoff: float = 1.0,
     env = dict(os.environ)
     if heartbeat:
         env["PICOTRON_HEARTBEAT"] = heartbeat
+    if metrics_jsonl:
+        # the trainer appends its per-step metrics JSONL here (the
+        # structured surface extract_metrics.py prefers over the log
+        # regex); append semantics make restarts stitch into one file
+        env["PICOTRON_METRICS_JSONL"] = metrics_jsonl
     budget = _RestartBudget(
         max_restarts, backoff, backoff_max, healthy_reset=healthy_reset,
         quota_window=quota_window, quota_backoff=quota_backoff,
@@ -306,7 +311,8 @@ def run_pod(cmd, num_procs: int, max_restarts: int = 3, backoff: float = 1.0,
             poll_interval: float = 0.2, healthy_reset: float = 600.0,
             quota_window: float = 0.0, quota_backoff: float = 30.0,
             quota_backoff_max: float = 1800.0, max_launch_retries: int = 120,
-            coordinator: str = "", sleep=time.sleep) -> int:
+            coordinator: str = "", metrics_jsonl: str = "",
+            sleep=time.sleep) -> int:
     """Supervise an N-process local pod of ``cmd``; returns the exit code
     to propagate. The pod restarts as a unit (see the module docstring);
     restart accounting is shared across ranks through one budget."""
@@ -333,6 +339,12 @@ def run_pod(cmd, num_procs: int, max_restarts: int = 3, backoff: float = 1.0,
             if hb:
                 env["PICOTRON_HEARTBEAT"] = hb
                 _touch(hb)
+            if metrics_jsonl:
+                # only the controller rank writes metrics (train gates on
+                # is_main_process), but export per-rank paths anyway so a
+                # misconfigured pod can never interleave one file
+                env["PICOTRON_METRICS_JSONL"] = (
+                    metrics_jsonl if i == 0 else f"{metrics_jsonl}.p{i}")
             hbs.append(hb)
             procs.append(subprocess.Popen(cmd, env=env))
         rcs: list = [None] * num_procs
@@ -437,6 +449,11 @@ def main(argv=None) -> int:
     parser.add_argument("--epoch-file", default="",
                         help="per-host pods: shared restart-epoch file; a "
                              "bump by any host restarts every host's child")
+    parser.add_argument("--metrics-jsonl", default="",
+                        help="per-step metrics JSONL path exported as "
+                             "PICOTRON_METRICS_JSONL (point it next to the "
+                             "run log; extract_metrics.py prefers it over "
+                             "the log regex; pod ranks > 0 get .p<rank>)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- then the command to supervise")
     args = parser.parse_args(argv)
@@ -462,7 +479,8 @@ def main(argv=None) -> int:
         healthy_reset=args.healthy_reset, quota_window=args.quota_window,
         quota_backoff=args.quota_backoff,
         quota_backoff_max=args.quota_backoff_max,
-        max_launch_retries=args.max_launch_retries)
+        max_launch_retries=args.max_launch_retries,
+        metrics_jsonl=args.metrics_jsonl)
     if args.num_procs > 1:
         return run_pod(cmd, args.num_procs, coordinator=args.coordinator,
                        **common)

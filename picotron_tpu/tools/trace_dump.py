@@ -1,0 +1,183 @@
+"""Dump, validate, and query Chrome-trace JSON from the span tracer.
+
+    # validate a dumped trace (train's obs.trace_path, or a saved /tracez)
+    python -m picotron_tpu.tools.trace_dump trace.json
+
+    # fetch from a live server and save
+    python -m picotron_tpu.tools.trace_dump --url http://127.0.0.1:8000/tracez \
+        --out trace.json
+
+    # additionally require at least one COMPLETE request chain
+    # (queue/prefill -> >=1 dispatch -> delivery, all parented) — the
+    # `make obs-smoke` gate
+    python -m picotron_tpu.tools.trace_dump trace.json --require-request-chain
+
+The file format is the Chrome trace-event "traceEvents" array
+(chrome://tracing, https://ui.perfetto.dev both load it directly);
+``picotron_tpu.obs.tracing.SpanTracer.chrome_trace`` emits it with
+``args.id``/``args.parent`` carrying the span links. ``validate`` checks
+structure (every event named, timestamped, complete events carry ``dur``);
+``dangling_parents`` reports unresolved parent links as WARNINGS only — a
+live ``/tracez`` snapshot legitimately has them (an in-flight request's
+root span isn't in the ring until it ends, and ring eviction drops old
+roots); ``request_chains`` reassembles each request's tree. Exit 1 on any
+validation error (or a missing required chain), so the smoke targets can
+gate on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+# span names the batcher/front end record under a request root
+_CHAIN_DISPATCH = ("decode", "verify")
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def fetch(url: str) -> dict:
+    """GET a /tracez endpoint (stdlib only)."""
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def validate(trace: dict) -> list:
+    """Structural errors in a Chrome-trace dict ([] = valid)."""
+    errors = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["top-level 'traceEvents' must be a list"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        for field in ("name", "ph", "ts", "pid", "tid"):
+            if field not in ev:
+                errors.append(f"event {i}: missing {field!r}")
+        if not isinstance(ev.get("ts", 0), (int, float)):
+            errors.append(f"event {i}: non-numeric ts")
+        if ev.get("ph") == "X":
+            if not isinstance(ev.get("dur"), (int, float)) or ev["dur"] < 0:
+                errors.append(f"event {i}: complete event without a "
+                              f"non-negative dur")
+    return errors
+
+
+def dangling_parents(trace: dict) -> list:
+    """Parent references that resolve to no event id in the trace.
+    Reported as WARNINGS, not errors: a live ``/tracez`` snapshot
+    legitimately contains them — a request's root span only lands in the
+    ring when it ENDS, so an in-flight request's queue_wait/prefill/
+    dispatch children reference a root that isn't exported yet, and ring
+    eviction on a busy server drops old roots before their children."""
+    events = [e for e in trace.get("traceEvents", ())
+              if isinstance(e, dict)]
+    ids = {(e.get("args") or {}).get("id") for e in events}
+    out = []
+    for i, ev in enumerate(events):
+        parent = (ev.get("args") or {}).get("parent")
+        if parent is not None and parent not in ids:
+            out.append(
+                f"event {i} ({ev.get('name')!r}): parent {parent} does "
+                f"not resolve to any event id in the trace (in-flight "
+                f"request or evicted root?)")
+    return out
+
+
+def request_chains(trace: dict) -> dict:
+    """Reassemble per-request span trees: {uid: {"queue_wait", "prefill",
+    "dispatches", "delivery", "complete"}}. A chain is COMPLETE when the
+    request saw a prefill, at least one decode/verify dispatch child, and
+    a delivery — all parented (directly) to the request root."""
+    events = [e for e in trace.get("traceEvents", ())
+              if isinstance(e, dict)]
+    roots = {}  # span id -> uid
+    for ev in events:
+        args = ev.get("args") or {}
+        if ev.get("name") == "request" and "uid" in args:
+            roots[args.get("id")] = args["uid"]
+    chains = {uid: {"queue_wait": False, "prefill": False,
+                    "dispatches": 0, "delivery": False}
+              for uid in roots.values()}
+    for ev in events:
+        args = ev.get("args") or {}
+        uid = roots.get(args.get("parent"))
+        if uid is None:
+            continue
+        c = chains[uid]
+        name = ev.get("name")
+        if name == "queue_wait":
+            c["queue_wait"] = True
+        elif name == "prefill":
+            c["prefill"] = True
+        elif name in _CHAIN_DISPATCH:
+            c["dispatches"] += 1
+        elif name == "delivery":
+            c["delivery"] = True
+    for c in chains.values():
+        c["complete"] = bool(c["prefill"] and c["dispatches"]
+                             and c["delivery"])
+    return chains
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate/query Chrome-trace JSON from the span "
+                    "tracer (obs.tracing; docs/OBSERVABILITY.md)")
+    ap.add_argument("path", nargs="?", help="trace JSON file")
+    ap.add_argument("--url", help="fetch from a live /tracez endpoint "
+                                  "instead of a file")
+    ap.add_argument("--out", help="write the (fetched or loaded) trace "
+                                  "back out — save a live /tracez")
+    ap.add_argument("--require-request-chain", nargs="?", const="any",
+                    default=None, metavar="UID",
+                    help="fail unless a COMPLETE request chain exists "
+                         "(prefill -> >=1 dispatch -> delivery); pass a "
+                         "UID to require that specific request's")
+    args = ap.parse_args(argv)
+    if not args.path and not args.url:
+        ap.error("pass a trace file path or --url")
+
+    trace = fetch(args.url) if args.url else load(args.path)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(trace, f)
+    errors = validate(trace)
+    for e in errors:
+        print(f"INVALID: {e}", file=sys.stderr)
+    for w in dangling_parents(trace):
+        print(f"WARN: {w}", file=sys.stderr)
+    n = len(trace.get("traceEvents", ()))
+    chains = request_chains(trace)
+    complete = sorted(u for u, c in chains.items() if c["complete"])
+    print(f"{n} events, {len(chains)} request chains "
+          f"({len(complete)} complete)")
+    for uid, c in sorted(chains.items()):
+        print(f"  {uid}: queue_wait={c['queue_wait']} "
+              f"prefill={c['prefill']} dispatches={c['dispatches']} "
+              f"delivery={c['delivery']} "
+              f"{'COMPLETE' if c['complete'] else 'partial'}")
+    if errors:
+        return 1
+    want = args.require_request_chain
+    if want is not None:
+        ok = bool(complete) if want == "any" \
+            else chains.get(want, {}).get("complete", False)
+        if not ok:
+            print(f"FAILED: no complete request chain"
+                  f"{'' if want == 'any' else f' for uid {want!r}'}",
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,17 @@ final save via try/finally; re-running the same command auto-resumes from
 the latest checkpoint; per-step losses feed an EMA anomaly detector with
 skip/rollback/abort policies; and a config-driven chaos injector gives all
 of it a deterministic test surface (``make chaos-smoke``).
+
+Telemetry (picotron_tpu/obs, docs/OBSERVABILITY.md): the controller
+process writes a per-step metrics JSONL (``$PICOTRON_METRICS_JSONL`` /
+``obs.metrics_jsonl``) that ``tools/extract_metrics.py`` ingests instead
+of regex-scraping the log; every dispatch records data/dispatch/host-sync
+spans (plus checkpoint and consensus-tick spans) into the process trace
+ring, dumped as Chrome-trace JSON at exit when ``obs.trace_path`` is set;
+rollbacks, anomalies, consensus adoptions, and emergency saves count in
+the metrics registry, whose snapshot lands as the JSONL's final summary
+row. ``kill -USR2 <pid>`` grabs a timed ``jax.profiler`` capture into
+``obs.profile_dir`` without restarting the run.
 """
 
 from __future__ import annotations
@@ -129,6 +140,8 @@ def train(cfg, max_steps_override: Optional[int] = None,
     from picotron_tpu import utils
     from picotron_tpu.data import MicroBatchDataLoader
     from picotron_tpu.models import llama
+    from picotron_tpu.obs import GLOBAL_REGISTRY, MetricsJsonl, Obs
+    from picotron_tpu.obs.jsonl import resolve_path as jsonl_path
     from picotron_tpu.resilience.anomaly import AnomalyAbort, LossAnomalyDetector
     from picotron_tpu.resilience.chaos import ChaosInjector
     from picotron_tpu.resilience.cluster import ClusterCoordinator, ClusterMonitor
@@ -171,6 +184,18 @@ def train(cfg, max_steps_override: Optional[int] = None,
     # <hb>.p<i>); a config path carried over from single-host use would
     # leave the watched files untouched and stall-kill a healthy pod.
     heartbeat = os.environ.get("PICOTRON_HEARTBEAT", "") or r.heartbeat_path
+    # Telemetry (docs/OBSERVABILITY.md): per-run registry + the process
+    # span ring; the per-step metrics JSONL replaces log-scraping
+    # (controller process only — same gating as the log/wandb reports).
+    obs = Obs.from_config(cfg.obs)
+    jpath = jsonl_path(cfg.obs)
+    jsonl = (MetricsJsonl(jpath, log=utils.log0)
+             if jpath and utils.is_main_process() else None)
+    rollbacks_ctr = obs.registry.counter(
+        "picotron_rollbacks_total", "anomaly rollbacks taken")
+    adoptions_ctr = obs.registry.counter(
+        "picotron_consensus_adoptions_total",
+        "peer preemption verdicts adopted via consensus")
 
     # state the finally below may touch — defined before anything can raise
     manager = None
@@ -263,14 +288,18 @@ def train(cfg, max_steps_override: Optional[int] = None,
             # the same collective emergency save — on ALL hosts. A locally-
             # set flag between rounds waits for the next round; breaking
             # alone would tear the collective save.
-            preempt = (coord.preempt_now(step, guard.triggered)
-                       if coord is not None else guard.triggered)
+            if coord is not None:
+                with obs.tracer.span("consensus_tick", step=step):
+                    preempt = coord.preempt_now(step, guard.triggered)
+            else:
+                preempt = guard.triggered
             if preempt:
                 if not guard.triggered:
                     # a peer's signal, learned via consensus: adopt it so the
                     # emergency-save path and the exit code behave exactly
                     # like a locally-signaled host (this host's OWN copy of
                     # the pod-wide SIGTERM stays benign, not an escalation)
+                    adoptions_ctr.inc()
                     guard.adopt()
                 utils.log0(f"preemption: {guard.signame} received; flushing "
                            f"checkpoint at step {step} and exiting "
@@ -303,7 +332,9 @@ def train(cfg, max_steps_override: Optional[int] = None,
             if k > 1:
                 tokens, targets = ts.shard_batch_stack(
                     [next(loader) for _ in range(k)], topo)
+                t_disp = time.perf_counter()
                 params, opt_state, loss_arr = step_fn(params, opt_state, tokens, targets)
+                t_sync = time.perf_counter()
                 losses = [float(x) for x in utils.host_values(loss_arr)]
             else:
                 tokens, targets = ts.shard_batch(next(loader), topo)
@@ -316,10 +347,25 @@ def train(cfg, max_steps_override: Optional[int] = None,
                     if step_fn_single is None:
                         step_fn_single = ts.build_train_step(cfg, topo)
                     fn = step_fn_single
+                t_disp = time.perf_counter()
                 params, opt_state, loss_arr = fn(
                     params, opt_state, tokens, targets)
+                t_sync = time.perf_counter()
                 losses = [float(utils.host_values(loss_arr))]
-            dt_call = time.perf_counter() - t_start
+            t_end = time.perf_counter()
+            dt_call = t_end - t_start
+            # per-dispatch spans: data (batch build) -> dispatch (async
+            # submit) -> host_sync (blocked on device losses), parented
+            # under one train/dispatch root — the serving trace's exact
+            # counterpart, dumped at exit via obs.trace_path
+            droot = obs.tracer.record("train/dispatch", t_start, t_end,
+                                      step=step_before + 1, steps=k)
+            obs.tracer.record("data", t_start, t_disp, parent=droot)
+            obs.tracer.record("dispatch", t_disp, t_sync, parent=droot)
+            obs.tracer.record("host_sync", t_sync, t_end, parent=droot)
+            obs.registry.histogram(
+                "picotron_train_dispatch_seconds",
+                "train dispatch wall time (k fused steps)").observe(dt_call)
 
             # Throughput is per dispatch (identical for every step in the group);
             # mfu/memory are computed lazily, once, and only if a step logs.
@@ -334,6 +380,10 @@ def train(cfg, max_steps_override: Optional[int] = None,
                     loss_history.append((step, loss))
                 anom = detector.observe(step, loss)
                 if anom is not None:
+                    obs.registry.counter(
+                        "picotron_loss_anomalies_total",
+                        "loss anomalies flagged, by kind",
+                        kind=anom.kind).inc()
                     utils.log0(
                         f"loss anomaly at step {step}: loss={loss:.6g} "
                         f"kind={anom.kind} consecutive={anom.consecutive} "
@@ -371,6 +421,19 @@ def train(cfg, max_steps_override: Optional[int] = None,
                                **({"mfu": mfu} if mfu is not None else {}),
                                **({"memory_gb": mem} if mem is not None else {})},
                               step=step)
+                if jsonl is not None:
+                    # EVERY step, not just log-frequency ones: the JSONL
+                    # is the machine surface, the log line the human one.
+                    # mfu/memory stay null off log steps (they are only
+                    # computed there); extract_metrics averages over the
+                    # non-null rows exactly as it did for the regex path.
+                    jsonl.write({
+                        "step": step, "loss": loss,
+                        "tokens_per_sec": tok_s,
+                        "tokens_per_sec_per_chip": tok_s_chip,
+                        "trained_tokens": trained_tokens,
+                        "mfu_pct": mfu, "memory_gb": mem,
+                        "t": round(time.time(), 3)})
 
             # Save at group boundaries only: params here are the end-of-group
             # state, so the recorded step must be the end-of-group step.
@@ -381,8 +444,10 @@ def train(cfg, max_steps_override: Optional[int] = None,
             if (manager is not None and c.save_frequency > 0
                     and not do_rollback
                     and step // c.save_frequency > step_before // c.save_frequency):
-                manager.save(step, params, opt_state, trained_tokens, layout=layout,
-                             zero1=z1, data_meta=loader.state_meta(step))
+                with obs.tracer.span("checkpoint", step=step):
+                    manager.save(step, params, opt_state, trained_tokens,
+                                 layout=layout, zero1=z1,
+                                 data_meta=loader.state_meta(step))
                 last_saved_step = step
 
             if monitor is not None:
@@ -395,12 +460,14 @@ def train(cfg, max_steps_override: Optional[int] = None,
                         f"rollback requested at step {step} but no "
                         f"checkpoint exists under {c.save_dir}")
                 rollbacks += 1
+                rollbacks_ctr.inc()
                 if rollbacks > r.max_rollbacks:
                     raise AnomalyAbort(
                         f"anomaly persisted through {r.max_rollbacks} "
                         f"rollbacks; aborting at step {step}")
-                params, opt_state, step, trained_tokens = manager.load(
-                    params, opt_state, layout=layout, zero1=z1)
+                with obs.tracer.span("rollback", step=step):
+                    params, opt_state, step, trained_tokens = manager.load(
+                        params, opt_state, layout=layout, zero1=z1)
                 loader.seek_steps(step)
                 detector.reset()
                 last_saved_step = step
@@ -451,6 +518,21 @@ def train(cfg, max_steps_override: Optional[int] = None,
                 monitor.stop(mark_done=sys.exc_info()[0] is None)
             if wandb is not None:
                 wandb.finish()
+            if jsonl is not None:
+                # the run's registry snapshot (rollbacks, anomalies,
+                # adoptions, retries, emergency saves, dispatch timing)
+                # rides out as the terminal summary row — consumers key
+                # rows on "step" and skip it
+                jsonl.write({"event": "summary",
+                             "metrics": {**obs.registry.summary(),
+                                         **GLOBAL_REGISTRY.summary()}})
+                jsonl.close()
+            if cfg.obs.trace_path and utils.is_main_process() \
+                    and obs.enabled:
+                try:
+                    obs.tracer.dump_chrome(cfg.obs.trace_path)
+                except OSError as e:
+                    utils.log0(f"trace dump failed: {e!r}")
     return step, trained_tokens, loss
 
 
@@ -471,6 +553,14 @@ def main(argv=None):
     cfg = Config.from_dict(raw)
     _ensure_devices(cfg)
     _maybe_init_distributed()
+    if cfg.obs.enabled:
+        # kill -USR2 <pid> -> one timed jax.profiler capture into
+        # obs.profile_dir: the "this run is slow RIGHT NOW" surface,
+        # no restart or pre-planned profile window needed
+        from picotron_tpu.obs import ProfileCapture, install_sigusr2
+
+        install_sigusr2(ProfileCapture(
+            cfg.obs.profile_dir, cfg.obs.profile_seconds, log=log0))
     from picotron_tpu import resilience
     from picotron_tpu.resilience.anomaly import AnomalyAbort
 

@@ -1,0 +1,578 @@
+"""Unified telemetry suite (ISSUE 10; docs/OBSERVABILITY.md).
+
+The observability plane's contracts:
+
+- registry correctness under concurrency (N threads hammering counters
+  while snapshotters read — totals exact, no lock held across user code);
+- histogram bucket-edge semantics (le-inclusive, cumulative rendering,
+  +Inf == count) and exact percentiles over the bounded window;
+- CounterDict: plain-dict surface, every write mirrored to the registry;
+- span tracer: parent links, ring overflow, Chrome-trace JSON validity,
+  instant events; trace_dump's validation/chain queries;
+- end-to-end: the batcher's /statz numbers == the registry's /metrics
+  numbers; one serve request's COMPLETE parented chain in /tracez; a
+  timed /profilez capture; train's per-step metrics JSONL ingested by
+  extract_metrics without the regex path; obs.enabled: false no-ops.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from conftest import make_config
+from picotron_tpu import obs as obs_mod
+from picotron_tpu.inference import ContinuousBatcher, InferenceEngine, Request
+from picotron_tpu.models import llama
+from picotron_tpu.obs import (
+    GLOBAL_REGISTRY,
+    GLOBAL_TRACER,
+    MetricsRegistry,
+    NullTracer,
+    Obs,
+    SpanTracer,
+)
+from picotron_tpu.obs.metrics import (
+    CounterDict,
+    NullRegistry,
+    parse_prometheus,
+)
+from picotron_tpu.tools import trace_dump
+
+MAX_LEN = 64
+
+_TINY = dict(
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    hidden_size=32, intermediate_size=64, vocab_size=128,
+    max_position_embeddings=MAX_LEN, rope_theta=10000.0, dtype="float32",
+    attention_impl="sdpa")
+
+
+def _engine(slots=2, **inf):
+    cfg = make_config(dict(_TINY), seq=32)
+    for k, v in inf.items():
+        setattr(cfg.inference, k, v)
+    engine = InferenceEngine(cfg, slots=slots, max_seq_len=MAX_LEN)
+    params = engine.shard_params(jax.jit(
+        lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
+    return cfg, engine, params
+
+
+# --------------------------------------------------------------------------- #
+# registry
+# --------------------------------------------------------------------------- #
+
+
+def test_counter_concurrency_exact():
+    """N threads x M increments with concurrent snapshot/prometheus
+    readers: the final value is exactly N*M (no lost updates) and no
+    reader ever crashes or deadlocks."""
+    reg = MetricsRegistry()
+    c = reg.counter("hammer_total", "concurrency test")
+    n_threads, m = 8, 500
+    stop = threading.Event()
+    reader_errs = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                reg.snapshot()
+                reg.prometheus()
+            except Exception as e:  # noqa: BLE001 - the assertion payload
+                reader_errs.append(e)
+                return
+
+    def writer():
+        for _ in range(m):
+            c.inc()
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    writers = [threading.Thread(target=writer) for _ in range(n_threads)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join(30)
+    stop.set()
+    for t in readers:
+        t.join(30)
+    assert not reader_errs
+    assert c.value == n_threads * m
+    assert parse_prometheus(reg.prometheus())["hammer_total"] == n_threads * m
+
+
+def test_histogram_concurrent_observe_count_exact():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds")
+    n_threads, m = 6, 400
+
+    def writer():
+        for i in range(m):
+            h.observe(1e-4 * (i + 1))
+
+    threads = [threading.Thread(target=writer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    r = h.read()
+    assert r["count"] == n_threads * m
+    assert sum(r["counts"]) + r["inf"] == r["count"]
+
+
+def test_histogram_bucket_edges():
+    """Prometheus 'le' is INCLUSIVE: a value exactly on a bound lands in
+    that bucket; above the last bound lands in +Inf; the cumulative
+    rendering ends at _count."""
+    reg = MetricsRegistry()
+    h = reg.histogram("edges_seconds", buckets=(0.001, 0.01, 0.1))
+    for v in (0.001, 0.0005, 0.01, 0.05, 0.1, 99.0):
+        h.observe(v)
+    r = h.read()
+    assert r["counts"] == [2, 1, 2]  # per-bucket, le-inclusive
+    assert r["inf"] == 1
+    assert r["count"] == 6
+    assert r["sum"] == pytest.approx(0.001 + 0.0005 + 0.01 + 0.05 + 0.1 + 99)
+    prom = parse_prometheus(reg.prometheus())
+    assert prom['edges_seconds_bucket{le="0.001"}'] == 2
+    assert prom['edges_seconds_bucket{le="0.01"}'] == 3  # cumulative
+    assert prom['edges_seconds_bucket{le="0.1"}'] == 5
+    assert prom['edges_seconds_bucket{le="+Inf"}'] == 6
+    assert prom["edges_seconds_count"] == 6
+
+
+def test_histogram_percentiles_window():
+    """Exact percentiles over the retained window; the oldest samples
+    drop past sample_window (the /statz recent-window semantics)."""
+    reg = MetricsRegistry(sample_window=100)
+    h = reg.histogram("w_seconds")
+    for v in range(1000):  # only the last 100 (900..999) retained
+        h.observe(float(v))
+    p = h.percentiles()
+    assert p["n"] == 100
+    assert p["p50"] == pytest.approx(np.percentile(np.arange(900, 1000), 50))
+    assert reg.histogram("w_seconds") is h  # get-or-create
+    assert reg.histogram("empty_seconds").percentiles() is None
+
+
+def test_histogram_rejects_bad_buckets():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError, match="strictly increasing"):
+        reg.histogram("bad", buckets=(0.1, 0.1))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("bad")  # name taken by a histogram family
+
+
+def test_counter_dict_semantics_and_mirror():
+    """The exact surface the batcher/serve counters rely on: dict
+    equality, dict(), += — with every write mirrored into the labeled
+    family (including keys born after construction)."""
+    reg = MetricsRegistry()
+    d = reg.counter_dict("req_total", ("a", "b"), label="state")
+    assert d == {"a": 0, "b": 0}
+    d["a"] += 1
+    d["a"] += 1
+    d["b"] += 1
+    d["late"] = 3  # unknown key: plain-dict write + lazy child
+    assert dict(d) == {"a": 2, "b": 1, "late": 3}
+    prom = parse_prometheus(reg.prometheus())
+    assert prom['req_total{state="a"}'] == 2
+    assert prom['req_total{state="b"}'] == 1
+    assert prom['req_total{state="late"}'] == 3
+
+
+def test_gauge_and_summary():
+    reg = MetricsRegistry()
+    reg.gauge("depth").set(7)
+    reg.counter("n_total").inc(3)
+    reg.histogram("h_seconds").observe(0.5)
+    s = reg.summary()
+    assert s["depth"] == 7 and s["n_total"] == 3
+    assert s["h_seconds"]["count"] == 1
+    assert s["h_seconds"]["p50"] == pytest.approx(0.5)
+
+
+def test_null_registry_and_disabled_obs():
+    o = Obs(enabled=False)
+    assert isinstance(o.registry, NullRegistry)
+    assert isinstance(o.tracer, NullTracer)
+    o.registry.counter("x").inc()
+    o.registry.histogram("y").observe(1.0)
+    with o.tracer.span("s"):
+        pass
+    assert o.registry.prometheus() == "" and o.registry.snapshot() == {}
+    assert o.tracer.spans() == []
+    d = CounterDict(o.registry, "z", ("k",))
+    d["k"] += 1
+    assert d == {"k": 1}  # local dict still authoritative
+
+
+# --------------------------------------------------------------------------- #
+# span tracer + trace_dump
+# --------------------------------------------------------------------------- #
+
+
+def test_span_parent_links_and_chrome_validity():
+    tr = SpanTracer(ring=64)
+    root = tr.begin("request", uid="r1")
+    with tr.span("prefill", parent=root, prompt_tokens=3):
+        pass
+    tr.record("decode", 1.0, 2.0, parent=root, tokens=4)
+    tr.instant("comm/all_reduce", axis="tp")
+    tr.end(root, finish_reason="length")
+    trace = tr.chrome_trace()
+    assert trace_dump.validate(trace) == []
+    by_name = {e["name"]: e for e in trace["traceEvents"]}
+    rid = by_name["request"]["args"]["id"]
+    assert by_name["prefill"]["args"]["parent"] == rid
+    assert by_name["decode"]["args"]["parent"] == rid
+    assert by_name["decode"]["dur"] == pytest.approx(1e6)
+    assert by_name["comm/all_reduce"]["ph"] == "i"
+    assert by_name["request"]["ph"] == "X"
+
+
+def test_span_ring_overflow_keeps_latest():
+    tr = SpanTracer(ring=4)
+    for i in range(10):
+        tr.record(f"s{i}", float(i), float(i) + 0.5)
+    names = [s.name for s in tr.spans()]
+    assert names == ["s6", "s7", "s8", "s9"]
+    tr.resize(8)  # grow-only, retained spans survive
+    assert [s.name for s in tr.spans()] == names
+    tr.resize(2)  # shrink requests are ignored
+    assert len(tr.spans()) == 4
+
+
+def test_scoped_span_records_exception():
+    tr = SpanTracer(ring=8)
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    (s,) = tr.spans()
+    assert s.args["error"] == "RuntimeError"
+
+
+def test_trace_dump_validate_catches_defects():
+    assert trace_dump.validate({}) == ["top-level 'traceEvents' must be "
+                                       "a list"]
+    bad = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 1, "pid": 1, "tid": 1},  # no dur
+        {"name": "b", "ph": "i", "ts": 1, "pid": 1, "tid": 1,
+         "args": {"id": 2, "parent": 99}},  # dangling parent
+    ]}
+    errs = trace_dump.validate(bad)
+    assert any("dur" in e for e in errs)
+    # a dangling parent is a WARNING, never a validation error: a live
+    # /tracez snapshot has in-flight requests whose root span isn't in
+    # the ring yet (it lands at end()), and ring eviction drops old roots
+    assert not any("parent" in e for e in errs)
+    warns = trace_dump.dangling_parents(bad)
+    assert any("parent 99" in w for w in warns)
+    assert trace_dump.dangling_parents(
+        {"traceEvents": [{"name": "c", "ph": "i", "ts": 0, "pid": 1,
+                          "tid": 1, "args": {"id": 5, "parent": 5}}]}) == []
+
+
+def test_trace_dump_cli_roundtrip(tmp_path):
+    tr = SpanTracer(ring=16)
+    root = tr.begin("request", uid="u1")
+    tr.record("prefill", 0.0, 0.1, parent=root)
+    tr.record("decode", 0.1, 0.2, parent=root)
+    tr.record("delivery", 0.2, 0.21, parent=root)
+    tr.end(root)
+    path = tmp_path / "trace.json"
+    tr.dump_chrome(str(path))
+    assert trace_dump.main([str(path), "--require-request-chain"]) == 0
+    assert trace_dump.main([str(path), "--require-request-chain",
+                            "u1"]) == 0
+    assert trace_dump.main([str(path), "--require-request-chain",
+                            "nope"]) == 1
+    # an incomplete chain (no delivery) fails the gate
+    tr2 = SpanTracer(ring=16)
+    r2 = tr2.begin("request", uid="u2")
+    tr2.record("prefill", 0.0, 0.1, parent=r2)
+    tr2.end(r2)
+    p2 = tmp_path / "t2.json"
+    tr2.dump_chrome(str(p2))
+    assert trace_dump.main([str(p2)]) == 0  # valid, just partial
+    assert trace_dump.main([str(p2), "--require-request-chain"]) == 1
+
+
+# --------------------------------------------------------------------------- #
+# engine/batcher integration
+# --------------------------------------------------------------------------- #
+
+
+def test_batcher_stats_agree_with_registry():
+    """/statz and /metrics are two renderings of the SAME instruments:
+    the counters, token totals, dispatch counts, and percentile payloads
+    must agree exactly."""
+    GLOBAL_TRACER.clear()
+    cfg, engine, params = _engine(slots=2)
+    b = ContinuousBatcher(engine, params)
+    b.run([Request(f"q{i}", [3 + i, 7 + i], max_new_tokens=4)
+           for i in range(3)])
+    s = b.stats()
+    prom = parse_prometheus(engine.obs.registry.prometheus())
+    assert prom['picotron_requests_total{state="completed"}'] == \
+        s["completed"] == 3
+    assert prom['picotron_requests_total{state="admitted"}'] == 3
+    assert prom["picotron_generated_tokens_total"] == \
+        s["generated_tokens"] == 12
+    assert prom['picotron_dispatch_total{kind="prefill"}'] == \
+        s["prefill_dispatches"]
+    assert prom["picotron_queue_wait_seconds_count"] == \
+        s["queue_wait_s"]["n"] == 3
+    assert prom["picotron_ttft_seconds_count"] == s["ttft_s"]["n"] == 3
+    assert prom["picotron_queue_depth"] == 0
+    assert prom["picotron_active_slots"] == 0
+    # dispatch latency histogram counted one entry per decode dispatch
+    assert prom['picotron_dispatch_seconds_count{kind="decode"}'] == \
+        b.decode_dispatches
+    # the span ring holds each request's prefill + >= 1 decode child
+    chains = trace_dump.request_chains(GLOBAL_TRACER.chrome_trace())
+    assert set(chains) == {"q0", "q1", "q2"}
+    for c in chains.values():
+        assert c["queue_wait"] and c["prefill"] and c["dispatches"] >= 1
+
+
+def test_speculative_round_spans_carry_accept_counts():
+    GLOBAL_TRACER.clear()
+    cfg, engine, params = _engine(slots=2, spec_len=3)
+    b = ContinuousBatcher(engine, params)
+    b.run([Request("s0", [5, 6, 7], max_new_tokens=6)])
+    prom = parse_prometheus(engine.obs.registry.prometheus())
+    assert prom["picotron_draft_proposed_total"] == b.draft_proposed > 0
+    assert prom["picotron_draft_accepted_total"] == b.draft_accepted
+    verifies = [s for s in GLOBAL_TRACER.spans() if s.name == "verify"]
+    assert verifies and all("accepted" in s.args and
+                            s.args["draft_len"] == 3 for s in verifies)
+
+
+def test_obs_disabled_batcher_runs_and_records_nothing():
+    cfg = make_config(dict(_TINY), seq=32)
+    cfg.obs.enabled = False
+    engine = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN)
+    params = engine.shard_params(jax.jit(
+        lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
+    b = ContinuousBatcher(engine, params)
+    res = b.run([Request("q", [3, 4, 5], max_new_tokens=4)])
+    assert res["q"].finish_reason == "length" and res["q"].span_id is None
+    assert b.counters["completed"] == 1  # the dict view still works
+    assert engine.obs.registry.prometheus() == ""
+    s = b.stats()
+    assert s["queue_wait_s"] is None and s["ttft_s"] is None
+
+
+def test_obs_disabled_output_identical():
+    """The acceptance bit: obs off produces byte-identical generations to
+    obs on (the instruments never touch the PRNG chain or the dispatch
+    path)."""
+    reqs = [Request(f"q{i}", [3 + i, 9 + i], max_new_tokens=6,
+                    temperature=0.8) for i in range(3)]
+    _, e_on, p_on = _engine(slots=2)
+    on = ContinuousBatcher(e_on, p_on, seed=11).run(
+        [Request(**vars(r)) for r in reqs])
+    cfg = make_config(dict(_TINY), seq=32)
+    cfg.obs.enabled = False
+    e_off = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN)
+    p_off = e_off.shard_params(jax.jit(
+        lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
+    off = ContinuousBatcher(e_off, p_off, seed=11).run(
+        [Request(**vars(r)) for r in reqs])
+    for uid in on:
+        assert on[uid].tokens == off[uid].tokens
+        assert on[uid].finish_reason == off[uid].finish_reason
+
+
+# --------------------------------------------------------------------------- #
+# serve integration: /metrics, /tracez, /profilez
+# --------------------------------------------------------------------------- #
+
+
+def _server(slots=2, **front_kw):
+    from picotron_tpu.tools import serve
+
+    cfg, engine, params = _engine(slots=slots)
+    front_kw.setdefault("log", lambda *a, **k: None)
+    srv = serve.Server(engine, params, port=0, **front_kw)
+    srv.start()
+    return cfg, srv
+
+
+def test_serve_metrics_tracez_profilez(tmp_path):
+    from picotron_tpu.tools import serve
+
+    GLOBAL_TRACER.clear()
+    cfg, srv = _server()
+    try:
+        port = srv.port
+        st, body = serve._post(port, {"prompt": [1, 2, 3],
+                                      "max_new_tokens": 5, "uid": "m1"})
+        assert st == 200
+        st, stats = serve._get(port, "/statz")
+        mst, mtext = serve._get_text(port, "/metrics")
+        assert mst == 200
+        prom = parse_prometheus(mtext)
+        assert prom['picotron_requests_total{state="completed"}'] == \
+            stats["completed"]
+        assert prom['picotron_rejections_total{reason="queue_full"}'] == 0
+        # /tracez: the request's chain is COMPLETE (queue wait ->
+        # prefill -> >= 1 dispatch -> delivery), all parented
+        tst, trace = serve._get(port, "/tracez")
+        assert tst == 200 and trace_dump.validate(trace) == []
+        chains = trace_dump.request_chains(trace)
+        assert chains["m1"]["complete"], chains
+        # /profilez: one timed capture lands real files; a second start
+        # while running is 409
+        prof = tmp_path / "prof"
+        pst, pbody = serve._profilez_post(
+            port, {"seconds": 0.8, "dir": str(prof)})
+        assert pst == 200 and pbody["ok"]
+        pst2, pbody2 = serve._profilez_post(
+            port, {"seconds": 0.8, "dir": str(prof)})
+        assert pst2 == 409 and "already running" in pbody2["error"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and srv.front.profiler.running:
+            time.sleep(0.05)
+        assert srv.front.profiler.captures == 1
+        assert prof.is_dir() and list(prof.iterdir())
+        pst3, pbody3 = serve._profilez_post(port, {"seconds": -1})
+        assert pst3 == 400 and "seconds" in pbody3["error"]
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+# --------------------------------------------------------------------------- #
+# train integration: metrics JSONL + trace dump
+# --------------------------------------------------------------------------- #
+
+
+def _train_cfg(tmp_path, **obs_kw):
+    cfg = make_config(dict(_TINY), seq=32, total_train_steps=4)
+    for k, v in obs_kw.items():
+        setattr(cfg.obs, k, v)
+    return cfg
+
+
+def test_train_writes_metrics_jsonl_and_trace(tmp_path):
+    from picotron_tpu.tools import extract_metrics as em
+    from picotron_tpu.train import train
+
+    run = tmp_path / "run_dp1_tp1_mbs2_sl32"
+    run.mkdir()
+    cfg = _train_cfg(tmp_path,
+                     metrics_jsonl=str(run / "metrics.jsonl"),
+                     trace_path=str(run / "trace.json"))
+    step, tokens, loss = train(cfg)
+    assert step == 4
+    rows = em.parse_jsonl_file(str(run / "metrics.jsonl"))
+    assert [r["step"] for r in rows] == [1, 2, 3, 4]
+    assert all(np.isfinite(r["loss"]) for r in rows)
+    # the terminal summary row carries the registry snapshot and is NOT
+    # a step row
+    last = [json.loads(l) for l in
+            open(run / "metrics.jsonl") if l.strip()][-1]
+    assert last.get("event") == "summary"
+    assert "picotron_train_dispatch_seconds" in last["metrics"]
+    # extract_metrics ingests the run WITHOUT any log present (and with
+    # a decoy log whose regex rows would disagree, the JSONL wins)
+    (run / "log.out").write_text(
+        "Step: 9 | Loss: 1.0 | Global batch size: 1 | "
+        "Tokens/s: 1.00K | Tokens/s/chip: 1.00K | Tokens: 1\n")
+    out = em.extract(str(tmp_path))
+    assert len(out) == 1
+    assert out[0]["num_steps"] == 1  # 4 steps - 3 warmup
+    assert out[0]["final_loss"] == pytest.approx(rows[-1]["loss"])
+    # the dumped trace is valid Chrome-trace JSON with train spans
+    trace = trace_dump.load(str(run / "trace.json"))
+    assert trace_dump.validate(trace) == []
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"train/dispatch", "data", "dispatch", "host_sync"} <= names
+
+
+def test_train_metrics_jsonl_env_override(tmp_path, monkeypatch):
+    from picotron_tpu.train import train
+
+    env_path = tmp_path / "env.jsonl"
+    monkeypatch.setenv("PICOTRON_METRICS_JSONL", str(env_path))
+    cfg = _train_cfg(tmp_path, metrics_jsonl=str(tmp_path / "cfg.jsonl"))
+    train(cfg, max_steps_override=2)
+    assert env_path.exists()  # the supervisor's export wins
+    assert not (tmp_path / "cfg.jsonl").exists()
+
+
+def test_train_obs_disabled_writes_nothing(tmp_path):
+    from picotron_tpu.train import train
+
+    cfg = _train_cfg(tmp_path, enabled=False,
+                     metrics_jsonl=str(tmp_path / "m.jsonl"),
+                     trace_path=str(tmp_path / "t.json"))
+    step, _, loss = train(cfg, max_steps_override=2)
+    assert step == 2 and np.isfinite(loss)
+    assert not (tmp_path / "m.jsonl").exists()
+    assert not (tmp_path / "t.json").exists()
+
+
+# --------------------------------------------------------------------------- #
+# resilience + comm_trace feeds
+# --------------------------------------------------------------------------- #
+
+
+def test_retry_counts_into_global_registry():
+    from picotron_tpu.resilience.retry import retry
+
+    before = GLOBAL_REGISTRY.counter(
+        "picotron_retries_total", desc="obs-test").value
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("flake")
+        return "ok"
+
+    assert retry(flaky, attempts=3, backoff=0, jitter=0,
+                 desc="obs-test", sleep=lambda s: None) == "ok"
+    after = GLOBAL_REGISTRY.counter(
+        "picotron_retries_total", desc="obs-test").value
+    assert after - before == 2  # two failed attempts counted
+
+
+def test_emergency_save_outcomes_counted():
+    from picotron_tpu.resilience.preemption import PreemptionGuard
+
+    def val(outcome):
+        return GLOBAL_REGISTRY.counter(
+            "picotron_emergency_saves_total", outcome=outcome).value
+
+    g = PreemptionGuard()
+    c0, f0 = val("completed"), val("failed")
+    assert g.emergency_save(lambda: None) is True
+    with pytest.raises(RuntimeError):
+        g.emergency_save(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    assert val("completed") == c0 + 1
+    assert val("failed") == f0 + 1
+
+
+def test_comm_trace_records_instant_events(monkeypatch, capsys):
+    from picotron_tpu import comm_trace
+
+    GLOBAL_TRACER.clear()
+    monkeypatch.setenv("PICOTRON_VERBOSE", "1")
+    x = np.ones((2, 4), np.float32)
+    out = comm_trace.log("all_reduce", "tp", x)
+    assert out is x  # identity on the value, as before
+    (s,) = [s for s in GLOBAL_TRACER.spans()
+            if s.name == "comm/all_reduce"]
+    assert s.args["axis"] == "tp" and s.args["shape"] == "(2, 4)"
+    assert "[comm] all_reduce" in capsys.readouterr().err
+    # verbose off: no stderr line AND no span
+    GLOBAL_TRACER.clear()
+    monkeypatch.setenv("PICOTRON_VERBOSE", "0")
+    comm_trace.log("all_gather", "tp", x)
+    assert GLOBAL_TRACER.spans() == []

@@ -218,6 +218,65 @@ def test_from_readable_format():
     assert em.from_readable_format("7") == 7.0
 
 
+# ----------------------------------------------- metrics JSONL (obs) source
+
+
+def _jsonl_row(step, loss, tps):
+    import json
+
+    return json.dumps({"step": step, "loss": loss, "tokens_per_sec": tps,
+                       "tokens_per_sec_per_chip": tps, "trained_tokens": 1,
+                       "mfu_pct": None, "memory_gb": None, "t": 0.0})
+
+
+def test_parse_jsonl_file_rows_and_junk(tmp_path):
+    """Step rows come back in parse_log_file's shape; the summary row,
+    corrupt lines, and a truncated tail (killed run) are skipped without
+    losing the steps before them."""
+    p = tmp_path / "metrics.jsonl"
+    p.write_text(
+        _jsonl_row(1, 10.5, 1000.0) + "\n"
+        + "not json at all\n"
+        + _jsonl_row(2, 9.5, 2000.0) + "\n"
+        + '{"event": "summary", "metrics": {}}\n'
+        + '{"step": 3, "loss": 9.0, "tokens_per_sec": 3000.0'  # truncated
+    )
+    rows = em.parse_jsonl_file(str(p))
+    assert [r["step"] for r in rows] == [1, 2]
+    assert rows[0]["loss"] == pytest.approx(10.5)
+    assert rows[1]["tokens_per_sec_per_chip"] == pytest.approx(2000.0)
+    assert rows[0]["mfu_pct"] is None and rows[0]["memory_gb"] is None
+
+
+def test_extract_prefers_jsonl_over_log(tmp_path):
+    """A run dir with BOTH sources: the structured JSONL wins and the
+    disagreeing legacy log is never regex-scraped."""
+    run = tmp_path / "smollm_dp2_tp4_pp1_cp1_mbs1_ga8_sl2048"
+    run.mkdir()
+    (run / "log.out").write_text(SAMPLE_LOG)  # says final_loss 8.0
+    (run / em.JSONL_NAME).write_text(
+        "\n".join(_jsonl_row(s, 20.0 - s, 5000.0) for s in range(1, 6))
+        + "\n")
+    rows = em.extract(str(tmp_path))
+    assert len(rows) == 1
+    assert rows[0]["final_loss"] == pytest.approx(15.0)  # JSONL, not 8.0
+    assert rows[0]["tokens_per_sec_per_chip"] == pytest.approx(5000.0)
+    assert (rows[0]["dp"], rows[0]["tp"]) == (2, 4)  # folder parse intact
+
+
+def test_extract_falls_back_to_legacy_log(tmp_path):
+    """An empty/corrupt JSONL (or none at all) drops to the regex path —
+    pre-obs runs keep extracting exactly as before."""
+    run = tmp_path / "smollm_dp1_tp1_pp1_cp1_mbs1_ga1_sl2048"
+    run.mkdir()
+    (run / "log.out").write_text(SAMPLE_LOG)
+    (run / em.JSONL_NAME).write_text("garbage\n{\n")
+    rows = em.extract(str(tmp_path))
+    assert len(rows) == 1
+    assert rows[0]["final_loss"] == pytest.approx(8.0)  # the log's numbers
+    assert rows[0]["num_steps"] == 2
+
+
 # ------------------------------------------------------------------- packaging
 
 
