@@ -66,13 +66,23 @@ spec-smoke:
 # Flash-decode kernel parity (ops/pallas/decode_attention.py) in Pallas
 # interpret mode on CPU: flash vs dense allclose across S=1 decode,
 # speculative verify, chunked prefill; bf16/fp32 AND int8 caches; ragged
-# lengths, stale rows, GQA down to nkv=1, non-dividing KV blocks — plus
-# the engine-level wiring proof for inference.attend_impl. The serving
-# default stays dense, so decode-smoke/spec-smoke GENERATION output is
-# unchanged (their bench JSON gains the attend_impl/kv_bytes_per_token
-# fields).
+# lengths, stale rows, GQA down to nkv=1, non-dividing KV blocks;
+# double-buffered DMA pinned bitwise against the serial fetch — plus the
+# engine-level wiring proof for inference.attend_impl and the on-device
+# sampling epilogue's seeded host-equivalence. Closes with the
+# mixed-rung bench: every PR-11 ladder rung ON in one run (pipelined
+# flash DMA over paged pages, hot_bf16 per-page policy, fused sampling
+# epilogue), so the JSON line carries the full A/B field set
+# (kv_bytes_per_token, logits_bytes_to_host_per_token,
+# dispatch_latency_s) the TPU A/B matrix diffs. The serving default
+# stays dense, so decode-smoke/spec-smoke GENERATION output is
+# unchanged.
 kernel-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_decode_kernel.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode_kernel.py \
+	  tests/test_sampling_epilogue.py -q
+	JAX_PLATFORMS=cpu python bench_decode.py --attend-impl flash \
+	  --kv-layout paged --kv-page-policy hot_bf16 --sample-on-device \
+	  --block-len 8
 
 # Paged-KV smoke (inference/paged_kv.py): a shared-prefix batch through
 # the page-pool layout (block-table indirection, radix prefix sharing,

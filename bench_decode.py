@@ -91,6 +91,41 @@ def tpu_preflight(timeout_s: float = 120.0) -> tuple:
     return True, "tpu"
 
 
+def logits_bytes_to_host_per_token(engine, vocab: int, block_len: int,
+                                   spec_len: int = 0) -> int:
+    """Bytes of sampling payload that cross the device->host boundary per
+    generated token: the [B, V] fp32 logits the per-token loop round-trips
+    just to pick one id each — or, everywhere sampling is fused into the
+    dispatch (``--sample-on-device``, blocked decode's on-device stop
+    state, the speculative verify), the int32 token ids alone. The
+    acceptance shape: V*4 per token on the host-sampling per-token loop,
+    O(B) per dispatch (= 4 bytes per token) with the epilogue on."""
+    if block_len == 1 and spec_len == 0 and not engine.sample_on_device:
+        return vocab * 4 + 4  # [V] fp32 logits + the sampled id fed back
+    if spec_len > 0:
+        # one verify dispatch emits ~(1 + r*G) ids per slot; conservatively
+        # charge the whole emitted row (G+1 ids) per produced token
+        return (spec_len + 1) * 4
+    return 4  # token ids only — logits never leave the device
+
+
+def dispatch_latency_summary(engine) -> dict:
+    """Per-kind dispatch-latency percentiles out of the registry histogram
+    PR 10 wired (``picotron_dispatch_seconds``): the per-rung before/after
+    the bench JSON records, so an A/B across flag flips (serial vs
+    pipelined DMA, host vs on-device sampling, uniform vs hot_bf16 pages)
+    is a diff of two JSON lines, not a re-instrumentation."""
+    out = {}
+    for kind in ("decode", "verify"):
+        h = engine.obs.registry.histogram(
+            "picotron_dispatch_seconds",
+            "dispatch wall time incl. host sync, by kind", kind=kind)
+        p = h.percentiles()
+        if p is not None:
+            out[kind] = p
+    return out
+
+
 def kv_bytes_per_token(engine, lengths) -> int:
     """Estimated KV HBM bytes the attend moves per cache walk: layers x
     K+V x (attention window rows) x kv_heads x head_dim x storage bytes,
@@ -121,14 +156,34 @@ def kv_bytes_per_token(engine, lengths) -> int:
                   else live)
     else:
         window = float(engine.max_seq_len)
-    per_row = 2 * m.num_key_value_heads * m.head_dim * \
+    fp_row = 2 * m.num_key_value_heads * m.head_dim * \
         engine.cache_dtype.itemsize
-    if engine.quantized:
-        per_row += 2 * m.num_key_value_heads * 4  # k_scale/v_scale rows
-        if engine.attend_impl == "dense":
-            # whole-window fp32 K/V materialization: 4 bytes written then
-            # read back per element, on top of the int8 cache read
-            per_row += 2 * m.num_key_value_heads * m.head_dim * 4 * 2
+    q_row = (2 * m.num_key_value_heads * m.head_dim  # int8 bytes
+             + 2 * m.num_key_value_heads * 4)  # + per-row fp32 scales
+    if getattr(engine, "page_policy", False):
+        # hot_bf16 mixed pages: the flash DMA fetches each page from ONE
+        # representation — full precision for hot (shared) pages, int8 +
+        # scales for cold (exclusive) tails — so per-row bytes are the
+        # live-page mix. The dense reference gathers BOTH windows plus
+        # the fp32 select copy (write + read), the same honesty rule as
+        # the dense-int8 materialization below.
+        flags = engine.paged.quant_flags()
+        refs = engine.paged.pool.refs
+        live = np.flatnonzero(refs[1:] > 0) + 1
+        qfrac = float(np.mean(flags[live])) if live.size else 0.0
+        if engine.attend_impl == "flash":
+            per_row = qfrac * q_row + (1.0 - qfrac) * fp_row
+        else:
+            per_row = (fp_row + q_row
+                       + 2 * m.num_key_value_heads * m.head_dim * 4 * 2)
+    else:
+        per_row = fp_row
+        if engine.quantized:
+            per_row += 2 * m.num_key_value_heads * 4  # k_scale/v_scale rows
+            if engine.attend_impl == "dense":
+                # whole-window fp32 K/V materialization: 4 bytes written
+                # then read back per element, on top of the int8 cache read
+                per_row += 2 * m.num_key_value_heads * m.head_dim * 4 * 2
     if paged and engine.attend_impl == "dense":
         # the gathered contiguous window copy: written then read back at
         # the storage width (the fp32 materialization above already
@@ -140,7 +195,8 @@ def kv_bytes_per_token(engine, lengths) -> int:
 
 def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
         steps: int, warmup: int = 8, block_len: int = 1,
-        attend_impl: str = "dense", kv_layout: str = "contiguous"):
+        attend_impl: str = "dense", kv_layout: str = "contiguous",
+        kv_page_policy: str = "uniform", sample_on_device: bool = False):
     """Time ``steps`` decode rounds (tokens per slot). Returns
     (tokens/s, dispatches_per_token, kv_bytes/token, engine)."""
     import jax
@@ -151,14 +207,19 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
 
     engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
                              decode_block_len=block_len,
-                             attend_impl=attend_impl, kv_layout=kv_layout)
+                             attend_impl=attend_impl, kv_layout=kv_layout,
+                             kv_page_policy=kv_page_policy,
+                             sample_on_device=sample_on_device)
     params = engine.shard_params(jax.jit(
         lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
     cache = engine.init_cache()
     rng = np.random.default_rng(0)
+    # greedy prefill epilogue (temp 0) == the host argmax it replaces
+    pf_sample = ((jax.random.PRNGKey(1), 0.0, 0, 1.0)
+                 if sample_on_device else None)
     for s in range(slots):
         prompt = rng.integers(1, cfg.model.vocab_size, prompt_len)
-        kv, _ = engine.prefill(params, prompt)
+        kv, _ = engine.prefill(params, prompt, sample=pf_sample)
         cache = engine.insert(cache, kv, s, prompt_len)
 
     toks = np.ones(slots, np.int32)
@@ -225,7 +286,9 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
 def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
              steps: int, warmup_rounds: int = SPEC_WARMUP_ROUNDS,
              spec_len: int = 4, attend_impl: str = "dense",
-             kv_layout: str = "contiguous"):
+             kv_layout: str = "contiguous",
+             kv_page_policy: str = "uniform",
+             sample_on_device: bool = False):
     """Time ``steps`` speculative decode tokens per slot: the same
     protocol as ``run`` — prefill fills every slot OUTSIDE the timed
     window, warmup rounds absorb compilation, then the timed window runs
@@ -247,7 +310,9 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
 
     engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
                              spec_len=spec_len, attend_impl=attend_impl,
-                             kv_layout=kv_layout)
+                             kv_layout=kv_layout,
+                             kv_page_policy=kv_page_policy,
+                             sample_on_device=sample_on_device)
     params = engine.shard_params(jax.jit(
         lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
     drafter = NgramDrafter(engine.spec_ngram)
@@ -258,11 +323,16 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
 
     cache = engine.init_cache()
     toks = np.zeros(slots, np.int32)
+    # greedy prefill epilogue (temp 0) == the host argmax it replaces
+    pf_sample = ((jax.random.PRNGKey(1), 0.0, 0, 1.0)
+                 if sample_on_device else None)
     hist = []
     for s in range(slots):
-        kv, logits = engine.prefill(params, prompt)
+        kv, logits = engine.prefill(params, prompt, sample=pf_sample)
         cache = engine.insert(cache, kv, s, prompt_len)
-        toks[s] = np.argmax(np.asarray(logits)[0])  # greedy first token
+        # epilogue engines return the greedy token id directly
+        toks[s] = (np.asarray(logits).reshape(-1)[0] if sample_on_device
+                   else np.argmax(np.asarray(logits)[0]))
         hist.append(list(prompt) + [int(toks[s])])
 
     eos = np.full(slots, -1, np.int32)  # bench streams never stop early
@@ -338,9 +408,25 @@ def main(argv=None) -> None:
                          "indirection (inference/paged_kv.py) — the JSON "
                          "then adds kv_pages_total/live, pool "
                          "utilization, and prefix_hit_rate")
+    ap.add_argument("--kv-page-policy", choices=("uniform", "hot_bf16"),
+                    default="uniform",
+                    help="per-page storage policy (paged layout only): "
+                         "hot_bf16 reads radix-shared prefix pages at "
+                         "full precision and exclusively-held tails as "
+                         "int8 + scales — kv_bytes_per_token then "
+                         "reflects the live-page mix")
+    ap.add_argument("--sample-on-device", action="store_true",
+                    help="fused sampling epilogue: prefill/decode "
+                         "dispatches sample inside the jitted program "
+                         "and ship token ids, never [B, vocab] logits — "
+                         "logits_bytes_to_host_per_token drops from "
+                         "vocab*4 to O(B)")
     args = ap.parse_args(argv)
     if args.spec_len > 0 and args.block_len != 1:
         ap.error("--spec-len replaces blocked decode; drop --block-len")
+    if args.kv_page_policy != "uniform" and args.kv_layout != "paged":
+        ap.error("--kv-page-policy hot_bf16 requires --kv-layout paged "
+                 "(per-page refcounts decide which pages read as int8)")
 
     # Preflight BEFORE any backend touch: a dead TPU tunnel hangs backend
     # init forever, and the probe child is the only safe way to find out.
@@ -395,12 +481,16 @@ def main(argv=None) -> None:
             tok_s, dpt, accept, kv_bytes, engine = run_spec(
                 cfg, spec_len=args.spec_len,
                 attend_impl=args.attend_impl,
-                kv_layout=args.kv_layout, **sizes)
+                kv_layout=args.kv_layout,
+                kv_page_policy=args.kv_page_policy,
+                sample_on_device=args.sample_on_device, **sizes)
         else:
             tok_s, dpt, kv_bytes, engine = run(
                 cfg, block_len=args.block_len,
                 attend_impl=args.attend_impl,
-                kv_layout=args.kv_layout, **sizes)
+                kv_layout=args.kv_layout,
+                kv_page_policy=args.kv_page_policy,
+                sample_on_device=args.sample_on_device, **sizes)
     except Exception as e:  # noqa: BLE001 - the record IS the error channel
         print(json.dumps({
             "metric": BENCH_METRICS["bench_decode"], "value": None,
@@ -414,17 +504,32 @@ def main(argv=None) -> None:
           f"steps={sizes['steps']} chips={chips} block_len={args.block_len} "
           f"spec_len={args.spec_len} attend_impl={args.attend_impl} "
           f"kv_layout={args.kv_layout} "
+          f"kv_page_policy={args.kv_page_policy} "
+          f"sample_on_device={args.sample_on_device} "
           + (f"accept_rate={accept:.3f} " if accept is not None else "")
           + f"dispatches/token={dpt:.3f} kv_bytes/token={kv_bytes} "
           f"tokens/s={tok_s:.1f}",
           file=sys.stderr)
+    logit_bytes = logits_bytes_to_host_per_token(
+        engine, cfg.model.vocab_size, args.block_len, args.spec_len)
     record = {"metric": metric, "value": round(tok_s / chips, 1),
               "unit": "tokens/s/chip", "vs_baseline": None,
               "block_len": args.block_len,
               "dispatches_per_token": round(dpt, 4),
               "attend_impl": args.attend_impl,
               "kv_layout": args.kv_layout,
+              "kv_page_policy": args.kv_page_policy,
+              "sample_on_device": args.sample_on_device,
               "kv_bytes_per_token": kv_bytes,
+              "logits_bytes_to_host_per_token": logit_bytes,
+              # the per-rung A/B referee: dispatch-latency percentiles
+              # from the PR 10 histograms, so flipping ONE flag (pipeline,
+              # epilogue, policy) and diffing two JSON lines is the whole
+              # measurement protocol once the TPU tunnel returns. This is
+              # the CANONICAL latency field — a projection of the same
+              # registry instruments the "obs" snapshot below serializes,
+              # so the two can never disagree at emit time.
+              "dispatch_latency_s": dispatch_latency_summary(engine),
               # hardware-validated numbers vs CPU-proxy fallback: the
               # kv_bytes/attend_impl deltas are layout facts and hold
               # either way; tokens/s only means hardware when validated
@@ -440,6 +545,7 @@ def main(argv=None) -> None:
             kv_page_len=p["kv_page_len"],
             kv_pages_total=p["kv_pages_total"],
             kv_pages_live=p["kv_pages_live"],
+            kv_pages_quant=p["kv_pages_quant"],
             kv_pool_utilization=p["kv_pool_utilization"],
             prefix_hit_rate=p["prefix_hit_rate"])
         # ...and into the registry, so the obs snapshot below is complete

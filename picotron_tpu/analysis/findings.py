@@ -61,6 +61,16 @@ RULES = {
             "the result is cached outside the loop",
         ),
         Rule(
+            "PICO-J005",
+            "make_async_copy started without a reachable wait",
+            "a pltpu.make_async_copy whose .start() has no matching "
+            ".wait() in scope — or whose per-iteration start inside a "
+            "fori_loop body has its only wait outside that loop path — "
+            "leaves DMAs in flight while compute reads the buffer (or "
+            "imbalances the semaphore), the exact hazard double-buffered "
+            "pipelining introduces",
+        ),
+        Rule(
             "PICO-C001",
             "lock-order inversion",
             "two locks acquired in opposite orders on different code paths "

@@ -1,4 +1,4 @@
-"""JAX hot-path analyzer: PICO-J001..J004.
+"""JAX hot-path analyzer: PICO-J001..J005.
 
 Entry points are discovered syntactically — functions decorated with or
 passed to ``jax.jit`` / ``jax.pmap`` / ``pl.pallas_call`` / ``shard_map``
@@ -29,6 +29,12 @@ outside jit).  From each entry the intra-project call graph is walked
 - **PICO-J004** — ``jax.jit``/``jax.pmap``/``pl.pallas_call`` evaluated
   lexically inside a ``for``/``while`` loop: a fresh callable per
   iteration means a recompile per iteration unless cached outside.
+- **PICO-J005** — ``pltpu.make_async_copy`` started with no matching
+  ``.wait()`` in the enclosing function, or started per-iteration inside
+  a ``fori_loop``/``while_loop``/``scan`` body whose every wait sits
+  outside the loop: the DMA is still in flight when its buffer is read
+  (or the semaphore imbalances) — the exact hazard the double-buffered
+  decode kernel (``ops/pallas/decode_attention.py``) must discipline.
 """
 
 from __future__ import annotations
@@ -648,6 +654,104 @@ def _check_jit_in_loop(mod: ModuleInfo, findings: list) -> None:
 
 
 # --------------------------------------------------------------------------- #
+# J005: make_async_copy started without a reachable wait
+# --------------------------------------------------------------------------- #
+
+
+def _outermost_functions(tree: ast.AST) -> list:
+    """Module-level functions and class methods, NOT nested defs — a DMA
+    kernel's start/wait pairing is analyzed over the whole outermost
+    function (helper closures included), so the double-buffer idiom of a
+    ``_start`` helper next to a ``_wait`` helper reads as paired."""
+    funcs: list = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.append(child)
+            else:
+                walk(child)
+
+    walk(tree)
+    return funcs
+
+
+def _dma_starts_waits(root: ast.AST) -> tuple:
+    """``(start_calls, wait_calls)`` on make_async_copy values inside one
+    subtree: ``.start()``/``.wait()`` chained directly onto a
+    ``make_async_copy(...)`` call, or on a name the subtree binds to one.
+    Receiver-typed on purpose — ``thread.start()`` / ``event.wait()`` /
+    helper-returned descriptors never match (precision over recall, the
+    empty-baseline contract)."""
+    names: set = set()
+    for node in ast.walk(root):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            parts = dotted_name(node.value.func)
+            if parts and parts[-1] == "make_async_copy":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    starts: list = []
+    waits: list = []
+    for node in ast.walk(root):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("start", "wait")):
+            continue
+        recv = node.func.value
+        hit = isinstance(recv, ast.Name) and recv.id in names
+        if not hit and isinstance(recv, ast.Call):
+            parts = dotted_name(recv.func)
+            hit = bool(parts) and parts[-1] == "make_async_copy"
+        if hit:
+            (starts if node.func.attr == "start" else waits).append(node)
+    return starts, waits
+
+
+def _check_dma_waits(mod: ModuleInfo, findings: list) -> None:
+    """PICO-J005, two layers:
+
+    (a) an outermost function whose subtree starts DMAs but never waits
+        on any — the copy is still in flight when its buffer is read;
+    (b) a ``fori_loop``/``while_loop``/``scan`` body that starts DMAs
+        per iteration while every wait sits OUTSIDE the loop path — N
+        starts against the wait discipline of 1, the semaphore-imbalance
+        hazard double buffering introduces (a warm-up start outside the
+        loop with the waits inside is the CORRECT pipelined shape and
+        stays silent).
+    """
+    flagged: set = set()
+
+    def emit(node: ast.AST, detail: str) -> None:
+        if id(node) in flagged:
+            return
+        flagged.add(id(node))
+        findings.append(Finding(
+            rule="PICO-J005", path=mod.rel, line=node.lineno,
+            context=enclosing_qualname(mod, node),
+            snippet=mod.snippet(node.lineno),
+            message=f"make_async_copy started {detail} — pair every "
+                    f"start with a wait built from the same (src, dst, "
+                    f"sem) triple on the same control path "
+                    f"(docs/ANALYSIS.md#pico-j005)"))
+
+    for fn in _outermost_functions(mod.tree):
+        starts, waits = _dma_starts_waits(fn)
+        if starts and not waits:
+            for s in starts:
+                emit(s, "with no .wait() anywhere in the enclosing "
+                        "function: the DMA may still be in flight when "
+                        "its destination buffer is read")
+    for body, wrapper in _loop_body_functions(mod):
+        bstarts, bwaits = _dma_starts_waits(body)
+        if bstarts and not bwaits:
+            for s in bstarts:
+                emit(s, f"inside a {wrapper} body whose every .wait() "
+                        f"sits outside the loop: one wait cannot "
+                        f"discharge N per-iteration starts")
+
+
+# --------------------------------------------------------------------------- #
 # driver
 # --------------------------------------------------------------------------- #
 
@@ -674,4 +778,5 @@ def analyze(project: Project) -> list:
     for mod in project.modules.values():
         _check_program_id(project, mod, findings)
         _check_jit_in_loop(mod, findings)
+        _check_dma_waits(mod, findings)
     return findings

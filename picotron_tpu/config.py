@@ -376,6 +376,17 @@ class InferenceConfig:
     # skip prefilling) their longest cached prefix, copy-on-write at the
     # fork point. False = pure paging, no sharing.
     prefix_cache: bool = True
+    # Per-page storage policy (paged layout only): "uniform" = every page
+    # stores kv_cache_dtype (the pinned default); "hot_bf16" = pages with
+    # more than one holder — radix-shared prefixes, forked slots — are
+    # READ at full precision while exclusively-held pages (cold unique
+    # tails, the bulk of a long generation) are read as int8 + per-row
+    # scales, so the shared prefix keeps full fidelity and the tail moves
+    # ~half the bytes per attend walk. Requires kv_layout: "paged" and is
+    # mutually exclusive with kv_cache_dtype: "int8" (the policy manages
+    # its own quantized representation). Handled by both the dense gather
+    # and the flash DMA read paths (inference/paged_kv.py).
+    kv_page_policy: str = "uniform"
     # Prompts longer than this prefill as a sequence of fixed-width chunk
     # dispatches writing K/V straight into the target slot
     # (engine.prefill_chunked): O(1) compiled shapes in prompt length and
@@ -393,6 +404,19 @@ class InferenceConfig:
     # surface, not a serving one); allclose-pinned against dense in
     # tests/test_decode_kernel.py.
     attend_impl: str = "dense"
+    # Fused on-device sampling epilogue: the prefill / chunked-prefill /
+    # decode_step dispatches sample their next token INSIDE the jitted
+    # program (temperature -> top-k -> top-p -> categorical, the same
+    # fused filter sampling.sample runs, sanitize_logits applied first),
+    # so only sampled token ids [B] cross to the host instead of full
+    # [B, vocab] fp32 logits. Seeded-identical to the host sampler: the
+    # batcher passes the exact PRNG key the host path would have drawn.
+    # False (default) keeps the host-side sampling path — the bit-pinned
+    # staging default until the epilogue is A/B'd on a chip, like
+    # attend_impl/kv_layout before it. (decode_block and verify always
+    # sampled on device; this key completes the story for the remaining
+    # logits round-trips.)
+    sample_on_device: bool = False
     # Speculative decoding (inference/speculative.py, engine.verify): number
     # of tokens the drafter proposes per slot per dispatch. One jitted
     # verify pass scores all spec_len+1 positions, accepts the matching
@@ -725,6 +749,33 @@ class Config:
         if inf.kv_num_pages < 0:
             raise ValueError(
                 "inference.kv_num_pages must be >= 0 (0 = auto-size)")
+        if inf.kv_page_policy not in ("uniform", "hot_bf16"):
+            raise ValueError(
+                f"unknown inference.kv_page_policy {inf.kv_page_policy!r} "
+                "(uniform|hot_bf16)")
+        if inf.kv_page_policy == "hot_bf16":
+            if inf.kv_layout != "paged":
+                # the policy is defined over pool pages and their refcounts;
+                # a contiguous strip has neither — name the fix, like the
+                # check_vma/use_cpu rejection above does
+                raise ValueError(
+                    "inference.kv_page_policy 'hot_bf16' requires the paged "
+                    "KV layout (per-page refcounts decide which pages read "
+                    "as int8); set inference.kv_layout: 'paged', or keep "
+                    "kv_page_policy: 'uniform' on the contiguous layout")
+            if inf.kv_cache_dtype == "int8":
+                raise ValueError(
+                    "inference.kv_page_policy 'hot_bf16' manages its own "
+                    "int8 representation for cold pages and is mutually "
+                    "exclusive with kv_cache_dtype: 'int8' (a uniformly "
+                    "quantized cache has no full-precision pages to keep "
+                    "hot); set kv_cache_dtype: 'auto', or keep "
+                    "kv_page_policy: 'uniform' for a fully int8 cache")
+        if not isinstance(inf.sample_on_device, bool):
+            raise ValueError(
+                f"inference.sample_on_device must be a JSON boolean "
+                f"(true/false), got {inf.sample_on_device!r} — quoted "
+                f"'true'/'false' strings are not parsed as booleans")
         if inf.attend_impl not in ("dense", "flash"):
             raise ValueError(
                 f"unknown inference.attend_impl {inf.attend_impl!r} "

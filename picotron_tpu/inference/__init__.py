@@ -11,7 +11,9 @@ into a batched generation engine:
   host-side refcounting allocator, radix prefix sharing (identical prompt
   prefixes stored and prefilled once), and copy-on-write at fork points;
 - ``sampling``: greedy / temperature / top-k / top-p as pure jittable
-  functions with per-request parameter arrays;
+  functions with per-request parameter arrays — also the fused on-device
+  epilogue (``inference.sample_on_device``) that keeps full-vocab logits
+  from ever crossing to the host;
 - ``engine``: jitted ``prefill`` / ``prefill_chunked`` / ``decode_step`` /
   ``decode_block`` programs under shard_map on a tp mesh, reusing the
   training ``decoder_layer`` (flash-capable prefill) with the

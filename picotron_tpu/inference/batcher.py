@@ -476,16 +476,23 @@ class ContinuousBatcher:
         else:
             self._last_tok[i] = tok
 
-    def _prefill_into(self, req: Request, i: int):
+    def _prefill_into(self, req: Request, i: int, key=None):
         """Prefill ``req`` into slot ``i`` (one-shot or chunked) and return
-        its last-token logits. Mutates the cache/dispatch counters. On the
+        its last-token logits — or, on a ``sample_on_device`` engine
+        (``key`` is then the admit-time PRNG key), the first sampled token
+        [1] int32: the fused epilogue draws it inside the prefill dispatch
+        from the slot's own sampling params, so the [1, V] logits never
+        cross to the host. Mutates the cache/dispatch counters. On the
         paged layout the engine's prefix-sharing admission runs instead:
         the longest radix-cached prefix is shared (no dispatches) and only
         the suffix prefills."""
+        sample = None
+        if self.engine.sample_on_device:
+            sample = (key, req.temperature, req.top_k, req.top_p)
         if self.paged is not None:
             self.paged.priced[i] = self.page_commitment(req)
             self._cache, logits, n, cached = self.engine.prefill_paged(
-                self.params, self._cache, req.prompt, i)
+                self.params, self._cache, req.prompt, i, sample=sample)
             self.prefill_dispatches += n
             self._last_prefill = {"dispatches": n, "cached_tokens": cached}
             return logits
@@ -494,11 +501,12 @@ class ContinuousBatcher:
             # O(1) compiled shapes in prompt length
             n_chunks = -(-len(req.prompt) // self.engine.prefill_chunk)
             self._cache, logits = self.engine.prefill_chunked(
-                self.params, self._cache, req.prompt, i)
+                self.params, self._cache, req.prompt, i, sample=sample)
             self.prefill_dispatches += n_chunks
             self._last_prefill = {"dispatches": n_chunks}
         else:
-            kv, logits = self.engine.prefill(self.params, req.prompt)
+            kv, logits = self.engine.prefill(self.params, req.prompt,
+                                             sample=sample)
             self._cache = self.engine.insert(
                 self._cache, kv, i, len(req.prompt))
             self.prefill_dispatches += 1
@@ -543,11 +551,19 @@ class ContinuousBatcher:
                 # span chain's first link, parented to the request root
                 self.obs.tracer.record("queue_wait", submit_t, t_admit,
                                        parent=root)
+            # the admit-time key: with the on-device epilogue it is drawn
+            # BEFORE the dispatch (the program needs it as an operand);
+            # host-side it is drawn after, exactly where it always was.
+            # Either way it is the SAME link of the split chain — one
+            # split per admit — so the two modes emit seeded-identical
+            # streams (tests/test_sampling_epilogue.py pins this through
+            # a full batcher run).
+            key = self._split() if self.engine.sample_on_device else None
             try:
                 pf_span = self.obs.tracer.begin(
                     "prefill", parent=root, uid=req.uid,
                     prompt_tokens=len(req.prompt))
-                logits = retry(lambda: self._prefill_into(req, i),
+                logits = retry(lambda: self._prefill_into(req, i, key),
                                **self._retry)
                 self.obs.tracer.end(pf_span, **self._last_prefill)
             except Exception as e:  # noqa: BLE001 - isolated to this request
@@ -586,11 +602,16 @@ class ContinuousBatcher:
             self._top_k[i] = req.top_k
             self._top_p[i] = req.top_p
             self._eos[i] = req.eos_id if req.eos_id is not None else -1
-            first = int(sampling.sample(
-                logits, self._split(),
-                np.float32([req.temperature]),
-                np.int32([req.top_k]),
-                np.float32([req.top_p]))[0])
+            if self.engine.sample_on_device:
+                # the dispatch already drew the first token (epilogue);
+                # the one int crossing here is the whole logits payload
+                first = int(np.asarray(logits).reshape(-1)[0])
+            else:
+                first = int(sampling.sample(
+                    logits, self._split(),
+                    np.float32([req.temperature]),
+                    np.int32([req.top_k]),
+                    np.float32([req.top_p]))[0])
             self._token_done(i, first)
 
     def _expire_deadlines(self) -> None:

@@ -116,6 +116,8 @@ class InferenceEngine:
                  kv_layout: Optional[str] = None,
                  kv_page_len: Optional[int] = None,
                  kv_num_pages: Optional[int] = None,
+                 kv_page_policy: Optional[str] = None,
+                 sample_on_device: Optional[bool] = None,
                  hooks=None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
@@ -169,6 +171,14 @@ class InferenceEngine:
                  "this engine starts on 'dense' (inference.attend_fallback)")
             inf.attend_impl = "dense"
         self.attend_impl = inf.attend_impl
+        # Fused on-device sampling epilogue: prefill/chunked-prefill/
+        # decode_step dispatches sample INSIDE the jitted program and
+        # return token ids instead of [*, vocab] logits (decode_block and
+        # verify always did). A trace-time choice like attend_impl: the
+        # programs below are built with or without the epilogue.
+        if sample_on_device is not None:
+            inf.sample_on_device = bool(sample_on_device)
+        self.sample_on_device = inf.sample_on_device
         # Telemetry (picotron_tpu/obs, docs/OBSERVABILITY.md): every
         # engine owns a fresh metrics registry (counters start at zero
         # per server) and shares the process span ring. The batcher and
@@ -208,6 +218,31 @@ class InferenceEngine:
                     f"unknown kv_layout {kv_layout!r} (contiguous|paged)")
             inf.kv_layout = kv_layout
         self.kv_layout = inf.kv_layout
+        # Per-page storage policy (hot_bf16: shared pages read full
+        # precision, exclusive tails read int8) — paged-only, mutually
+        # exclusive with a uniformly int8 cache (config.validate mirrors
+        # both checks for the JSON path; the kwargs path lands here).
+        if kv_page_policy is not None:
+            if kv_page_policy not in ("uniform", "hot_bf16"):
+                raise ValueError(
+                    f"unknown kv_page_policy {kv_page_policy!r} "
+                    "(uniform|hot_bf16)")
+            inf.kv_page_policy = kv_page_policy
+        self.kv_page_policy = inf.kv_page_policy
+        if self.kv_page_policy == "hot_bf16":
+            if self.kv_layout != "paged":
+                raise ValueError(
+                    "kv_page_policy 'hot_bf16' requires kv_layout='paged' "
+                    "(per-page refcounts decide which pages read as int8); "
+                    "set kv_layout='paged' or keep kv_page_policy="
+                    "'uniform'")
+            if self.quantized:
+                raise ValueError(
+                    "kv_page_policy 'hot_bf16' is mutually exclusive with "
+                    "an int8 cache (it manages its own quantized "
+                    "representation); drop cache_dtype/kv_cache_dtype "
+                    "'int8' or keep kv_page_policy='uniform'")
+        self.page_policy = self.kv_page_policy == "hot_bf16"
         self.paged: Optional[paged_kv.PagedKV] = None
         if self.kv_layout == "paged":
             self.page_len = int(kv_page_len or inf.kv_page_len)
@@ -233,7 +268,8 @@ class InferenceEngine:
 
         self._pspecs = llama.param_pspecs(m)
         if self.paged is not None:
-            self._cspecs = paged_kv.cache_pspecs(self.quantized)
+            self._cspecs = paged_kv.cache_pspecs(self.quantized,
+                                                 policy=self.page_policy)
         else:
             self._cspecs = kv_cache.cache_pspecs(self.quantized)
         self._build_programs()
@@ -250,7 +286,8 @@ class InferenceEngine:
             self._init_cache_jit = jax.jit(
                 partial(paged_kv.init_cache, m, self.slots, self.num_pages,
                         self.page_len, self.max_pages,
-                        dtype=self.cache_dtype, quantized=self.quantized),
+                        dtype=self.cache_dtype, quantized=self.quantized,
+                        policy=self.page_policy),
                 out_shardings=named_shardings(topo, self._cspecs))
         else:
             self._insert_jit = jax.jit(kv_cache.insert_prefill,
@@ -267,25 +304,33 @@ class InferenceEngine:
         the kernel choice is a trace-time constant the jit wrappers close
         over, so changing it means new programs, not a runtime branch."""
         kv_spec = {n: s for n, s in self._cspecs.items()
-                   if n not in ("lengths", "block_tables")}
+                   if n not in paged_kv.META_LEAVES}
         mesh = self.topo.mesh
 
         chunk_impl = (self._prefill_chunk_impl_paged
                       if self.kv_layout == "paged"
                       else self._prefill_chunk_impl)
+        # the on-device sampling epilogue changes the programs' I/O: the
+        # prefill family gains (key, temperature, top_k, top_p) inputs and
+        # returns a sampled token id [1] where the host path returns [1, V]
+        # logits; decode_step stops returning its [B, V] logits at all —
+        # the whole point is that they never leave the device
+        sod = self.sample_on_device
+        samp = (P(), P(), P(), P()) if sod else ()
         self._prefill_jit = jax.jit(shard_map(
             self._prefill_impl, mesh,
-            in_specs=(self._pspecs, P(), P()),
+            in_specs=(self._pspecs, P(), P()) + samp,
             out_specs=(kv_spec, P())))
         self._prefill_chunk_jit = jax.jit(shard_map(
             chunk_impl, mesh,
-            in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P()),
+            in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P()) + samp,
             out_specs=(self._cspecs, P())),
             donate_argnums=(1,))
         self._decode_jit = jax.jit(shard_map(
             self._decode_impl, mesh,
             in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P(), P()),
-            out_specs=(self._cspecs, P(), P())),
+            out_specs=(self._cspecs, P()) if sod
+            else (self._cspecs, P(), P())),
             donate_argnums=(1,))
         self._decode_block_jit = self._make_decode_block_jit()
         self._decode_block_poison_jit = None  # chaos-only; built on demand
@@ -399,18 +444,36 @@ class InferenceEngine:
 
     def _pack_kv(self, K, V):
         """Prefill K/V blocks in cache storage form: quantize (int8 mode)
-        or cast to the cache dtype."""
+        or cast to the cache dtype. hot_bf16 policy engines pack BOTH
+        representations (full precision + int8 with scales) — the paged
+        insert parks them side by side, the per-page flag picks the read."""
         if self.quantized:
             qk, ks = kv_cache.quantize_kv(K)
             qv, vs = kv_cache.quantize_kv(V)
             return {"k": qk, "v": qv, "k_scale": ks, "v_scale": vs}
-        return {"k": K.astype(self.cache_dtype),
-                "v": V.astype(self.cache_dtype)}
+        out = {"k": K.astype(self.cache_dtype),
+               "v": V.astype(self.cache_dtype)}
+        if self.page_policy:
+            qk, ks = kv_cache.quantize_kv(K)
+            qv, vs = kv_cache.quantize_kv(V)
+            out.update({"k_q": qk, "v_q": qv, "k_scale": ks, "v_scale": vs})
+        return out
 
-    def _prefill_impl(self, params, tokens, length):
+    def _epilogue(self, logits, key, temperature, top_k, top_p):
+        """The fused on-device sampling epilogue: sanitize -> temperature
+        -> top-k -> top-p -> categorical (sampling.sample's fused filter,
+        exactly the host sampler's pipeline over the same key), collapsing
+        the dispatch's host-bound payload from [B, V] fp32 logits to [B]
+        int32 token ids."""
+        return sampling.sample(logits, key, temperature, top_k, top_p)
+
+    def _prefill_impl(self, params, tokens, length, *sample):
         """tokens [1, S_bucket] int32, length [1] -> (kv blocks, last-token
         logits [1, V]). Pad tokens beyond ``length`` produce K/V rows the
-        length mask makes unreachable."""
+        length mask makes unreachable. With the on-device sampling
+        epilogue, ``sample`` is (key, temperature [1], top_k [1],
+        top_p [1]) and the second return is the sampled token id [1]
+        int32 — the logits never leave the device."""
         cfg = self.cfg
         S = tokens.shape[1]
         cos_l = lax.dynamic_slice_in_dim(self._cos, 0, S, 0)
@@ -428,45 +491,53 @@ class InferenceEngine:
         # bucket pays one [1, H] @ [H, V] row instead of S_bucket of them
         h_last = jnp.take_along_axis(h, (length - 1)[:, None, None], axis=1)
         last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
-        return self._pack_kv(K, V), last.astype(jnp.float32)
+        last = last.astype(jnp.float32)
+        if self.sample_on_device:
+            return self._pack_kv(K, V), self._epilogue(last, *sample)
+        return self._pack_kv(K, V), last
 
     def _split_cache(self, cache):
         """(per-layer K/V leaves to scan, lengths) — the scan consumes every
         [L, ...] cache leaf the way it consumes the stacked params. The
-        paged layout's ``block_tables`` has no layer axis: it rides as a
-        scan constant, injected per layer by ``_layer_body``."""
+        paged layout's ``block_tables`` (and the hot_bf16 policy's
+        ``page_quant`` flags) have no layer axis: they ride as scan
+        constants, injected per layer by ``_layer_body``."""
         return ({n: a for n, a in cache.items()
-                 if n not in ("lengths", "block_tables")},
+                 if n not in paged_kv.META_LEAVES},
                 cache["lengths"])
 
-    def _layer_body(self, cos_b, sin_b, pos, block_tables):
+    def _meta(self, cache) -> dict:
+        """The layer-less host-owned metadata leaves a paged cache carries
+        (block tables; page_quant under the hot_bf16 policy)."""
+        return {n: cache[n] for n in ("block_tables", "page_quant")
+                if n in cache}
+
+    def _layer_body(self, cos_b, sin_b, pos, meta):
         """Build the layer-scan body: decode one layer against its cache
-        leaves. For paged caches the (layer-less) block tables are spliced
-        into each layer's dict on the way in — kv_cache.cache_write/attend
-        dispatch on their presence — and stripped on the way out so the
-        scan stacks only real [L, ...] leaves."""
+        leaves. For paged caches the (layer-less) metadata leaves are
+        spliced into each layer's dict on the way in —
+        kv_cache.cache_write/attend dispatch on their presence — and
+        stripped on the way out so the scan stacks only real [L, ...]
+        leaves."""
 
         def body(hc, xs):
             lp, lc = xs
-            if block_tables is not None:
-                lc = {**lc, "block_tables": block_tables}
+            if meta:
+                lc = {**lc, **meta}
             hc, lc = llama.decoder_layer(lp, hc, cos_b, sin_b, self.cfg,
                                          cache=lc, pos=pos)
-            if block_tables is not None:
-                lc = {n: a for n, a in lc.items() if n != "block_tables"}
+            if meta:
+                lc = {n: a for n, a in lc.items() if n not in meta}
             return hc, lc
 
         return body
 
     def _rebuild(self, cache, new_leaves, lengths):
         """Reassemble a cache pytree from updated per-layer leaves +
-        lengths, carrying the paged layout's block tables through
+        lengths, carrying the paged layout's metadata leaves through
         unchanged (the HOST allocator owns them; device programs only
         read)."""
-        out = {**new_leaves, "lengths": lengths}
-        if "block_tables" in cache:
-            out["block_tables"] = cache["block_tables"]
-        return out
+        return {**new_leaves, **self._meta(cache), "lengths": lengths}
 
     def _model_block(self, params, cache, tokens, rows, pos):
         """The shared incremental-decode model body: embed ``tokens``
@@ -480,8 +551,7 @@ class InferenceEngine:
         cos_b, sin_b = rope_at_positions(self._cos, self._sin, rows)
         h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
         leaves, _ = self._split_cache(cache)
-        body = self._layer_body(cos_b, sin_b, pos,
-                                cache.get("block_tables"))
+        body = self._layer_body(cos_b, sin_b, pos, self._meta(cache))
         h, new_leaves = lax.scan(body, h, (params["layers"], leaves))
         logits = tp_gather(llama.head_logits(params, h, self.cfg))
         return new_leaves, logits.astype(jnp.float32)
@@ -498,7 +568,10 @@ class InferenceEngine:
     def _decode_impl(self, params, cache, tokens, key, temperature,
                      top_k, top_p):
         """One autoregressive step for all slots: tokens [B] (each slot's
-        current last token), cache lengths give every slot its position."""
+        current last token), cache lengths give every slot its position.
+        Sampling always runs on device; with the epilogue enabled the
+        [B, V] logits are additionally DROPPED from the outputs, so the
+        dispatch's host payload is the [B] token ids alone."""
         pos = cache["lengths"]
         new_leaves, logits = self._decode_core(params, cache, tokens)
         next_tok = sampling.sample(logits, key, temperature, top_k, top_p)
@@ -506,6 +579,8 @@ class InferenceEngine:
         # length 0 — their row-0 writes are never visible
         new_cache = self._rebuild(cache, new_leaves,
                                   jnp.where(pos > 0, pos + 1, 0))
+        if self.sample_on_device:
+            return new_cache, next_tok
         return new_cache, next_tok, logits
 
     def _decode_block_impl(self, params, cache, tokens, keys, eos_id,
@@ -613,7 +688,8 @@ class InferenceEngine:
                                   jnp.where(active, pos0 + counts, pos0))
         return new_cache, emitted, counts, accepted
 
-    def _prefill_chunk_impl(self, params, cache, tokens, slot, start, valid):
+    def _prefill_chunk_impl(self, params, cache, tokens, slot, start, valid,
+                            *sample):
         """One fixed-width prefill chunk for one slot: tokens [1, C] (pad
         past ``valid``), written into the cache at rows
         [start, start + C) of ``slot``. Queries attend causally over the
@@ -621,7 +697,12 @@ class InferenceEngine:
         S = C); pad queries' outputs and their K/V rows beyond
         ``start + valid`` sit past the final length — unreachable. Returns
         (cache with lengths[slot] = start + valid, the last valid token's
-        logits [1, V] fp32 — consumed by the caller on the final chunk)."""
+        logits [1, V] fp32 — consumed by the caller on the final chunk).
+        With the on-device epilogue, ``sample`` is (key, temperature,
+        top_k, top_p) and the second return is the sampled token [1]
+        int32 instead — every chunk samples from the SAME key (cheap next
+        to the model body) and only the final chunk's draw is consumed,
+        so no key is ever burned on an intermediate chunk."""
         cfg = self.cfg
         C = tokens.shape[1]
         start = jnp.asarray(start, jnp.int32)
@@ -648,12 +729,15 @@ class InferenceEngine:
         h_last = jnp.take_along_axis(
             h, jnp.full((1, 1, 1), idx, jnp.int32), axis=1)
         last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
+        last = last.astype(jnp.float32)
         new_cache = {**new_leaves,
                      "lengths": lengths.at[slot].set(start + valid)}
-        return new_cache, last.astype(jnp.float32)
+        if self.sample_on_device:
+            return new_cache, self._epilogue(last, *sample)
+        return new_cache, last
 
     def _prefill_chunk_impl_paged(self, params, cache, tokens, slot, start,
-                                  valid):
+                                  valid, *sample):
         """Paged counterpart of ``_prefill_chunk_impl``: the slot's pages
         cannot be sliced out as a contiguous block, so the layer scan runs
         against the whole pool with the slot's block-table row (B = 1) —
@@ -670,15 +754,19 @@ class InferenceEngine:
         row = lax.dynamic_slice_in_dim(cache["block_tables"], slot, 1,
                                        axis=0)  # [1, max_pages]
         pos = jnp.full((1,), start, jnp.int32)
-        body = self._layer_body(cos_b, sin_b, pos, row)
+        meta = {**self._meta(cache), "block_tables": row}
+        body = self._layer_body(cos_b, sin_b, pos, meta)
         h, new_leaves = lax.scan(body, h, (params["layers"], leaves))
         idx = jnp.clip(valid - 1, 0, C - 1)
         h_last = jnp.take_along_axis(
             h, jnp.full((1, 1, 1), idx, jnp.int32), axis=1)
         last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
+        last = last.astype(jnp.float32)
         new_cache = self._rebuild(cache, new_leaves,
                                   lengths.at[slot].set(start + valid))
-        return new_cache, last.astype(jnp.float32)
+        if self.sample_on_device:
+            return new_cache, self._epilogue(last, *sample)
+        return new_cache, last
 
     # ---- host-facing API ---------------------------------------------------
 
@@ -704,8 +792,13 @@ class InferenceEngine:
         """Ship the host allocator's block-table master to the device
         (replacing the donated copy the last dispatch consumed). Tiny
         ([slots, max_pages] int32) and unconditional — simpler than dirty
-        tracking and invisible next to a model dispatch."""
-        return {**cache, "block_tables": jnp.asarray(self.paged.tables)}
+        tracking and invisible next to a model dispatch. hot_bf16 policy
+        engines refresh the per-page read flags from live refcounts in
+        the same breath, so sharing changes take effect next dispatch."""
+        out = {**cache, "block_tables": jnp.asarray(self.paged.tables)}
+        if self.page_policy:
+            out["page_quant"] = jnp.asarray(self.paged.quant_flags())
+        return out
 
     def _ensure(self, cache, slot: int, from_pos: int, to_pos: int) -> dict:
         """Make rows [from_pos, to_pos) of ``slot`` writable before a
@@ -747,10 +840,41 @@ class InferenceEngine:
             b *= 2
         return min(b, self.max_seq_len)
 
-    def prefill(self, params, prompt_ids) -> tuple:
+    def _sample_args(self, sample) -> tuple:
+        """Normalize a host caller's ``sample=(key, temperature, top_k,
+        top_p)`` into the epilogue's device operands — and enforce that
+        callers and the engine agree on WHERE sampling happens, so a
+        host-sampling caller can never silently read a token id as
+        logits (or vice versa)."""
+        if not self.sample_on_device:
+            if sample is not None:
+                raise ValueError(
+                    "this engine samples host-side (inference."
+                    "sample_on_device: false); drop the sample argument "
+                    "or build the engine with sample_on_device=True")
+            return ()
+        if sample is None:
+            raise ValueError(
+                "this engine runs the on-device sampling epilogue "
+                "(inference.sample_on_device: true); pass sample=(key, "
+                "temperature, top_k, top_p) so the dispatch can draw the "
+                "next token without shipping logits to the host")
+        key, temperature, top_k, top_p = sample
+        return (jnp.asarray(key),
+                jnp.asarray(np.asarray(temperature, np.float32).reshape(1)),
+                jnp.asarray(np.asarray(top_k, np.int32).reshape(1)),
+                jnp.asarray(np.asarray(top_p, np.float32).reshape(1)))
+
+    def prefill(self, params, prompt_ids, sample=None) -> tuple:
         """Run one prompt through the full-sequence model. Returns
-        (kv_blocks, last_logits [1, V] fp32). Pads to the prompt's bucket
-        host-side; jit reuses one executable per bucket size."""
+        (kv_blocks, last_logits [1, V] fp32) — or, on a
+        ``sample_on_device`` engine (which REQUIRES ``sample=(key,
+        temperature, top_k, top_p)``), (kv_blocks, sampled token [1]
+        int32): the fused epilogue draws the first generated token inside
+        the dispatch and the full-vocab logits never cross to the host.
+        Pads to the prompt's bucket host-side; jit reuses one executable
+        per bucket size."""
+        samp = self._sample_args(sample)
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -759,20 +883,25 @@ class InferenceEngine:
         padded[0, : ids.size] = ids
         self._hook("prefill")
         return self._prefill_jit(params, jnp.asarray(padded),
-                                 jnp.asarray([ids.size], jnp.int32))
+                                 jnp.asarray([ids.size], jnp.int32), *samp)
 
     def prefill_chunked(self, params, cache, prompt_ids, slot: int,
-                        start: int = 0) -> tuple:
+                        start: int = 0, sample=None) -> tuple:
         """Prefill one prompt as fixed-width chunk dispatches writing K/V
         straight into ``slot`` (consumes ``cache``). Returns (cache,
-        last_logits [1, V] fp32). One compiled shape regardless of prompt
-        length; the ragged final chunk pads to the chunk width with rows
-        past the final length unreachable.
+        last_logits [1, V] fp32) — or (cache, sampled token [1] int32) on
+        a ``sample_on_device`` engine: every chunk runs the epilogue from
+        the SAME key (only the final chunk's draw is consumed, so the key
+        chain matches the host sampler's exactly) and no chunk ever ships
+        logits. One compiled shape regardless of prompt length; the
+        ragged final chunk pads to the chunk width with rows past the
+        final length unreachable.
 
         ``start`` > 0 resumes past an already-parked prefix (the paged
         prefix-sharing admission: rows [0, start) are cached pages the
         chunks attend over but never recompute). ``prompt_ids`` is always
         the FULL prompt — chunk positions are absolute."""
+        samp = self._sample_args(sample)
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -817,15 +946,17 @@ class InferenceEngine:
                 params, cache, jnp.asarray(padded),
                 jnp.asarray(slot, jnp.int32),
                 jnp.asarray(w0, jnp.int32),
-                jnp.asarray(chunk.size, jnp.int32)))
+                jnp.asarray(chunk.size, jnp.int32), *samp))
             if self.paged is not None:
                 self.paged.set_len(slot, end)
         return cache, logits
 
-    def prefill_paged(self, params, cache, prompt_ids, slot: int) -> tuple:
+    def prefill_paged(self, params, cache, prompt_ids, slot: int,
+                      sample=None) -> tuple:
         """Paged admission: prefix-match, share, and prefill one prompt
         into ``slot`` (consumes ``cache``). Returns (cache, last_logits
-        [1, V] fp32, n_dispatches, cached_tokens).
+        [1, V] fp32 — or the sampled token [1] int32 on a
+        ``sample_on_device`` engine — n_dispatches, cached_tokens).
 
         The radix cache resolves the longest cached prefix; its pages are
         shared into the slot (refcount bumps — ZERO prefill work for
@@ -847,14 +978,16 @@ class InferenceEngine:
             cache = self._set_length_jit(self._sync_tables(cache), slot,
                                          cached)
             cache, logits = self.prefill_chunked(params, cache, ids, slot,
-                                                 start=cached)
+                                                 start=cached,
+                                                 sample=sample)
             n = -(-(len(ids) - cached) // self.prefill_chunk)
         elif len(ids) <= self.prefill_chunk:
-            kv, logits = self.prefill(params, ids)
+            kv, logits = self.prefill(params, ids, sample=sample)
             cache = self.insert(cache, kv, slot, len(ids))
             n = 1
         else:
-            cache, logits = self.prefill_chunked(params, cache, ids, slot)
+            cache, logits = self.prefill_chunked(params, cache, ids, slot,
+                                                 sample=sample)
             n = -(-len(ids) // self.prefill_chunk)
         self.paged.register_prompt(slot, ids)
         return cache, logits, n, cached
@@ -883,7 +1016,10 @@ class InferenceEngine:
                     top_k, top_p) -> tuple:
         """One token for every slot. tokens/temperature/top_k/top_p are
         [slots] host or device arrays; returns (cache, next_tokens [slots],
-        logits [slots, V] fp32). Consumes ``cache``."""
+        logits [slots, V] fp32). On a ``sample_on_device`` engine the
+        logits slot is None — the [B, V] array never leaves the device
+        (the [B] token ids are the dispatch's whole host payload).
+        Consumes ``cache``."""
         self._hook("decode")
         if self.paged is not None:
             cache = self._pre_write(cache, 1)
@@ -896,6 +1032,9 @@ class InferenceEngine:
         if self.paged is not None:
             # mirror the device rule: parked slots advanced by one
             self.paged.advance((self.paged.host_len > 0).astype(np.int64))
+        if self.sample_on_device:
+            cache, toks = out
+            return cache, toks, None
         return out
 
     def decode_block(self, params, cache, tokens, keys, eos_id, budget,

@@ -203,10 +203,14 @@ def attend(q: jnp.ndarray, layer_cache: dict, lengths: jnp.ndarray,
       bit-pinned reference path);
     - "flash": the Pallas flash-decode kernel
       (ops/pallas/decode_attention.py) — KV blocks are read only up to
-      each slot's live length, int8 bytes + per-row scales travel to the
-      kernel as stored and dequantize in registers: no whole-cache fp32
-      materialization ever exists on this path. Runs in interpret mode off
-      TPU; allclose-pinned against dense (tests/test_decode_kernel.py).
+      each slot's live length with DOUBLE-BUFFERED DMA (block j+1's copy
+      commits while block j's dots run), int8 bytes + per-row scales
+      travel to the kernel as stored and dequantize in registers: no
+      whole-cache fp32 materialization ever exists on this path. Wide
+      chunked-prefill query windows split over a q-block grid axis
+      (flash_attention's causal block-skip bounds each tile's walk).
+      Runs in interpret mode off TPU; allclose-pinned against dense
+      (tests/test_decode_kernel.py).
 
     Paged caches (the per-layer dict carries ``block_tables``) route to
     the page-indirect attends (inference/paged_kv.py): dense gathers the

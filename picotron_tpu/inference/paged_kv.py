@@ -76,25 +76,58 @@ class PagePoolExhausted(RuntimeError):
 # --------------------------------------------------------------------------- #
 
 
-def cache_pspecs(quantized: bool = False) -> dict:
+def cache_pspecs(quantized: bool = False, policy: bool = False) -> dict:
     """PartitionSpecs of the paged cache pytree: identical to the
     contiguous layout's (the kv-head axis of the pool — and of the int8
     scale tensors — shards over 'tp'; page axes are replicated), plus the
-    replicated ``block_tables``."""
+    replicated ``block_tables``. The ``hot_bf16`` policy adds the int8
+    side pool (``k_q``/``v_q`` + scales, same head sharding) and the
+    replicated per-page ``page_quant`` flags."""
     from jax.sharding import PartitionSpec as P
 
     specs = kv_cache.cache_pspecs(quantized)
     specs["block_tables"] = P()
+    if policy:
+        kv = P(None, None, None, "tp", None)
+        scale = P(None, None, None, "tp")
+        specs.update(k_q=kv, v_q=kv, k_scale=scale, v_scale=scale,
+                     page_quant=P())
     return specs
 
 
+# cache leaves with no layer axis: host-owned page metadata that rides as
+# a scan constant through the engine's layer scan and is skipped by every
+# per-page device op (copy_page slices the page axis, which these lack)
+META_LEAVES = ("lengths", "block_tables", "page_quant")
+
+
+def is_policy(cache: dict) -> bool:
+    """Whether a cache pytree (full or per-layer) carries the hot_bf16
+    dual-representation pool."""
+    return "k_q" in cache
+
+
 def init_cache(m: ModelConfig, slots: int, num_pages: int, page_len: int,
-               max_pages: int, dtype=None, quantized: bool = False) -> dict:
+               max_pages: int, dtype=None, quantized: bool = False,
+               policy: bool = False) -> dict:
     """Zeroed page pool + NULL block tables + zero lengths. Same dtype
-    rules as the contiguous ``kv_cache.init_cache``."""
+    rules as the contiguous ``kv_cache.init_cache``. ``policy`` (the
+    ``hot_bf16`` per-page policy) adds the int8 side pool: every write
+    lands in BOTH representations and the per-page ``page_quant`` flag —
+    refreshed from the host allocator's refcounts before each dispatch —
+    selects which one the attend READS, so a page can flip between hot
+    (full precision) and cold (int8) as sharing changes without ever
+    rewriting bytes. (This reference implementation keeps both
+    representations resident; a hardware allocator would partition one
+    arena and demote pages physically — staged exactly like the dense/
+    contiguous serving defaults.)"""
     shape = (m.num_hidden_layers, num_pages, page_len,
              m.num_key_value_heads, m.head_dim)
     if quantized:
+        if policy:
+            raise ValueError(
+                "hot_bf16 page policy is mutually exclusive with a "
+                "uniformly int8 cache (config.validate names the fix)")
         cache = {
             "k": jnp.zeros(shape, jnp.int8),
             "v": jnp.zeros(shape, jnp.int8),
@@ -104,6 +137,14 @@ def init_cache(m: ModelConfig, slots: int, num_pages: int, page_len: int,
     else:
         dt = jnp.dtype(dtype if dtype is not None else m.dtype)
         cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        if policy:
+            cache.update({
+                "k_q": jnp.zeros(shape, jnp.int8),
+                "v_q": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:-1], kv_cache.SCALE_DTYPE),
+                "v_scale": jnp.zeros(shape[:-1], kv_cache.SCALE_DTYPE),
+                "page_quant": jnp.zeros((num_pages,), jnp.int32),
+            })
     cache["block_tables"] = jnp.full((slots, max_pages), NULL_PAGE,
                                      jnp.int32)
     cache["lengths"] = jnp.zeros((slots,), jnp.int32)
@@ -145,8 +186,23 @@ def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
     rows = pos[:, None].astype(jnp.int32) + jnp.arange(S, dtype=jnp.int32)
     pid, off = _targets(bt, rows, page_len)  # [B, S] each
     out = dict(layer_cache)
+    policy = is_policy(layer_cache)
 
-    def store(name, sname, new):
+    def store(name, qname, sname, new):
+        if policy:
+            # hot_bf16 dual write: the fresh rows land in BOTH pool
+            # representations (full precision + int8 with scales), so the
+            # per-page flag can flip as sharing changes without rewriting
+            # bytes — the read side (attend) selects per page. Write
+            # traffic is S rows per dispatch, noise next to the attend's
+            # window read the policy halves.
+            qvals, scales = kv_cache.quantize_kv(new)
+            out[name] = layer_cache[name].at[pid, off].set(
+                new.astype(layer_cache[name].dtype))
+            out[qname] = layer_cache[qname].at[pid, off].set(qvals)
+            out[sname] = layer_cache[sname].at[pid, off].set(
+                scales.astype(kv_cache.SCALE_DTYPE))
+            return
         if kv_cache.quantized(layer_cache):
             vals, scales = kv_cache.quantize_kv(new)
         else:
@@ -156,8 +212,8 @@ def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
             out[sname] = layer_cache[sname].at[pid, off].set(
                 scales.astype(kv_cache.SCALE_DTYPE))
 
-    store("k", "k_scale", k_new)
-    store("v", "v_scale", v_new)
+    store("k", "k_q", "k_scale", k_new)
+    store("v", "v_q", "v_scale", v_new)
     return out
 
 
@@ -181,12 +237,25 @@ def attend(q: jnp.ndarray, layer_cache: dict, lengths: jnp.ndarray,
     tables to the Pallas kernel, which DMAs pages straight from HBM —
     no gathered window ever exists on that path."""
     bt = layer_cache["block_tables"]
+    policy = is_policy(layer_cache)
     if impl == "flash":
         from picotron_tpu.ops.pallas.decode_attention import (
             flash_decode_attention,
         )
         from picotron_tpu.utils import on_tpu
 
+        if policy:
+            # mixed-precision page read: the per-page flag — gathered
+            # through the block table into [B, max_pages] SMEM rows —
+            # decides which pool representation each page's DMA fetches
+            return flash_decode_attention(
+                q, layer_cache["k"], layer_cache["v"], lengths, scale,
+                k_quant=layer_cache["k_q"], v_quant=layer_cache["v_q"],
+                k_scale=layer_cache["k_scale"],
+                v_scale=layer_cache["v_scale"],
+                block_tables=bt,
+                block_quant=jnp.take(layer_cache["page_quant"], bt, axis=0),
+                interpret=not on_tpu())
         return flash_decode_attention(
             q, layer_cache["k"], layer_cache["v"], lengths, scale,
             k_scale=layer_cache.get("k_scale"),
@@ -196,7 +265,24 @@ def attend(q: jnp.ndarray, layer_cache: dict, lengths: jnp.ndarray,
         raise ValueError(f"unknown attend impl {impl!r} (dense|flash)")
     k = gather_window(layer_cache["k"], bt)
     v = gather_window(layer_cache["v"], bt)
-    if kv_cache.quantized(layer_cache):
+    if policy:
+        # mixed dense read (the bit-pinned reference for the flash DMA
+        # path above): gather both representations' windows, dequantize
+        # the int8 one, and select per page — rows of a flagged page come
+        # from the quantized bytes, exactly what the kernel DMAs
+        page_len = layer_cache["k"].shape[1]
+        flags = jnp.repeat(jnp.take(layer_cache["page_quant"], bt, axis=0),
+                           page_len, axis=1)  # [B, max_pages*page_len]
+        quant = (flags != 0)[..., None, None]
+        kq = kv_cache.dequantize_kv(
+            gather_window(layer_cache["k_q"], bt),
+            gather_window(layer_cache["k_scale"], bt), jnp.float32)
+        vq = kv_cache.dequantize_kv(
+            gather_window(layer_cache["v_q"], bt),
+            gather_window(layer_cache["v_scale"], bt), jnp.float32)
+        k = jnp.where(quant, kq, k.astype(jnp.float32))
+        v = jnp.where(quant, vq, v.astype(jnp.float32))
+    elif kv_cache.quantized(layer_cache):
         k = kv_cache.dequantize_kv(
             k, gather_window(layer_cache["k_scale"], bt), jnp.float32)
         v = kv_cache.dequantize_kv(
@@ -226,9 +312,10 @@ def insert_prefill(cache: dict, kv: dict, slot, length) -> dict:
         src = kv[name][:, 0].astype(dst.dtype)  # [L, S, ...]
         return dst.at[:, pid, off].set(src)
 
-    out = {name: put(name) for name in cache
-           if name not in ("lengths", "block_tables")}
-    out["block_tables"] = bt
+    out = {name: put(name) for name in cache if name not in META_LEAVES}
+    for name in META_LEAVES:
+        if name in cache:
+            out[name] = cache[name]
     out["lengths"] = cache["lengths"].at[slot].set(length)
     return out
 
@@ -242,7 +329,7 @@ def copy_page(cache: dict, src, dst) -> dict:
     dst = jnp.asarray(dst, jnp.int32)
     out = dict(cache)
     for name, a in cache.items():
-        if name in ("lengths", "block_tables"):
+        if name in META_LEAVES:
             continue
         page = lax.dynamic_slice_in_dim(a, src, 1, axis=1)
         out[name] = lax.dynamic_update_slice_in_dim(a, page, dst, axis=1)
@@ -659,6 +746,17 @@ class PagedKV:
         if self.prefix_cache:
             self.radix.insert(ids, lambda i: int(self.tables[slot, i]))
 
+    def quant_flags(self) -> np.ndarray:
+        """Per-page ``hot_bf16`` policy flags for the device
+        (``page_quant``): 1 = read this page as int8 (cold — exactly one
+        holder), 0 = read at full precision (hot — radix-shared prefixes
+        and fork pages, anything with more than one holder; also free
+        pages, which nothing reads). Recomputed from live refcounts
+        before every dispatch (engine._sync_tables), so a page flips
+        hot<->cold as sharing changes — both representations are always
+        written, so the flip is metadata-only."""
+        return (self.pool.refs == 1).astype(np.int32)
+
     def advance(self, slot_counts: np.ndarray) -> None:
         """Mirror device length advancement after a dispatch (counts per
         slot, 0 for inactive)."""
@@ -702,4 +800,8 @@ class PagedKV:
             "prefix_cached_tokens": self.cached_tokens,
             "cow_copies": self.cow_copies,
             "radix_evictions": self.radix.evictions,
+            # hot_bf16 policy mix over LIVE pages (cold = read as int8);
+            # consumers that know the row byte widths (bench_decode's
+            # kv_bytes_per_token) weight their accounting with this
+            "kv_pages_quant": int(np.sum(self.pool.refs[1:] == 1)),
         }

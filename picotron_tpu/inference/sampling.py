@@ -16,6 +16,14 @@ decoding (engine.verify): exact-match for greedy rows, rejection sampling
 with residual-distribution resampling for stochastic rows — the emitted
 stream is distributionally identical to drawing token-by-token from
 ``sample`` over the same filtered logits.
+
+``sample`` is also the FUSED ON-DEVICE EPILOGUE
+(``inference.sample_on_device``): the engine's prefill/chunked-prefill/
+decode_step programs call it inside the jitted dispatch — the one
+descending sort of ``filter_top_k_top_p`` plus the categorical draw —
+so token ids, not ``[B, vocab]`` logits, are what crosses to the host.
+Same function, same key, either side of the boundary: that is what makes
+the epilogue seeded-identical to the host sampler by construction.
 """
 
 from __future__ import annotations

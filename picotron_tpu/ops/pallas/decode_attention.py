@@ -8,10 +8,12 @@ the live sequences are, and whose int8 mode first materializes a
 dequantized fp32 copy of the entire block (4x the bytes the cache stores).
 This kernel removes both costs:
 
-- **Length-aware**: the grid is ``(slots, kv_heads)`` and each instance
-  walks KV blocks with a ``fori_loop`` bounded by
-  ``ceil(lengths[b] / block_t)`` — its OWN slot's live token count, an
-  even tighter bound than ``max(lengths)`` — so HBM reads track parked
+- **Length-aware**: the grid is ``(slots, kv_heads, q_blocks)`` and each
+  instance walks KV blocks with a ``fori_loop`` bounded by
+  ``ceil(visible / block_t)`` — its OWN slot's live token count clipped to
+  the highest key its query rows can see (the same causal block-skip the
+  training flash kernel uses, shared via
+  ``flash_attention.causal_kv_blocks``) — so HBM reads track parked
   tokens, not the cache window. Keys inside the last partial block are
   masked per query row against the slot's ``lengths`` (the stale rows a
   speculative rollback or a freed slot leaves beyond the length pointer
@@ -32,6 +34,14 @@ This kernel removes both costs:
   ONE kernel serves all three call sites: blocked decode (S = 1),
   speculative verify (S = spec_len + 1, B = slots), and chunked prefill
   (B = 1, S = chunk width).
+- **Blocked queries for chunked prefill**: wide query groups (S*g beyond
+  ``block_q`` folded rows — the chunked-prefill shape) split over the
+  third grid axis instead of shrinking the KV block to fit one giant
+  score tile: each q-block keeps a deep ``block_t``, walks only the KV
+  blocks its own causal band can see, and the q-blocks parallelize
+  across the grid — ``flash_attention.py``'s block machinery applied to
+  the cache-prefix+chunk window. Decode/verify shapes (a handful of
+  rows) fold to a single q-block, exactly the old layout.
 
 Softmax is the standard online (flash) recurrence in fp32: running max
 ``m``, normalizer ``l``, and accumulator ``acc`` per query row, masked
@@ -45,13 +55,26 @@ interpret mode).
 Hardware notes: K/V (+ scales) are handed to the kernel in ``pl.ANY``
 memory space (they stay in HBM) and each block is pulled with
 ``pltpu.make_async_copy`` into VMEM scratch; query rows pad to a multiple
-of 8 sublanes. Blocks are fetched serially (no double buffering yet —
-decode is a bandwidth-bound dot per block, and the DMA engine overlaps
-across grid instances); on CPU the kernel runs in Pallas interpret mode
-(``interpret=True``), which is how the parity suite and the tier-1 gate
-exercise it. Dense remains the serving default (``inference.attend_impl``)
-until the kernel is A/B'd on a chip, the same staging discipline the
-``bshd`` flash layout went through.
+of 8 sublanes. Block fetches are **double-buffered** (``pipeline=True``,
+the default): two VMEM scratch buffers per operand and iteration ``j``
+prefetches block ``j+1`` into the idle buffer before waiting on its own,
+so the next block's DMA commits while the current block's dots run — the
+async-send/compute overlap the reference survey credits for its MFU
+(SURVEY §5.7). ``pipeline=False`` keeps the serial fetch (one buffer,
+start-wait-compute per block) as the bitwise-identical reference the
+parity suite pins the pipelined path against. On CPU the kernel runs in
+Pallas interpret mode (``interpret=True``), which is how the parity suite
+and the tier-1 gate exercise it. Dense remains the serving default
+(``inference.attend_impl``) until the kernel is A/B'd on a chip, the same
+staging discipline the ``bshd`` flash layout went through.
+
+``block_tables`` switches to the PAGED layout (one DMA per pool page);
+``block_quant`` additionally enables the **mixed-precision page read**
+(``inference.kv_page_policy: "hot_bf16"`` — inference/paged_kv.py): each
+page carries a per-page flag choosing which of the two pool
+representations to DMA — the full-precision leaves for hot (radix-shared)
+prefix pages, the int8+scales leaves for cold unique tails — so shared
+prefixes keep full precision while the long tail moves ~half the bytes.
 
 **The program_id trap (picolint rule PICO-J003).** ``pl.program_id`` must
 be read ONCE, outside the ``fori_loop`` body: the jax 0.4.37 Pallas
@@ -59,11 +82,23 @@ interpreter cannot resolve grid ids inside a loop body's sub-jaxpr, so a
 kernel that reads ``pl.program_id`` under ``fori_loop``/``while_loop``
 traces fine on TPU but fails (or silently misindexes) on the interpret
 path every CPU test runs. This kernel hit exactly that during PR 5 — the
-fix is the ``b``/``h`` reads at the top of ``_flash_decode_kernel``,
-before ``body`` closes over them. The hazard is now enforced
-mechanically: ``python -m picotron_tpu.tools.lint`` flags any
-``program_id`` read inside a loop-body closure as PICO-J003
-(picotron_tpu/analysis/jax_rules.py; catalog: docs/ANALYSIS.md#pico-j003).
+fix is the ``b``/``h``/``qi`` reads at the top of
+``_flash_decode_kernel``, before ``body`` closes over them.
+
+**The two-buffer semaphore discipline (picolint rule PICO-J005).** With
+double buffering, iteration ``j`` owns buffer slot ``j % 2`` and its
+semaphore column ``sems[j % 2, :]``; the prefetch of block ``j+1``
+targets the OTHER slot, so the only write-after-read hazard (re-filling a
+buffer the current iteration still reads) is structurally impossible —
+the body runs sequentially and the j+2 prefetch happens one full
+iteration after slot ``j % 2``'s compute finished. Every ``start()`` has
+a matching ``wait()`` built from the same (source, destination,
+semaphore) triple — in the mixed-page mode both live under the SAME
+``pl.when`` predicate, so a wait can never block on a copy that was
+never started. A ``make_async_copy`` whose wait is missing (or sits off
+some fori_loop path its start runs on) is now flagged mechanically as
+PICO-J005 (picotron_tpu/analysis/jax_rules.py; catalog:
+docs/ANALYSIS.md#pico-j005), like the program_id trap before it.
 """
 
 from __future__ import annotations
@@ -77,17 +112,26 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from picotron_tpu.ops.attention import NEG_INF
-from picotron_tpu.ops.pallas.flash_attention import _pick_block
+from picotron_tpu.ops.pallas.flash_attention import (
+    _pick_block,
+    causal_kv_blocks,
+)
 
 # KV rows fetched per DMA; halved automatically until the block divides the
-# cache window AND the [S*g, block_t] fp32 score tile stays under
+# cache window AND the [block_q, block_t] fp32 score tile stays under
 # _MAX_SCORE_TILE elements (see _pick_block_t).
 DEFAULT_BLOCK_T = 256
+# Folded query rows (S*g) per grid instance. Decode/verify shapes (S*g <= 8)
+# fold into one block; chunked-prefill windows wider than this split over
+# the q grid axis instead of shrinking block_t — the flash_attention.py
+# blocking applied to the decode kernel.
+DEFAULT_BLOCK_Q = 256
 # score-tile budget: 256K fp32 elements = 1 MB, the same tile scale the
 # training flash kernel's 512x512 default occupies — decode shapes
 # (S*g <= 8 rows) keep the full DEFAULT_BLOCK_T, wide chunked-prefill query
-# groups (S*g in the thousands) trade KV-block depth for row count so VMEM
-# never blows up with the chunk width
+# groups (S*g in the thousands) first split over the q grid axis and only
+# then trade KV-block depth for row count, so VMEM never blows up with the
+# chunk width
 _MAX_SCORE_TILE = 256 * 1024
 _SUBLANE = 8  # fp32 sublane quantum the padded query-row count respects
 
@@ -104,63 +148,200 @@ def _pick_block_t(seq: int, want: int, rows: int = _SUBLANE) -> int:
     return _pick_block(seq, want)
 
 
-def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized, paged):
-    """One (slot, kv head) grid instance: all S*g query rows of slot ``b``
-    under kv head ``h`` against the slot's live KV blocks. ``paged``
-    mode walks the slot's block-table row instead of contiguous blocks:
-    iteration ``j`` DMAs pool page ``bt[b, j]`` (K/V are the global
-    ``[num_pages, page_len, Hkv, D]`` pool, ``block_t == page_len``) —
-    the indirection lives entirely in the DMA source address, the
-    online-softmax math is unchanged."""
+def _pick_block_q(sgp: int, want: int, block_t: int) -> int:
+    """Folded query rows per grid instance: at or under ``want``, dividing
+    the padded row count, shrunk until the [rows, block_t] fp32 score tile
+    fits the VMEM budget (the paged layout fixes block_t at the page
+    length, so rows are the only tunable there)."""
+    rq = _pick_block(sgp, want)
+    while rq > _SUBLANE and rq * block_t > _MAX_SCORE_TILE:
+        rq = _pick_block(sgp, rq // 2)
+    return rq
+
+
+def _flash_decode_kernel(*refs, scale, block_t, S, g, rq, quantized, mixed,
+                         paged, pipeline):
+    """One (slot, kv head, q-block) grid instance: ``rq`` folded query
+    rows of slot ``b`` under kv head ``h`` against the slot's visible KV
+    blocks. ``paged`` mode walks the slot's block-table row instead of
+    contiguous blocks: iteration ``j`` DMAs pool page ``bt[b, j]`` (K/V
+    are the global ``[num_pages, page_len, Hkv, D]`` pool,
+    ``block_t == page_len``) — the indirection lives entirely in the DMA
+    source address, the online-softmax math is unchanged. ``mixed`` adds
+    the per-page dtype flag (``qt[b, j]``) choosing which pool
+    representation iteration ``j`` fetches. ``pipeline`` double-buffers
+    the fetches (see the module docstring's semaphore discipline)."""
     refs = list(refs)
     len_ref = refs.pop(0)
     bt_ref = refs.pop(0) if paged else None
-    if quantized:
-        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
-         kbuf, vbuf, ksbuf, vsbuf, sems) = refs
-    else:
-        (q_ref, k_ref, v_ref, o_ref, kbuf, vbuf, sems) = refs
-        ks_ref = vs_ref = ksbuf = vsbuf = None
+    qt_ref = refs.pop(0) if mixed else None
+    q_ref = refs.pop(0)
+    k_ref = refs.pop(0)
+    v_ref = refs.pop(0)
+    kq_ref = refs.pop(0) if mixed else None
+    vq_ref = refs.pop(0) if mixed else None
+    scaled = quantized or mixed
+    ks_ref = refs.pop(0) if scaled else None
+    vs_ref = refs.pop(0) if scaled else None
+    o_ref = refs.pop(0)
+    kbuf, vbuf = refs.pop(0), refs.pop(0)
+    kqbuf = refs.pop(0) if mixed else None
+    vqbuf = refs.pop(0) if mixed else None
+    ksbuf = refs.pop(0) if scaled else None
+    vsbuf = refs.pop(0) if scaled else None
+    sems = refs.pop(0)
     # program ids are read ONCE here: the 0.4.37 interpreter cannot resolve
     # pl.program_id inside the fori_loop body's sub-jaxpr (enforced as
     # picolint PICO-J003 — see the module docstring)
     b = pl.program_id(0)
     h = pl.program_id(1)
+    qi = pl.program_id(2)
     L = len_ref[0]  # this slot's live token count
-    q = q_ref[0, 0].astype(jnp.float32)  # [Sgp, D]
-    sgp = q.shape[0]
+    q = q_ref[0, 0].astype(jnp.float32)  # [rq, D]
+    r0 = qi * rq  # first folded query row of this tile
     # query row r = s*g + g_idx sits at global position L - S + s
     pos_q = (L - S
-             + lax.broadcasted_iota(jnp.int32, (sgp, block_t), 0) // g)
-    kiota = lax.broadcasted_iota(jnp.int32, (sgp, block_t), 1)
+             + (r0 + lax.broadcasted_iota(jnp.int32, (rq, block_t), 0)) // g)
+    kiota = lax.broadcasted_iota(jnp.int32, (rq, block_t), 1)
+
+    def _srcs(j):
+        """Iteration j's DMA source slices (K, V, and the scale rows)."""
+        if paged:
+            pid = bt_ref[0, j]
+            return (lambda ref: ref.at[pid, :, h, :],
+                    lambda ref: ref.at[pid, :, h])
+        rows = pl.ds(j * block_t, block_t)
+        return (lambda ref: ref.at[b, rows, h, :],
+                lambda ref: ref.at[b, rows, h])
+
+    # start/wait pairs are built from the SAME (src, dst, sem) triples, so
+    # a wait always matches the copy its iteration/slot started — the
+    # PICO-J005 discipline. sems column layout: 0=K(+q), 1=V(+q),
+    # 2=k_scale, 3=v_scale.
+    if mixed:
+        def _flag(j):
+            return qt_ref[0, j] != 0
+
+        def start(j, slot):
+            path, spath = _srcs(j)
+            isq = _flag(j)
+
+            @pl.when(isq)
+            def _():  # cold page: int8 bytes + per-row scales
+                pltpu.make_async_copy(path(kq_ref), kqbuf.at[slot],
+                                      sems.at[slot, 0]).start()
+                pltpu.make_async_copy(path(vq_ref), vqbuf.at[slot],
+                                      sems.at[slot, 1]).start()
+                pltpu.make_async_copy(spath(ks_ref), ksbuf.at[slot],
+                                      sems.at[slot, 2]).start()
+                pltpu.make_async_copy(spath(vs_ref), vsbuf.at[slot],
+                                      sems.at[slot, 3]).start()
+
+            @pl.when(~isq)
+            def _():  # hot page: the full-precision leaves
+                pltpu.make_async_copy(path(k_ref), kbuf.at[slot],
+                                      sems.at[slot, 0]).start()
+                pltpu.make_async_copy(path(v_ref), vbuf.at[slot],
+                                      sems.at[slot, 1]).start()
+
+        def wait_k(j, slot):
+            path, spath = _srcs(j)
+            isq = _flag(j)
+
+            @pl.when(isq)
+            def _():
+                pltpu.make_async_copy(path(kq_ref), kqbuf.at[slot],
+                                      sems.at[slot, 0]).wait()
+                pltpu.make_async_copy(spath(ks_ref), ksbuf.at[slot],
+                                      sems.at[slot, 2]).wait()
+
+            @pl.when(~isq)
+            def _():
+                pltpu.make_async_copy(path(k_ref), kbuf.at[slot],
+                                      sems.at[slot, 0]).wait()
+            deq = kqbuf[slot].astype(jnp.float32) * ksbuf[slot][:, None]
+            return jnp.where(isq, deq, kbuf[slot].astype(jnp.float32))
+
+        def wait_v(j, slot):
+            path, spath = _srcs(j)
+            isq = _flag(j)
+
+            @pl.when(isq)
+            def _():
+                pltpu.make_async_copy(path(vq_ref), vqbuf.at[slot],
+                                      sems.at[slot, 1]).wait()
+                pltpu.make_async_copy(spath(vs_ref), vsbuf.at[slot],
+                                      sems.at[slot, 3]).wait()
+
+            @pl.when(~isq)
+            def _():
+                pltpu.make_async_copy(path(v_ref), vbuf.at[slot],
+                                      sems.at[slot, 1]).wait()
+            deq = vqbuf[slot].astype(jnp.float32) * vsbuf[slot][:, None]
+            return jnp.where(isq, deq, vbuf[slot].astype(jnp.float32))
+    else:
+        def start(j, slot):
+            path, spath = _srcs(j)
+            pltpu.make_async_copy(path(k_ref), kbuf.at[slot],
+                                  sems.at[slot, 0]).start()
+            pltpu.make_async_copy(path(v_ref), vbuf.at[slot],
+                                  sems.at[slot, 1]).start()
+            if quantized:
+                pltpu.make_async_copy(spath(ks_ref), ksbuf.at[slot],
+                                      sems.at[slot, 2]).start()
+                pltpu.make_async_copy(spath(vs_ref), vsbuf.at[slot],
+                                      sems.at[slot, 3]).start()
+
+        def wait_k(j, slot):
+            path, spath = _srcs(j)
+            pltpu.make_async_copy(path(k_ref), kbuf.at[slot],
+                                  sems.at[slot, 0]).wait()
+            kb = kbuf[slot].astype(jnp.float32)
+            if quantized:
+                pltpu.make_async_copy(spath(ks_ref), ksbuf.at[slot],
+                                      sems.at[slot, 2]).wait()
+                kb = kb * ksbuf[slot][:, None]  # dequant in registers
+            return kb
+
+        def wait_v(j, slot):
+            path, spath = _srcs(j)
+            pltpu.make_async_copy(path(v_ref), vbuf.at[slot],
+                                  sems.at[slot, 1]).wait()
+            vb = vbuf[slot].astype(jnp.float32)
+            if quantized:
+                pltpu.make_async_copy(spath(vs_ref), vsbuf.at[slot],
+                                      sems.at[slot, 3]).wait()
+                vb = vb * vsbuf[slot][:, None]
+            return vb
+
+    # the whole point: the block walk is bounded by THIS slot's live
+    # length, never by max_seq_len — a fresh slot (L == 0) runs no
+    # iterations and costs no HBM reads at all. Clipped twice: (a) to the
+    # highest key this q-tile's causal band can see (the flash_attention
+    # block-skip — early chunked-prefill q-blocks never walk the whole
+    # window), and (b) to the window's block count: at the window edge the
+    # engine's write-then-attend convention can pass
+    # lengths = pos + S > T (the scatter dropped the out-of-bounds rows),
+    # and the walk must not DMA past the cache (the dense kernel's mask
+    # absorbs the same case for free). Paged mode clamps to the
+    # block-table width instead.
+    max_nb = bt_ref.shape[1] if paged else k_ref.shape[1] // block_t
+    hi = jnp.clip(L - S + (r0 + rq - 1) // g, -1, L - 1)  # last visible key
+    nb = jnp.maximum(causal_kv_blocks(max_nb, hi, block_t), 0)
 
     def body(j, carry):
         acc, m, l = carry
-        if paged:
-            # the page walk: block j's DMA source is pool page bt[b, j]
-            pid = bt_ref[0, j]
-            ksrc, vsrc = k_ref.at[pid, :, h, :], v_ref.at[pid, :, h, :]
-            kssrc = None if not quantized else ks_ref.at[pid, :, h]
-            vssrc = None if not quantized else vs_ref.at[pid, :, h]
+        if pipeline:
+            slot = lax.rem(j, 2)
+
+            @pl.when(j + 1 < nb)
+            def _():  # commit block j+1 into the idle buffer NOW; the
+                # dots below overlap with its DMA (SURVEY §5.7's overlap)
+                start(j + 1, 1 - slot)
         else:
-            rows = pl.ds(j * block_t, block_t)
-            ksrc, vsrc = k_ref.at[b, rows, h, :], v_ref.at[b, rows, h, :]
-            kssrc = None if not quantized else ks_ref.at[b, rows, h]
-            vssrc = None if not quantized else vs_ref.at[b, rows, h]
-        kdma = pltpu.make_async_copy(ksrc, kbuf, sems.at[0])
-        vdma = pltpu.make_async_copy(vsrc, vbuf, sems.at[1])
-        kdma.start()
-        vdma.start()
-        if quantized:
-            ksdma = pltpu.make_async_copy(kssrc, ksbuf, sems.at[2])
-            vsdma = pltpu.make_async_copy(vssrc, vsbuf, sems.at[3])
-            ksdma.start()
-            vsdma.start()
-        kdma.wait()
-        kb = kbuf[...].astype(jnp.float32)  # [bt, D]
-        if quantized:
-            ksdma.wait()
-            kb = kb * ksbuf[...][:, None]  # dequant in registers
+            slot = 0
+            start(j, slot)
+        kb = wait_k(j, slot)  # [bt, D] fp32
         s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         mask = (j * block_t + kiota) <= pos_q
@@ -172,30 +353,21 @@ def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized, paged):
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        vdma.wait()
-        vb = vbuf[...].astype(jnp.float32)
-        if quantized:
-            vsdma.wait()
-            vb = vb * vsbuf[...][:, None]
+        vb = wait_v(j, slot)
         acc = acc * alpha + lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l
 
+    if pipeline:
+        @pl.when(nb > 0)
+        def _():  # warm-up: block 0's DMA is in flight before the loop
+            start(0, 0)
+
     d = q.shape[1]
-    acc0 = jnp.zeros((sgp, d), jnp.float32)
-    m0 = jnp.full((sgp, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((sgp, 1), jnp.float32)
-    # the whole point: the block walk is bounded by THIS slot's live
-    # length, never by max_seq_len — a fresh slot (L == 0) runs no
-    # iterations and costs no HBM reads at all. Clamped to the window's
-    # block count: at the window edge the engine's write-then-attend
-    # convention can pass lengths = pos + S > T (the scatter dropped the
-    # out-of-bounds rows), and the walk must not DMA past the cache
-    # (the dense kernel's mask absorbs the same case for free). Paged
-    # mode clamps to the block-table width instead.
-    max_nb = bt_ref.shape[1] if paged else k_ref.shape[1] // block_t
-    nb = jnp.minimum(lax.div(L + block_t - 1, block_t), max_nb)
+    acc0 = jnp.zeros((rq, d), jnp.float32)
+    m0 = jnp.full((rq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rq, 1), jnp.float32)
     acc, _, l = lax.fori_loop(0, nb, body, (acc0, m0, l0))
     out = acc / jnp.where(l > 0, l, 1.0)
     o_ref[0, 0] = jnp.where(l > 0, out, 0.0).astype(o_ref.dtype)
@@ -203,8 +375,12 @@ def _flash_decode_kernel(*refs, scale, block_t, S, g, quantized, paged):
 
 def flash_decode_attention(q, k, v, lengths, scale, *,
                            k_scale=None, v_scale=None,
+                           k_quant=None, v_quant=None,
+                           block_quant=None,
                            block_t: int | None = None,
+                           block_q: int | None = None,
                            block_tables=None,
+                           pipeline: bool = True,
                            interpret: bool = False):
     """Fused masked attention of S fresh queries per slot against a KV
     cache block, reading only live rows.
@@ -228,9 +404,32 @@ def flash_decode_attention(q, k, v, lengths, scale, *,
     ``b``'s walk reads pool page ``block_tables[b, j]`` at iteration
     ``j`` instead of its contiguous block ``j``. The KV block size is
     the page length; everything else (masking, online softmax, GQA fold,
-    in-register dequant) is the identical code path."""
+    in-register dequant) is the identical code path.
+
+    ``k_quant``/``v_quant`` + ``block_quant`` ([B, max_pages] int32, paged
+    only) enable the MIXED-precision page read (``kv_page_policy:
+    "hot_bf16"``): k/v stay the full-precision pool, k_quant/v_quant are
+    the parallel int8 pool with ``k_scale``/``v_scale`` per-row scales,
+    and page ``j`` of slot ``b`` is fetched from whichever representation
+    ``block_quant[b, j]`` selects (0 = full precision, nonzero = int8).
+
+    ``pipeline=True`` (default) double-buffers the block DMA — page
+    ``j+1``'s copy commits while page ``j``'s dots run; ``False`` keeps
+    the serial fetch the pipelined path is pinned bitwise-identical to.
+    ``block_q`` caps the folded query rows per grid instance (chunked
+    prefill splits wide windows over the q grid axis)."""
     B, S, nh, D = q.shape
     paged = block_tables is not None
+    mixed = k_quant is not None
+    if mixed != (v_quant is not None):
+        raise ValueError("k_quant and v_quant must be given together")
+    if mixed and not paged:
+        raise ValueError(
+            "mixed-precision pages (k_quant/v_quant) require the paged "
+            "layout (block_tables)")
+    if mixed and block_quant is None:
+        raise ValueError(
+            "mixed-precision pages need block_quant per-page flags")
     if paged:
         if block_tables.shape[0] != B:
             raise ValueError(
@@ -241,20 +440,29 @@ def flash_decode_attention(q, k, v, lengths, scale, *,
         T, nkv = k.shape[1], k.shape[2]
     if nh % nkv:
         raise ValueError(f"n_heads {nh} not a multiple of n_kv_heads {nkv}")
-    quantized = k_scale is not None
-    if quantized != (v_scale is not None):
+    quantized = (k_scale is not None) and not mixed
+    if (k_scale is not None) != (v_scale is not None):
         raise ValueError("k_scale and v_scale must be given together")
+    if mixed and k_scale is None:
+        raise ValueError("mixed-precision pages need k_scale/v_scale for "
+                         "the int8 representation")
     if (k.dtype == jnp.int8) != quantized:
         raise ValueError(
             f"int8 cache blocks need per-row scales (and vice versa); got "
-            f"k.dtype={k.dtype} with scales={'set' if quantized else 'unset'}")
+            f"k.dtype={k.dtype} with scales="
+            f"{'set' if k_scale is not None else 'unset'}")
     g = nh // nkv
     sg = S * g
     sgp = -(-sg // _SUBLANE) * _SUBLANE  # pad query rows to the sublane tile
     # paged: the DMA unit is a whole pool page, so the block size IS the
-    # page length (the allocator's granularity, already VMEM-sized)
-    bt = (k.shape[1] if paged
-          else _pick_block_t(T, block_t or DEFAULT_BLOCK_T, rows=sgp))
+    # page length (the allocator's granularity, already VMEM-sized) and
+    # the q-block count is the only VMEM-budget tunable
+    if paged:
+        bt = k.shape[1]
+        rq = _pick_block_q(sgp, block_q or DEFAULT_BLOCK_Q, bt)
+    else:
+        rq = _pick_block(sgp, block_q or DEFAULT_BLOCK_Q)
+        bt = _pick_block_t(T, block_t or DEFAULT_BLOCK_T, rows=rq)
     # fold [B, S, nkv, g, D] -> [B, nkv, S*g, D]: one kv head's whole query
     # group per grid instance (tiny copy — S is 1..chunk, never the cache)
     qf = q.reshape(B, S, nkv, g, D).swapaxes(1, 2).reshape(B, nkv, sg, D)
@@ -263,35 +471,48 @@ def flash_decode_attention(q, k, v, lengths, scale, *,
 
     kernel = functools.partial(
         _flash_decode_kernel, scale=float(scale), block_t=bt, S=S, g=g,
-        quantized=quantized, paged=paged)
+        rq=rq, quantized=quantized, mixed=mixed, paged=paged,
+        pipeline=pipeline)
     in_specs = [
-        pl.BlockSpec((1,), lambda b, h: (b,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1,), lambda b, h, i: (b,), memory_space=pltpu.SMEM),
     ]
     operands = [lengths.astype(jnp.int32)]
     if paged:
         maxp = block_tables.shape[1]
-        in_specs.append(pl.BlockSpec((1, maxp), lambda b, h: (b, 0),
+        in_specs.append(pl.BlockSpec((1, maxp), lambda b, h, i: (b, 0),
                                      memory_space=pltpu.SMEM))
         operands.append(block_tables.astype(jnp.int32))
+    if mixed:
+        maxp = block_tables.shape[1]
+        in_specs.append(pl.BlockSpec((1, maxp), lambda b, h, i: (b, 0),
+                                     memory_space=pltpu.SMEM))
+        operands.append(block_quant.astype(jnp.int32))
     in_specs += [
-        pl.BlockSpec((1, 1, sgp, D), lambda b, h: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, rq, D), lambda b, h, i: (b, h, i, 0)),
         pl.BlockSpec(memory_space=pltpu.ANY),  # K stays in HBM
         pl.BlockSpec(memory_space=pltpu.ANY),  # V stays in HBM
     ]
     operands += [qf, k, v]
-    scratch = [pltpu.VMEM((bt, D), k.dtype), pltpu.VMEM((bt, D), v.dtype)]
-    if quantized:
+    nbuf = 2 if pipeline else 1
+    scratch = [pltpu.VMEM((nbuf, bt, D), k.dtype),
+               pltpu.VMEM((nbuf, bt, D), v.dtype)]
+    if mixed:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        operands += [k_quant, v_quant]
+        scratch += [pltpu.VMEM((nbuf, bt, D), jnp.int8),
+                    pltpu.VMEM((nbuf, bt, D), jnp.int8)]
+    if quantized or mixed:
         in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
         operands += [k_scale, v_scale]
-        scratch += [pltpu.VMEM((bt,), jnp.float32),
-                    pltpu.VMEM((bt,), jnp.float32)]
-    scratch.append(pltpu.SemaphoreType.DMA((4,)))
+        scratch += [pltpu.VMEM((nbuf, bt), jnp.float32),
+                    pltpu.VMEM((nbuf, bt), jnp.float32)]
+    scratch.append(pltpu.SemaphoreType.DMA((nbuf, 4)))
 
     out = pl.pallas_call(
         kernel,
-        grid=(B, nkv),
+        grid=(B, nkv, sgp // rq),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, sgp, D), lambda b, h: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, rq, D), lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, nkv, sgp, D), q.dtype),
         scratch_shapes=scratch,
         interpret=interpret,

@@ -71,6 +71,18 @@ def _pick_block(seq: int, want: int) -> int:
     return max(b, 1)
 
 
+def causal_kv_blocks(nk, q_hi, block_k):
+    """Leading ``block_k``-row KV blocks that intersect key positions
+    ``<= q_hi`` — the causal block-skip bound. Shared machinery: the
+    training flash forward/backward kernels bound their key walk with it
+    (``q_hi`` = the q-tile's last row position), and the decode/chunked-
+    prefill kernel (ops/pallas/decode_attention.py) reuses it with
+    ``q_hi`` additionally clipped to the slot's live length, so early
+    prefill chunks and short sequences alike skip whole blocks instead of
+    masking them."""
+    return jnp.minimum(nk, (q_hi + block_k) // block_k)
+
+
 def _causal_band(s, q0, k0, bq, bk):
     qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -95,7 +107,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
     nk = seq_k // block_k
     if causal:
         # key blocks that intersect rows <= this q block's last row
-        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+        nk = causal_kv_blocks(nk, (qi + 1) * block_q - 1, block_k)
 
     def body(j, carry):
         acc, m, l = carry
@@ -219,7 +231,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
     seq_k = k_ref.shape[1]
     nk = seq_k // block_k
     if causal:
-        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+        nk = causal_kv_blocks(nk, (qi + 1) * block_q - 1, block_k)
 
     def body(j, dq):
         k = k_ref[0, pl.ds(j * block_k, block_k), :]

@@ -137,6 +137,17 @@ def main(argv=None) -> int:
                     help="KV cache layout (default: config "
                          "inference.kv_layout; paged = block-table pool "
                          "with refcounted prefix sharing + COW)")
+    ap.add_argument("--kv-page-policy", choices=["uniform", "hot_bf16"],
+                    default=None,
+                    help="per-page storage policy (paged layout only; "
+                         "default: config inference.kv_page_policy) — "
+                         "hot_bf16 reads radix-shared prefix pages at "
+                         "full precision, exclusive tails as int8")
+    ap.add_argument("--sample-on-device", action="store_true",
+                    help="fused sampling epilogue: prefill/decode "
+                         "dispatches sample inside the jitted program "
+                         "and ship token ids, never [B, vocab] logits "
+                         "(seeded-identical to the host sampler)")
     ap.add_argument("--check-layout-parity", action="store_true",
                     help="run the batch again under the OTHER kv layout "
                          "and fail unless every request's tokens match — "
@@ -176,6 +187,16 @@ def main(argv=None) -> int:
         cfg.inference.kv_cache_dtype = args.kv_cache_dtype
     if args.kv_layout is not None:
         cfg.inference.kv_layout = args.kv_layout
+    if args.kv_page_policy is not None:
+        cfg.inference.kv_page_policy = args.kv_page_policy
+    if args.sample_on_device:
+        cfg.inference.sample_on_device = True
+    if args.check_layout_parity and cfg.inference.kv_page_policy != "uniform":
+        # checked on the EFFECTIVE config (flag or config file): mixed
+        # pages quantize cold tails, so contiguous-vs-paged would be
+        # allclose, not token-equal — the parity gate is a uniform check
+        ap.error("--check-layout-parity needs kv_page_policy 'uniform' "
+                 "(hot_bf16 int8 tails make parity allclose, not exact)")
     t0 = time.perf_counter()
     engine = InferenceEngine(cfg, slots=args.slots,
                              max_seq_len=args.max_seq_len,
@@ -204,7 +225,13 @@ def main(argv=None) -> int:
                                prefill_chunk=args.prefill_chunk,
                                spec_len=args.spec_len,
                                spec_ngram=args.spec_ngram,
-                               kv_layout=other)
+                               kv_layout=other,
+                               # hot_bf16 is defined over pool pages; the
+                               # contiguous side of the parity pair runs
+                               # uniform (and the comparison is only run
+                               # with a uniform primary — mixed tails
+                               # quantize, parity would be allclose not ==)
+                               kv_page_policy="uniform")
         results2 = ContinuousBatcher(
             eng2, _load_weights(args, cfg, eng2), seed=args.seed,
         ).run(_build_requests(args, tokenizer))

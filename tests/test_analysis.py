@@ -267,6 +267,99 @@ def test_j003_lambda_body(tmp_path):
 
 
 # --------------------------------------------------------------------------- #
+# PICO-J005: make_async_copy started without a reachable wait
+# --------------------------------------------------------------------------- #
+
+
+def test_j005_start_without_wait(tmp_path):
+    found = _scan(tmp_path, """
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(src_ref, buf, sem, o_ref):
+            dma = pltpu.make_async_copy(src_ref, buf, sem)
+            dma.start()  # nothing ever waits: buf read mid-flight
+            o_ref[0] = buf[0]
+        """)
+    assert _rules(found) == ["PICO-J005"]
+    assert "wait" in found[0].message
+
+
+def test_j005_start_in_loop_body_wait_outside(tmp_path):
+    # the exact double-buffering hazard: a per-iteration start whose only
+    # wait sits after the loop — N starts against 1 wait
+    found = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(src_ref, buf, sem, o_ref):
+            def body(j, acc):
+                pltpu.make_async_copy(src_ref.at[j], buf, sem).start()
+                return acc + buf[0]
+            acc = lax.fori_loop(0, 4, body, 0.0)
+            pltpu.make_async_copy(src_ref.at[0], buf, sem).wait()
+            o_ref[0] = acc
+        """)
+    assert _rules(found) == ["PICO-J005"]
+    assert "loop" in found[0].message
+
+
+def test_j005_negative_paired_double_buffer_idiom(tmp_path):
+    # the shipped decode-kernel shape: start/wait pairs built from the
+    # same triples by sibling helper closures, warm-up start outside the
+    # loop, per-iteration prefetch + wait inside — silent
+    found = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(src_ref, buf, sems, o_ref):
+            def start(j, slot):
+                pltpu.make_async_copy(src_ref.at[j], buf.at[slot],
+                                      sems.at[slot]).start()
+
+            def wait(j, slot):
+                pltpu.make_async_copy(src_ref.at[j], buf.at[slot],
+                                      sems.at[slot]).wait()
+                return buf[slot]
+
+            def body(j, acc):
+                slot = lax.rem(j, 2)
+
+                @pl.when(j + 1 < 4)
+                def _():
+                    start(j + 1, 1 - slot)
+                return acc + wait(j, slot)[0]
+
+            start(0, 0)
+            o_ref[0] = lax.fori_loop(0, 4, body, 0.0)
+        """)
+    assert found == []
+
+
+def test_j005_negative_thread_start_and_serial_pair(tmp_path):
+    # receiver typing: thread.start()/event.wait() are not DMAs; a serial
+    # in-body start+wait pair is the pre-pipelining idiom and stays silent
+    found = _scan(tmp_path, """
+        import threading
+        from jax import lax
+        from jax.experimental.pallas import tpu as pltpu
+
+        def host():
+            t = threading.Thread(target=print)
+            t.start()
+
+        def kernel(src_ref, buf, sem, o_ref):
+            def body(j, acc):
+                dma = pltpu.make_async_copy(src_ref.at[j], buf, sem)
+                dma.start()
+                dma.wait()
+                return acc + buf[0]
+            o_ref[0] = lax.fori_loop(0, 4, body, 0.0)
+        """)
+    assert found == []
+
+
+# --------------------------------------------------------------------------- #
 # PICO-J004: jit/pallas_call constructed inside a loop
 # --------------------------------------------------------------------------- #
 
@@ -1041,7 +1134,7 @@ def test_rule_catalog_is_stable():
     """Rule IDs are API (baselines, suppressions, docs cross-links):
     removing or renaming one breaks every consumer."""
     assert set(RULES) == {
-        "PICO-J001", "PICO-J002", "PICO-J003", "PICO-J004",
+        "PICO-J001", "PICO-J002", "PICO-J003", "PICO-J004", "PICO-J005",
         "PICO-C001", "PICO-C002", "PICO-C003", "PICO-C004"}
     for rule in RULES.values():
         assert rule.title and rule.rationale

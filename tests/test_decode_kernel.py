@@ -181,6 +181,156 @@ def test_flash_path_never_materializes_dequantized_cache(monkeypatch):
 
 
 # --------------------------------------------------------------------------- #
+# double-buffered DMA: pipelined fetches are bitwise the serial kernel
+# --------------------------------------------------------------------------- #
+
+
+def _paged_blocks(rng, B, maxp, plen, nkv, D, S, nh, quantized):
+    """A page pool + shuffled block tables + the dense gathered window."""
+    P = 2 + B * maxp
+    q = jnp.asarray(rng.normal(size=(B, S, nh, D)).astype(np.float32))
+    pk = rng.normal(size=(P, plen, nkv, D)).astype(np.float32)
+    pv = rng.normal(size=(P, plen, nkv, D)).astype(np.float32)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, P))[: B * maxp].reshape(B, maxp),
+        jnp.int32)
+    if quantized:
+        qk, ks = quantize_kv(jnp.asarray(pk))
+        qv, vs = quantize_kv(jnp.asarray(pv))
+        dk = dequantize_kv(qk, ks, jnp.float32)
+        dv = dequantize_kv(qv, vs, jnp.float32)
+        stored = (qk, qv, ks, vs)
+    else:
+        stored = (jnp.asarray(pk), jnp.asarray(pv), None, None)
+        dk, dv = stored[0], stored[1]
+    gather = lambda pool: pool[tables].reshape(B, maxp * plen, *pool.shape[2:])
+    return q, stored, (gather(dk), gather(dv)), tables
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+@pytest.mark.parametrize("T,block_t,lengths", [
+    (16, 16, [16, 7]),        # single block: the whole window is one DMA
+    (48, 16, [48, 33]),       # odd block count (3)
+    (40, 16, [1, 23]),        # T % block_t != 0 (block halves to 8)
+    (32, 8, [0, 0]),          # nothing live: zero iterations, zeros out
+    (32, 8, [0, 29]),         # fresh slot riding next to a live one
+])
+def test_double_buffer_matches_serial_and_dense_contiguous(
+        T, block_t, lengths, quantized):
+    """The pipelined (two-buffer, prefetch-j+1) walk must be BITWISE the
+    serial walk — same blocks, same order, same fp32 math — and allclose
+    to dense, across the nasty window shapes and int8 scales."""
+    rng = np.random.default_rng(10)
+    B, nh, nkv, D, S = 2, 8, 4, 16, 1
+    q, stored, dense_kv = _blocks(rng, B, T, nh, nkv, D, S,
+                                  "float32", quantized)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    piped = _assert_parity(q, stored, dense_kv, lengths, block_t, 1e-5)
+    k, v, ks, vs = stored
+    serial = np.asarray(flash_decode_attention(
+        q, k, v, lengths, q.shape[-1] ** -0.5, k_scale=ks, v_scale=vs,
+        block_t=block_t, pipeline=False, interpret=True))
+    np.testing.assert_array_equal(piped, serial)
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+@pytest.mark.parametrize("maxp,plen,lengths", [
+    (1, 16, [16, 5]),         # single page per slot
+    (3, 8, [24, 17]),         # odd page count
+    (4, 8, [0, 31]),          # fresh slot + nearly-full slot
+])
+def test_double_buffer_matches_serial_and_dense_paged(
+        maxp, plen, lengths, quantized):
+    """The paged walk (one DMA per pool page through the block table)
+    under the same discipline: pipelined == serial bitwise, both allclose
+    to the dense gathered-window reference, fp32 and int8 pools."""
+    rng = np.random.default_rng(11)
+    B, nh, nkv, D, S = 2, 8, 4, 16, 1
+    q, stored, dense_kv, tables = _paged_blocks(
+        rng, B, maxp, plen, nkv, D, S, nh, quantized)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    scale = q.shape[-1] ** -0.5
+    k, v, ks, vs = stored
+    want = np.asarray(
+        decode_attention(q, dense_kv[0], dense_kv[1], lengths, scale))
+    outs = {}
+    for pipeline in (True, False):
+        outs[pipeline] = np.asarray(flash_decode_attention(
+            q, k, v, lengths, scale, k_scale=ks, v_scale=vs,
+            block_tables=tables, pipeline=pipeline, interpret=True))
+    np.testing.assert_array_equal(outs[True], outs[False])
+    live = np.asarray(lengths) > 0
+    np.testing.assert_allclose(outs[True][live], want[live],
+                               rtol=1e-5, atol=1e-5)
+    assert np.all(outs[True][~live] == 0.0)
+
+
+def test_double_buffer_verify_shape():
+    """The S>1 verify shape under pipelining: ragged lengths including a
+    row with lengths < S (leading fully-masked query rows)."""
+    rng = np.random.default_rng(12)
+    q, stored, dense_kv = _blocks(rng, 3, 48, 8, 4, 16, 4, "float32", True)
+    lengths = jnp.asarray([4, 30, 48], jnp.int32)
+    piped = _assert_parity(q, stored, dense_kv, lengths, 16, 1e-5)
+    k, v, ks, vs = stored
+    serial = np.asarray(flash_decode_attention(
+        q, k, v, lengths, q.shape[-1] ** -0.5, k_scale=ks, v_scale=vs,
+        block_t=16, pipeline=False, interpret=True))
+    np.testing.assert_array_equal(piped, serial)
+
+
+# --------------------------------------------------------------------------- #
+# flash chunked prefill: the q-blocked grid (flash_attention machinery)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_chunk_q_blocking_matches_dense(quantized):
+    """B=1 chunk windows wide enough to split over the q grid axis
+    (block_q below S*g forces multiple q-tiles): every tile walks only
+    its causal band's KV blocks and the assembled output is allclose to
+    dense — first chunk, mid-prompt resume, ragged final, full window."""
+    rng = np.random.default_rng(13)
+    B, T, nh, nkv, D, S = 1, MAX_LEN, 8, 4, 16, 24
+    q, stored, dense_kv = _blocks(rng, B, T, nh, nkv, D, S,
+                                  "float32", quantized)
+    k, v, ks, vs = stored
+    scale = D ** -0.5
+    for length in (S, 40, 61, MAX_LEN):
+        lengths = jnp.asarray([length], jnp.int32)
+        want = np.asarray(
+            decode_attention(q, dense_kv[0], dense_kv[1], lengths, scale))
+        got = np.asarray(flash_decode_attention(
+            q, k, v, lengths, scale, k_scale=ks, v_scale=vs,
+            block_t=16, block_q=16, interpret=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        # q-blocked == single-tile (the pre-blocking layout)
+        one = np.asarray(flash_decode_attention(
+            q, k, v, lengths, scale, k_scale=ks, v_scale=vs,
+            block_t=16, interpret=True))
+        np.testing.assert_allclose(got, one, rtol=1e-6, atol=1e-6)
+
+
+def test_chunk_q_blocking_paged():
+    """The paged chunk shape (prefix-sharing resume attends over pages
+    the chunk never wrote) with q-tiles narrower than the window."""
+    rng = np.random.default_rng(14)
+    B, nh, nkv, D, S = 1, 8, 4, 16, 16
+    q, stored, dense_kv, tables = _paged_blocks(
+        rng, B, 6, 8, nkv, D, S, nh, False)
+    k, v, _, _ = stored
+    scale = D ** -0.5
+    for length in (S, 37, 48):
+        lengths = jnp.asarray([length], jnp.int32)
+        want = np.asarray(
+            decode_attention(q, dense_kv[0], dense_kv[1], lengths, scale))
+        got = np.asarray(flash_decode_attention(
+            q, k, v, lengths, scale, block_tables=tables, block_q=16,
+            interpret=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
 # engine-level wiring: attend_impl reaches all three jitted call sites
 # --------------------------------------------------------------------------- #
 

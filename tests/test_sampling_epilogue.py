@@ -1,0 +1,143 @@
+"""Fused on-device sampling epilogue: seeded-identical to the host path.
+
+``inference.sample_on_device`` moves the prefill / chunked-prefill /
+decode_step sampling INSIDE the jitted dispatch (temperature -> top-k ->
+top-p -> categorical over the same fused filter, ``sanitize_logits``
+first), so only token ids cross to the host. The contract this file pins:
+
+- the epilogue is the SAME function over the SAME key the host sampler
+  would have run — a full batcher run (prefill first-token draws, blocked
+  decode, speculative verify rows, stochastic and greedy slots mixed)
+  emits bit-identical streams with the epilogue on and off;
+- the engine API is honest about where sampling happens: a
+  ``sample_on_device`` engine refuses a prefill without sampling params,
+  a host-sampling engine refuses one with them, and ``decode_step``'s
+  logits slot is None when they never left the device;
+- the config key validates (bad JSON types rejected with the fix named).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from conftest import make_config
+from picotron_tpu.config import Config
+from picotron_tpu.inference import InferenceEngine
+from picotron_tpu.inference.batcher import ContinuousBatcher, Request
+from picotron_tpu.models import llama
+
+MAX_LEN = 96
+
+
+def _engine(tiny_model_kwargs, sod, **kw):
+    cfg = make_config(tiny_model_kwargs, tp=1, seq=MAX_LEN)
+    eng = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN,
+                          sample_on_device=sod, **kw)
+    params = eng.shard_params(jax.jit(
+        lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
+    return eng, params
+
+
+_REQS = [
+    # stochastic, greedy, and filtered slots in one batch — the epilogue
+    # must reproduce every per-slot parameter combination
+    dict(uid="greedy", prompt=[1, 2, 3, 4, 5], max_new_tokens=6),
+    dict(uid="hot", prompt=list(range(1, 20)), max_new_tokens=5,
+         temperature=0.9, top_k=7, top_p=0.9),
+    dict(uid="warm", prompt=[9, 8, 7], max_new_tokens=4, temperature=0.5,
+         top_p=0.8),
+]
+
+
+def _run_batch(tiny_model_kwargs, sod, **kw):
+    eng, params = _engine(tiny_model_kwargs, sod, **kw)
+    b = ContinuousBatcher(eng, params, seed=11)
+    out = b.run([Request(**r) for r in _REQS])
+    assert all(r.finish_reason == "length" for r in out.values())
+    return {u: r.tokens for u, r in out.items()}
+
+
+@pytest.mark.parametrize("kw", [
+    {},                          # one-shot prefill + blocked decode
+    {"prefill_chunk": 8},        # chunked prefill epilogue (final chunk)
+    {"kv_layout": "paged"},      # prefix-sharing admission path
+    {"spec_len": 3},             # draft-verify rounds (verify rows)
+    {"cache_dtype": "int8"},     # quantized cache under the epilogue
+])
+def test_batcher_streams_identical_on_and_off(tiny_model_kwargs, kw):
+    """The whole serving loop, epilogue on vs off, same seed: bit-equal
+    token streams — the on-device draw is the host draw, relocated."""
+    host = _run_batch(tiny_model_kwargs, False, **kw)
+    dev = _run_batch(tiny_model_kwargs, True, **kw)
+    assert host == dev
+
+
+def test_prefill_epilogue_equals_host_sample(tiny_model_kwargs):
+    """Direct engine call: the token the epilogue returns is exactly
+    sampling.sample over the logits the host path returns, same key —
+    stochastic params included."""
+    from picotron_tpu.inference import sampling
+
+    host_eng, params = _engine(tiny_model_kwargs, False)
+    dev_eng, _ = _engine(tiny_model_kwargs, True)
+    prompt = list(range(1, 12))
+    key = jax.random.PRNGKey(42)
+    _, logits = host_eng.prefill(params, prompt)
+    for temp, tk, tp in ((0.0, 0, 1.0), (0.8, 5, 0.9), (1.3, 0, 0.7)):
+        want = int(sampling.sample(
+            logits, key, np.float32([temp]), np.int32([tk]),
+            np.float32([tp]))[0])
+        _, tok = dev_eng.prefill(params, prompt,
+                                 sample=(key, temp, tk, tp))
+        assert int(np.asarray(tok)[0]) == want
+
+
+def test_decode_step_drops_logits(tiny_model_kwargs):
+    """decode_step on an epilogue engine returns (cache, tokens, None) —
+    and the tokens match the host-sampling engine's draw."""
+    host_eng, params = _engine(tiny_model_kwargs, False)
+    dev_eng, _ = _engine(tiny_model_kwargs, True)
+    outs = {}
+    for eng in (host_eng, dev_eng):
+        cache = eng.init_cache()
+        kv, first = eng.prefill(
+            params, [1, 2, 3, 4],
+            sample=((jax.random.PRNGKey(5), 0.0, 0, 1.0)
+                    if eng.sample_on_device else None))
+        cache = eng.insert(cache, kv, 0, 4)
+        toks = np.array([int(np.asarray(first).reshape(-1)[0])
+                         if eng.sample_on_device
+                         else int(np.argmax(np.asarray(first)[0])), 0],
+                        np.int32)
+        cache, nxt, logits = eng.decode_step(
+            params, cache, toks, jax.random.PRNGKey(6),
+            np.float32([0.7, 0.0]), np.zeros(2, np.int32),
+            np.ones(2, np.float32))
+        outs[eng.sample_on_device] = np.asarray(nxt)
+        if eng.sample_on_device:
+            assert logits is None
+        else:
+            assert np.asarray(logits).shape[1] > 1
+    np.testing.assert_array_equal(outs[True], outs[False])
+
+
+def test_sample_argument_contract(tiny_model_kwargs):
+    """Mode mismatches fail loudly instead of returning the wrong kind
+    of array."""
+    host_eng, params = _engine(tiny_model_kwargs, False)
+    dev_eng, _ = _engine(tiny_model_kwargs, True)
+    with pytest.raises(ValueError, match="sample_on_device"):
+        dev_eng.prefill(params, [1, 2, 3])  # epilogue engine needs params
+    with pytest.raises(ValueError, match="sample_on_device"):
+        host_eng.prefill(params, [1, 2, 3],
+                         sample=(jax.random.PRNGKey(0), 0.0, 0, 1.0))
+
+
+def test_config_key_validated(tiny_model_kwargs):
+    """JSON-level validation names the fix for a mistyped boolean."""
+    cfg = make_config(tiny_model_kwargs, tp=1, seq=MAX_LEN)
+    raw = cfg.to_dict()
+    raw["inference"]["sample_on_device"] = "true"
+    with pytest.raises(ValueError, match="sample_on_device"):
+        Config.from_dict(raw)
