@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke obs-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke obs-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -20,6 +20,7 @@ test: native lint
 test-all: native lint
 	python -m pytest tests/ -x -q
 	$(MAKE) obs-smoke
+	$(MAKE) router-chaos-smoke
 
 # picolint static analysis (picotron_tpu/analysis/, docs/ANALYSIS.md):
 # JAX hot-path rules (host syncs on traced values, trace-time
@@ -141,6 +142,19 @@ obs-smoke:
 	  --obs-dump $(OBS_SMOKE_DIR)
 	python -m picotron_tpu.tools.trace_dump $(OBS_SMOKE_DIR)/trace.json \
 	  --require-request-chain
+
+# Multi-replica router chaos drill (tools/router.py, docs/SERVING.md
+# "Multi-replica fabric"): 3 in-process serve.py replicas behind the
+# prefix-affinity router; kill one mid-stream (the spliced client stream
+# must be BIT-IDENTICAL to an unfaulted greedy run, replays=1, no token
+# duplicated or dropped), flap/stall a second through the circuit
+# breaker's open -> half-open -> closed walk with zero client-visible
+# errors, inject scrape failures (candidate drop without a breaker
+# trip), drain a third gracefully — with every request accounted in the
+# router's own /metrics and a route -> attempt[n] -> replay span chain
+# in /tracez. The same drill runs in tier-1 (tests/test_router.py).
+router-chaos-smoke:
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.router --smoke
 
 # Serving chaos suite (tests/test_serving.py): dispatch-exception,
 # latency-spike, and poisoned-logits faults through the engine hooks —

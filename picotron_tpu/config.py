@@ -470,6 +470,91 @@ class ObsConfig:
 
 
 @dataclass
+class RouterConfig:
+    """Multi-replica serving fabric knobs (``tools/router.py``,
+    docs/SERVING.md "Multi-replica fabric"). Deliberately NOT a section of
+    ``Config``: the router fronts a FLEET of serve.py replicas (each with
+    its own experiment config) and is configured per deployment — one JSON
+    object loaded with ``RouterConfig.from_dict`` (unknown keys ignored,
+    same policy as ``Config``) or plain CLI flags."""
+
+    # -- health probing (per-replica prober thread) --
+    probe_interval_s: float = 1.0  # closed-state probe cadence
+    probe_timeout_s: float = 2.0  # per-HTTP-call probe deadline
+    # -- circuit breaker --
+    breaker_failures: int = 3  # consecutive hard failures -> open
+    # open-state reprobe ladder (resilience.retry): first delay, doubling
+    # per failed reprobe, capped; a successful reprobe -> half-open, one
+    # trial request decides closed vs open again.
+    breaker_backoff_s: float = 1.0
+    breaker_backoff_max_s: float = 30.0
+    breaker_probe_attempts: int = 6  # reprobes per retry() ladder cycle
+    # -- load scraping / scoring --
+    # a replica whose last good /metrics scrape is older than this falls
+    # out of the candidate set (stale = unknown load = unplaceable)
+    scrape_stale_s: float = 10.0
+    load_queue_weight: float = 1.0  # per queued request (+ router inflight)
+    load_slot_weight: float = 0.5  # per active slot
+    load_pool_weight: float = 4.0  # per unit of KV pool utilization [0,1]
+    load_ttft_weight: float = 2.0  # per second of TTFT p95
+    # -- prefix affinity --
+    # prompt prefixes are hashed at this page alignment (match the fleet's
+    # inference.kv_page_len so the hash key is exactly the radix-shareable
+    # page run); the affinity (rendezvous) pick wins while its load score
+    # is within affinity_load_slack of the least-loaded candidate.
+    affinity_page_len: int = 16
+    affinity_load_slack: float = 4.0
+    # -- per-request bounds --
+    place_attempts: int = 3  # placements that never streamed (shed/refused)
+    replay_budget: int = 2  # mid-stream failovers (replays) per request
+    connect_timeout_s: float = 5.0
+    # no token for this long mid-stream reads as a wedged replica (the
+    # failover trigger for stalls the replica's own watchdog missed)
+    stream_idle_timeout_s: float = 60.0
+    retry_after_s: int = 2  # Retry-After when no replica is eligible
+
+    def validate(self) -> None:
+        for name in ("probe_interval_s", "probe_timeout_s",
+                     "breaker_backoff_s", "breaker_backoff_max_s",
+                     "scrape_stale_s", "connect_timeout_s",
+                     "stream_idle_timeout_s"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"router.{name} must be > 0")
+        for name in ("breaker_failures", "breaker_probe_attempts",
+                     "place_attempts", "retry_after_s"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"router.{name} must be >= 1")
+        if self.replay_budget < 0:
+            raise ValueError("router.replay_budget must be >= 0 (0 = a "
+                             "mid-stream death fails the request)")
+        if self.breaker_backoff_max_s < self.breaker_backoff_s:
+            raise ValueError(
+                f"router.breaker_backoff_max_s "
+                f"({self.breaker_backoff_max_s}) must be >= "
+                f"breaker_backoff_s ({self.breaker_backoff_s})")
+        p = self.affinity_page_len
+        if p < 8 or p & (p - 1):
+            # the same quantum rule as inference.kv_page_len: the hash key
+            # must be a whole page run or affinity lands shared prefixes on
+            # different replicas than the radix cache can reuse
+            raise ValueError(
+                f"router.affinity_page_len must be a power of two >= 8 "
+                f"(match the fleet's inference.kv_page_len), got {p}")
+        for name in ("load_queue_weight", "load_slot_weight",
+                     "load_pool_weight", "load_ttft_weight",
+                     "affinity_load_slack"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"router.{name} must be >= 0")
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "RouterConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        cfg = cls(**{k: v for k, v in raw.items() if k in known})
+        cfg.validate()
+        return cfg
+
+
+@dataclass
 class LoggingConfig:
     use_wandb: bool = False
     run_name: str = "picotron-tpu"

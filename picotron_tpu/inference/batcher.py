@@ -389,6 +389,16 @@ class ContinuousBatcher:
                   "requests waiting for a slot").set(queued)
         reg.gauge("picotron_active_slots",
                   "slots holding a live request").set(active)
+        if self.paged is not None:
+            # pool occupancy on /metrics, not just /statz: the router's
+            # least-loaded scoring reads it straight off the scrape
+            total = self.paged.pool.usable_pages
+            live = self.paged.pool.live_count
+            reg.gauge("picotron_kv_pages_live",
+                      "KV pool pages holding live tokens").set(live)
+            reg.gauge("picotron_kv_pool_utilization",
+                      "live / usable KV pool pages").set(
+                          live / max(total, 1))
         return queued, active
 
     def stats(self) -> dict:

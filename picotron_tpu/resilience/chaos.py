@@ -55,6 +55,7 @@ from __future__ import annotations
 import os
 import signal
 import sys
+import threading
 import time
 
 from picotron_tpu.config import parse_rank_at_step
@@ -275,3 +276,131 @@ class ServingChaos:
             log0(f"chaos: poisoning dispatch round {self.round} logits")
             return True
         return False
+
+
+class RouterChaos:
+    """Deterministic fault injection for the multi-replica router drill
+    (``tools/router.py``, docs/SERVING.md "Multi-replica fabric").
+
+    Two injection surfaces, matching where real faults land:
+
+    **Replica-side** (operates on in-process ``serve.Server`` objects —
+    the ``make router-chaos-smoke`` fleet):
+
+    - ``kill(server)``      — the in-process SIGKILL: the dispatch loop
+      dies on its next step (in-flight waiters are released with
+      ``finish_reason "error"`` — the contract the router's replay path
+      depends on) and the HTTP listener closes (probes see connection
+      refused);
+    - ``stall(server, s)``  — ``/healthz`` answers only after ``s``
+      seconds: a probe timeout shorter than ``s`` reads the replica as
+      wedged (the hard-failure ladder) without the replica being down;
+      ``unstall`` heals it;
+    - ``flap(server, down)`` — health surfaces flip 503/200: the
+      breaker's open -> half-open -> closed walk under an unstable
+      replica.
+
+    **Router-side** (installed as ``Router(..., chaos=RouterChaos())``):
+
+    - ``fail_scrape(name)``     — the prober's ``/metrics`` read fails:
+      the replica's scrape goes stale and it falls out of the candidate
+      set WITHOUT tripping the breaker;
+    - ``sever_stream(name, n)`` — the router's ``/generate`` stream from
+      that replica raises ``ConnectionResetError`` after the n-th token
+      row (once): the raw connection-drop flavor of a mid-stream death,
+      as opposed to ``kill``'s dispatch-death flavor.
+
+    Thread-safety: the injection sets are mutated by the drill thread and
+    read by prober/handler threads; one leaf lock guards them (the same
+    discipline as the router's own counters — picolint PICO-C003).
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._scrape_fail: set = set()
+        self._sever: dict = {}  # replica name -> sever after N token rows
+        self._stalled: dict = {}  # id(front) -> original healthy()
+        self._flapped: dict = {}  # id(front) -> (healthy, ready)
+
+    # ---- replica-side ------------------------------------------------------
+
+    def kill(self, server) -> None:
+        front = server.front
+
+        def _bomb(*a, **k):
+            raise ChaosError("router chaos: replica killed mid-step")
+
+        front._batcher.step = _bomb
+        front._wake.set()
+        # the listener goes away like the process did; established
+        # connections live on just long enough for the dying dispatch
+        # loop's terminal "error" results to reach their streams
+        server.httpd.shutdown()
+        server.httpd.server_close()
+
+    def stall(self, server, seconds: float) -> None:
+        front = server.front
+        with self._mu:
+            if id(front) not in self._stalled:
+                self._stalled[id(front)] = front.healthy
+
+        def _slow(orig=front.healthy, s=float(seconds)):
+            time.sleep(s)
+            return orig()
+
+        front.healthy = _slow  # instance attr shadows the method
+
+    def unstall(self, server) -> None:
+        front = server.front
+        with self._mu:
+            self._stalled.pop(id(front), None)
+        try:
+            del front.healthy  # restores the class method
+        except AttributeError:
+            pass
+
+    def flap(self, server, down: bool) -> None:
+        front = server.front
+        if down:
+            front.healthy = lambda: False
+            front.ready = lambda: False
+        else:
+            for attr in ("healthy", "ready"):
+                try:
+                    delattr(front, attr)
+                except AttributeError:
+                    pass
+
+    # ---- router-side -------------------------------------------------------
+
+    def fail_scrape(self, name: str, on: bool = True) -> None:
+        with self._mu:
+            if on:
+                self._scrape_fail.add(name)
+            else:
+                self._scrape_fail.discard(name)
+
+    def scrape_fails(self, name: str) -> bool:
+        """Router prober hook: should this replica's /metrics read fail?"""
+        with self._mu:
+            return name in self._scrape_fail
+
+    def sever_stream(self, name: str, after_tokens: int) -> None:
+        with self._mu:
+            self._sever[name] = int(after_tokens)
+
+    def on_stream_row(self, name: str, tokens_so_far: int) -> None:
+        """Router stream hook: called before each NDJSON row is processed
+        with the count of token rows already consumed from this attempt.
+        Consumes the sever event (fires once)."""
+        with self._mu:
+            at = self._sever.get(name)
+            if at is not None and tokens_so_far >= at:
+                del self._sever[name]
+                fire = True
+            else:
+                fire = False
+        if fire:
+            raise ConnectionResetError(
+                f"router chaos: stream from {name} severed after "
+                f"{tokens_so_far} tokens")

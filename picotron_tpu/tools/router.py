@@ -1,0 +1,1327 @@
+"""Multi-replica serving fabric: one logical endpoint over N serve.py replicas.
+
+    python -m picotron_tpu.tools.router --replica 10.0.0.1:8000 \
+        --replica 10.0.0.2:8000 --port 9000
+
+`tools/serve.py` made ONE replica chaos-survivable; this is the layer that
+makes a FLEET of them look like one endpoint (docs/SERVING.md
+"Multi-replica fabric"). Stdlib only, like the front end it fronts. Three
+responsibilities:
+
+**Placement** — each request is scored onto a replica by prefix affinity
+plus load. The affinity key is the longest page-aligned prompt prefix
+(``RouterConfig.affinity_page_len``; match the fleet's
+``inference.kv_page_len``): requests sharing a system prompt rendezvous-
+hash to the same replica, which already holds those radix-cache pages, so
+the shared prefix is prefilled once per CLUSTER instead of once per
+request. The affinity pick wins only while its load score — queue depth +
+router-inflight, active slots, KV pool occupancy, and TTFT p95, every
+term scraped from the replica's own ``/metrics`` (the PR-10 instruments)
+— stays within ``affinity_load_slack`` of the least-loaded candidate;
+past that, least-loaded wins. Replicas whose last good scrape is older
+than ``scrape_stale_s`` fall out of the candidate set entirely: unknown
+load is unplaceable load.
+
+**Failure handling** — a prober thread per replica walks
+``/healthz`` + ``/readyz`` + ``/metrics`` on ``probe_interval_s``. A
+readyz 503 whose body says ``{"state": "draining"}`` is GRACEFUL: the
+replica leaves the candidate set but its circuit breaker is untouched
+(that is the drain-vs-dead distinction serve.py's readyz body exists
+for). Hard failures (unreachable, healthz 503, readyz stalled/dead)
+count consecutively: at ``breaker_failures`` the breaker opens and the
+prober switches to an exponential reprobe ladder driven by
+``resilience.retry``; the first successful reprobe flips half-open,
+where ONE trial request (or ``breaker_failures`` consecutive clean
+probes) decides closed vs open again. A scrape-only failure is SOFT —
+health state still updates, but the scrape goes stale and the replica
+drops out of placement without tripping the breaker. When no replica is
+eligible the router answers 503 with ``Retry-After``.
+
+**Mid-stream failover replay** — the router always streams from the
+replica and records every token it delivers to the client. When a
+replica dies mid-stream (connection drop, torn NDJSON row, 5xx, a
+``finish_reason: "error"`` from a dying dispatch loop), the router
+re-submits the ORIGINAL prompt *plus the already-delivered tokens* as
+the new prompt to a surviving replica with the token budget reduced by
+what was delivered. The replayed prefix is prompt, not generation, on
+the new replica — nothing is re-emitted — and the spliced stream hands
+the client every token exactly once. Greedy requests are bit-identical
+to an unfaulted run (the continuation is conditioned on exactly the
+prefix the client already holds); stochastic requests are
+prefix-consistent, not bit-identical (the surviving replica draws fresh
+PRNG keys — docs/SERVING.md spells out the caveat). Failovers are
+bounded by ``replay_budget``; refused placements (shed, drain-shed) by
+``place_attempts``.
+
+Client surface (mirrors serve.py): ``POST /generate`` (same body; adds
+``request_id`` passthrough — echoed on every NDJSON row by router and
+replica so replay dedup is observable end to end), ``GET /healthz``
+``/readyz`` ``/statz`` ``/metrics`` ``/tracez``. Router responses carry
+``replays`` / ``attempts`` / ``replica`` so a client can see a failover
+happened without losing a token.
+
+``--smoke`` is the ``make router-chaos-smoke`` drive: 2–3 in-process
+serve.py replicas + this router + ``resilience.chaos.RouterChaos``
+(kill a replica mid-stream, stall healthz past the probe timeout, flap
+health, inject scrape failures, drain) with a bit-identical greedy
+oracle and full accounting asserts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import http.client
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from picotron_tpu.config import RouterConfig
+from picotron_tpu.obs import GLOBAL_REGISTRY, Obs
+from picotron_tpu.obs.metrics import parse_prometheus
+from picotron_tpu.resilience.retry import retry
+
+
+class ReplicaFailure(Exception):
+    """A hard per-replica failure: unreachable, sick health surface, or a
+    broken /generate stream. Feeds the circuit breaker."""
+
+
+class RouteRefused(Exception):
+    """The router-level reject (the fabric's AdmissionError): nothing was
+    streamed to the client and the caller turns this into an HTTP
+    status + Retry-After."""
+
+    def __init__(self, status: int, reason: str, retry_after: int = 0):
+        super().__init__(reason)
+        self.status = status
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+class _Stopped(Exception):
+    """Router shutdown interrupting a prober sleep/backoff ladder."""
+
+
+# --------------------------------------------------------------------------- #
+# pure helpers (unit-tested directly)
+# --------------------------------------------------------------------------- #
+
+
+def prefix_key(prompt, page_len: int) -> Optional[str]:
+    """Affinity key: hash of the longest page-aligned prompt prefix, or
+    None when the prompt holds no whole page (nothing the radix cache
+    could share — pure least-loaded placement)."""
+    n = (len(prompt) // page_len) * page_len
+    if n <= 0:
+        return None
+    raw = ",".join(str(int(t)) for t in prompt[:n]).encode()
+    return hashlib.blake2b(raw, digest_size=8).hexdigest()
+
+
+def _rendezvous(key: str, name: str) -> int:
+    """Highest-random-weight hash: every router instance ranks the same
+    replicas identically for one prefix, with no shared state and minimal
+    disruption when the replica set changes."""
+    h = hashlib.blake2b(f"{key}|{name}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+def hist_quantile(prom: dict, name: str, q: float) -> float:
+    """Quantile estimate from a scraped Prometheus histogram: the upper
+    bound of the first cumulative bucket covering ``q`` of the count
+    (conservative — a bucket bound, not an interpolation). 0.0 when the
+    histogram is absent or empty."""
+    pts = []
+    total = None
+    prefix = f"{name}_bucket{{"
+    for k, v in prom.items():
+        if not k.startswith(prefix):
+            continue
+        i = k.find('le="')
+        le = k[i + 4:k.rindex('"')]
+        if le == "+Inf":
+            total = v
+        else:
+            pts.append((float(le), v))
+    if not total or not pts:
+        return 0.0
+    pts.sort()
+    target = q * total
+    for le, cum in pts:
+        if cum >= target:
+            return le
+    return pts[-1][0]
+
+
+# --------------------------------------------------------------------------- #
+# transport (all failures normalized to ReplicaFailure)
+# --------------------------------------------------------------------------- #
+
+_TRANSPORT_ERRORS = (OSError, http.client.HTTPException, ValueError)
+
+
+def _get_json(host: str, port: int, path: str, timeout: float) -> tuple:
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+    except _TRANSPORT_ERRORS as e:
+        raise ReplicaFailure(
+            f"GET {path}: {type(e).__name__}: {e}") from e
+
+
+def _get_text(host: str, port: int, path: str, timeout: float) -> tuple:
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read().decode("utf-8", errors="replace")
+        finally:
+            conn.close()
+    except _TRANSPORT_ERRORS as e:
+        raise ReplicaFailure(
+            f"GET {path}: {type(e).__name__}: {e}") from e
+
+
+# --------------------------------------------------------------------------- #
+# replica record
+# --------------------------------------------------------------------------- #
+
+
+class Replica:
+    """Per-replica state. Every mutable field is guarded by ``_mu`` — a
+    LEAF lock (picolint PICO-C001/C003): taken for pure state reads and
+    transitions only, never while doing I/O or waiting on another lock."""
+
+    def __init__(self, name: str, host: str, port: int):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self._mu = threading.Lock()
+        self.breaker = "closed"  # closed | open | half_open
+        self.fails = 0  # consecutive hard failures
+        self.okays = 0  # consecutive clean probes (half-open recovery)
+        self.trial = False  # half-open: one live trial request at a time
+        self.ready = False
+        self.draining = False
+        self.scrape: dict = {}  # parsed load terms from /metrics
+        self.scrape_t = float("-inf")  # monotonic time of last good scrape
+        self.inflight = 0  # router-placed requests currently streaming
+
+    def snapshot(self, now: float) -> dict:
+        with self._mu:
+            age = now - self.scrape_t
+            return {
+                "addr": f"{self.host}:{self.port}",
+                "breaker": self.breaker,
+                "ready": self.ready,
+                "draining": self.draining,
+                "consecutive_failures": self.fails,
+                "inflight": self.inflight,
+                "scrape_age_s": None if age == float("inf") else round(age, 3),
+                "scrape": dict(self.scrape),
+            }
+
+
+class Router:
+    """Placement + breaker + failover brain (no HTTP server of its own —
+    ``RouterServer`` adds that). Prober threads are started by
+    ``start()``; the request path is driven by ``route()`` from any
+    number of caller threads.
+
+    Locking discipline (picolint PICO-C001–C004): each ``Replica._mu``
+    and the counter-dict lock ``_ctr_mu`` are leaf locks — taken last,
+    held only across pure state transitions, never across HTTP calls,
+    sleeps, or each other. Registry instruments carry their own internal
+    leaf locks."""
+
+    def __init__(self, replicas, cfg: Optional[RouterConfig] = None, *,
+                 obs: Optional[Obs] = None, chaos=None, log=print,
+                 clock=time.monotonic):
+        self.cfg = cfg or RouterConfig()
+        self.cfg.validate()
+        self.replicas: dict = {}
+        for spec in replicas:
+            if isinstance(spec, str):
+                host, _, port = spec.rpartition(":")
+                spec = (f"{host}:{port}", host, int(port))
+            name, host, port = spec
+            if name in self.replicas:
+                raise ValueError(f"duplicate replica name {name!r}")
+            self.replicas[name] = Replica(name, host, port)
+        if not self.replicas:
+            raise ValueError("router needs at least one replica")
+        self.chaos = chaos
+        self.obs = obs or Obs(enabled=True)
+        self.registry = self.obs.registry
+        self._log = log
+        self._clock = clock
+        # requests by terminal state; CounterDict writes are serialized by
+        # the leaf lock _ctr_mu (handler threads finish concurrently)
+        self.requests = self.registry.counter_dict(
+            "picotron_router_requests_total",
+            ("completed", "failed", "shed", "client_error", "abandoned"),
+            help="routed requests by terminal state", label="state")
+        self._ctr_mu = threading.Lock()
+        self._replays = self.registry.counter(
+            "picotron_router_replays_total",
+            "mid-stream failovers replayed onto a surviving replica")
+        self._placement_retries = self.registry.counter(
+            "picotron_router_placement_retries_total",
+            "placements refused (shed/unreachable) and retried elsewhere")
+        self._route_hist = self.registry.histogram(
+            "picotron_router_route_seconds", "accept -> terminal response")
+        self._rid_mu = threading.Lock()
+        self._rid_seq = 0
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._start_t = clock()
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        for rep in self.replicas.values():
+            t = threading.Thread(target=self._probe_loop, args=(rep,),
+                                 name=f"router-probe-{rep.name}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def wait_eligible(self, n: int = 1, timeout: float = 30.0) -> bool:
+        """Block until >= n replicas are placeable (startup convenience for
+        the CLI and the smoke drive)."""
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
+            if len(self._eligible()) >= n:
+                return True
+            if self._stop.wait(0.02):
+                return False
+        return False
+
+    def _sleep(self, seconds: float) -> None:
+        if self._stop.wait(seconds):
+            raise _Stopped()
+
+    def _event(self, evt: str, **fields) -> None:
+        self._log(json.dumps({"evt": evt, "t": round(time.time(), 3),
+                              **fields}), flush=True)
+
+    def _next_rid(self) -> str:
+        with self._rid_mu:
+            self._rid_seq += 1
+            return f"rt{self._rid_seq}"
+
+    # ---- probing + breaker ------------------------------------------------
+
+    def _probe_loop(self, rep: Replica) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._probe_once(rep)
+                except ReplicaFailure as e:
+                    if self._probe_fail(rep, str(e)):
+                        self._reprobe_open(rep)
+                        continue
+                self._sleep(self.cfg.probe_interval_s)
+        except _Stopped:
+            pass
+
+    def _probe_once(self, rep: Replica) -> None:
+        """One probe cycle: hard failures (unreachable/sick healthz/
+        stalled-or-dead readyz) raise ReplicaFailure; a drain is graceful
+        (ready=False, breaker untouched); a scrape failure is soft (the
+        scrape goes stale, placement drops the replica, no breaker
+        action). All I/O happens before any lock is taken."""
+        t = self.cfg.probe_timeout_s
+        st, _ = _get_json(rep.host, rep.port, "/healthz", t)
+        if st != 200:
+            raise ReplicaFailure(f"{rep.name}: healthz {st}")
+        st, body = _get_json(rep.host, rep.port, "/readyz", t)
+        draining = (body.get("state") == "draining"
+                    or bool(body.get("draining")))
+        if st != 200 and not draining:
+            raise ReplicaFailure(
+                f"{rep.name}: readyz {st} (state="
+                f"{body.get('state', '?')})")
+        scrape = None
+        try:
+            if self.chaos is not None and self.chaos.scrape_fails(rep.name):
+                raise ReplicaFailure(f"{rep.name}: injected scrape failure")
+            mst, text = _get_text(rep.host, rep.port, "/metrics", t)
+            if mst == 200:
+                prom = parse_prometheus(text)
+                scrape = {
+                    "queue_depth": prom.get("picotron_queue_depth", 0.0),
+                    "active_slots": prom.get("picotron_active_slots", 0.0),
+                    "pool_utilization": prom.get(
+                        "picotron_kv_pool_utilization", 0.0),
+                    "ttft_p95": hist_quantile(
+                        prom, "picotron_ttft_seconds", 0.95),
+                }
+        except ReplicaFailure:
+            scrape = None
+        self._probe_ok(rep, ready=st == 200, draining=draining,
+                       scrape=scrape)
+
+    def _transition(self, rep: Replica, to: str) -> None:
+        """Count + log one breaker transition. Called WITH ``rep._mu``
+        held: the counter's own leaf lock nests strictly inside it (one
+        direction only — no cycle)."""
+        self.registry.counter(
+            "picotron_router_breaker_transitions_total",
+            "circuit-breaker state changes", replica=rep.name, to=to).inc()
+
+    def _probe_ok(self, rep: Replica, ready: bool, draining: bool,
+                  scrape: Optional[dict]) -> None:
+        now = self._clock()
+        opened_to = None
+        with rep._mu:
+            rep.ready = ready
+            rep.draining = draining
+            if scrape is not None:
+                rep.scrape = scrape
+                rep.scrape_t = now
+            rep.fails = 0
+            rep.okays += 1
+            if rep.breaker == "open":
+                rep.breaker = "half_open"
+                rep.okays = 1
+                self._transition(rep, "half_open")
+                opened_to = "half_open"
+            elif (rep.breaker == "half_open"
+                  and rep.okays >= self.cfg.breaker_failures):
+                # traffic-free recovery: enough consecutive clean probes
+                # close the breaker without risking a trial request
+                rep.breaker = "closed"
+                self._transition(rep, "closed")
+                opened_to = "closed"
+        if opened_to:
+            self._event("breaker", replica=rep.name, to=opened_to,
+                        via="probe")
+
+    def _probe_fail(self, rep: Replica, why: str) -> bool:
+        """Record one hard probe failure; returns True when the breaker is
+        now open (the caller switches to the reprobe ladder)."""
+        opened = False
+        with rep._mu:
+            rep.ready = False
+            rep.okays = 0
+            rep.fails += 1
+            if (rep.breaker == "half_open"
+                    or (rep.breaker == "closed"
+                        and rep.fails >= self.cfg.breaker_failures)):
+                rep.breaker = "open"
+                self._transition(rep, "open")
+                opened = True
+            is_open = rep.breaker == "open"
+        self._event("probe_failure", replica=rep.name, why=why,
+                    breaker_opened=opened)
+        return is_open
+
+    def _reprobe_open(self, rep: Replica) -> None:
+        """Open-state reprobe ladder: ``resilience.retry`` drives
+        exponentially backed-off probes (first delay
+        ``breaker_backoff_s``, doubling, jittered); the first success
+        lands in ``_probe_ok`` which flips half-open. An exhausted ladder
+        parks at the cap and starts over — an open replica is reprobed
+        forever, just never faster than the cap."""
+        def capped_sleep(d: float) -> None:
+            # retry()'s raw exponential has no cap of its own: clamp
+            # every inter-reprobe delay at the configured ceiling
+            self._sleep(min(d, self.cfg.breaker_backoff_max_s))
+
+        while not self._stop.is_set():
+            try:
+                retry(lambda: self._probe_once(rep),
+                      attempts=self.cfg.breaker_probe_attempts,
+                      backoff=self.cfg.breaker_backoff_s,
+                      jitter=0.25, retry_on=(ReplicaFailure,),
+                      desc=f"router-reprobe-{rep.name}",
+                      sleep=capped_sleep)
+                return
+            except ReplicaFailure:
+                self._sleep(self.cfg.breaker_backoff_max_s)
+
+    def _request_success(self, rep: Replica) -> None:
+        closed = False
+        with rep._mu:
+            rep.inflight -= 1
+            if rep.breaker == "half_open" and rep.trial:
+                rep.breaker = "closed"
+                rep.fails = 0
+                self._transition(rep, "closed")
+                closed = True
+            rep.trial = False
+        if closed:
+            self._event("breaker", replica=rep.name, to="closed",
+                        via="trial_request")
+
+    def _request_failure(self, rep: Replica, why: str) -> None:
+        opened = False
+        with rep._mu:
+            rep.inflight -= 1
+            rep.fails += 1
+            rep.okays = 0
+            if (rep.breaker == "half_open"
+                    or (rep.breaker == "closed"
+                        and rep.fails >= self.cfg.breaker_failures)):
+                if rep.breaker != "open":
+                    rep.breaker = "open"
+                    self._transition(rep, "open")
+                    opened = True
+            rep.trial = False
+        self._event("request_failure", replica=rep.name, why=why,
+                    breaker_opened=opened)
+
+    def _request_refused(self, rep: Replica) -> None:
+        """A shed/drain refusal: the replica is alive (that WAS its
+        answer) — no breaker action, just release the slot."""
+        with rep._mu:
+            rep.inflight -= 1
+            rep.trial = False
+
+    # ---- placement --------------------------------------------------------
+
+    def _load(self, rep: Replica) -> float:
+        """Load score under ``rep._mu`` (caller holds it): scraped queue
+        depth + the router's own in-flight placements (fresher than any
+        scrape), active slots, pool occupancy, TTFT p95."""
+        c = self.cfg
+        s = rep.scrape
+        return (c.load_queue_weight * (s.get("queue_depth", 0.0)
+                                       + rep.inflight)
+                + c.load_slot_weight * s.get("active_slots", 0.0)
+                + c.load_pool_weight * s.get("pool_utilization", 0.0)
+                + c.load_ttft_weight * s.get("ttft_p95", 0.0))
+
+    def _candidates(self, excluded=()) -> list:
+        """[(replica, load)] of currently placeable replicas."""
+        now = self._clock()
+        out = []
+        for rep in self.replicas.values():
+            if rep.name in excluded:
+                continue
+            with rep._mu:
+                if rep.breaker == "open":
+                    continue
+                if rep.breaker == "half_open" and rep.trial:
+                    continue  # one trial at a time through a half-open door
+                if not rep.ready or rep.draining:
+                    continue
+                if now - rep.scrape_t > self.cfg.scrape_stale_s:
+                    continue  # unknown load is unplaceable load
+                out.append((rep, self._load(rep)))
+        return out
+
+    def _eligible(self) -> list:
+        return [rep for rep, _ in self._candidates()]
+
+    def place(self, prompt, excluded=()) -> Optional[Replica]:
+        """Pick a replica for ``prompt`` (None when nothing is eligible):
+        the rendezvous affinity pick while it is within
+        ``affinity_load_slack`` of the least-loaded candidate, else
+        least-loaded. Reserves an inflight slot (and the half-open trial
+        token) on the pick."""
+        cands = self._candidates(excluded)
+        key = prefix_key(prompt, self.cfg.affinity_page_len)
+        while cands:
+            best = min(load for _, load in cands)
+            pick = None
+            if key is not None:
+                for rep, load in sorted(
+                        cands, key=lambda c: _rendezvous(key, c[0].name),
+                        reverse=True):
+                    if load <= best + self.cfg.affinity_load_slack:
+                        pick = rep
+                        break
+            if pick is None:
+                pick = min(cands, key=lambda c: c[1])[0]
+            with pick._mu:
+                if pick.breaker == "half_open" and pick.trial:
+                    # lost the race for the one half-open trial token
+                    # (_candidates read it before another placement took
+                    # it): fall through to the next candidate
+                    reserved = False
+                else:
+                    pick.inflight += 1
+                    if pick.breaker == "half_open":
+                        pick.trial = True
+                    reserved = True
+            if not reserved:
+                cands = [c for c in cands if c[0] is not pick]
+                continue
+            self.registry.counter(
+                "picotron_router_placements_total",
+                "requests placed, by replica", replica=pick.name).inc()
+            return pick
+        return None
+
+    # ---- request path -----------------------------------------------------
+
+    def route(self, spec: dict, rid: str, on_token=None) -> dict:
+        """Serve one request against the fleet, failing over mid-stream as
+        needed. ``on_token(tok)`` fires once per delivered token (the
+        streaming splice); returns the terminal payload
+        ``{request_id, tokens, finish_reason, replays, attempts,
+        replica}``. Raises RouteRefused when nothing was streamed and no
+        replica served (the caller maps it to 400/503)."""
+        prompt = spec.get("prompt")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            raise RouteRefused(
+                400, "prompt must be a non-empty list of token ids")
+        try:
+            max_new = int(spec.get("max_new_tokens", 32))
+        except (TypeError, ValueError) as e:
+            raise RouteRefused(400, f"bad max_new_tokens: {e}") from e
+        if max_new < 1:
+            raise RouteRefused(400, "max_new_tokens must be >= 1")
+        t0 = self._clock()
+        tracer = self.obs.tracer
+        root = tracer.begin("route", request_id=rid)
+        delivered: list = []
+        excluded: set = set()
+        replays = 0
+        refusals = 0
+        attempt = 0
+        finish = None
+        last_replica = None
+        state = "failed"
+        try:
+            while True:
+                if delivered:
+                    # failover landed exactly on a finished generation:
+                    # synthesize the terminal the dead replica owed us
+                    eos = spec.get("eos_id")
+                    if eos is not None and delivered[-1] == int(eos):
+                        finish = "eos"
+                        break
+                    if len(delivered) >= max_new:
+                        finish = "length"
+                        break
+                rep = self.place(prompt + delivered, excluded)
+                if rep is None:
+                    if delivered:
+                        finish = "error"  # mid-stream with no survivor
+                        break
+                    raise RouteRefused(
+                        503, "no replica eligible",
+                        self.cfg.retry_after_s)
+                attempt += 1
+                last_replica = rep.name
+                try:
+                    outcome, detail = self._attempt(
+                        rep, spec, rid, attempt, prompt, delivered,
+                        max_new, on_token, root, tracer)
+                except BaseException:
+                    # a non-replica abort (the CLIENT dropped its
+                    # connection mid-splice): release the placement slot
+                    # without a breaker verdict — the replica did nothing
+                    # wrong
+                    self._request_refused(rep)
+                    raise
+                if outcome == "served":
+                    self._request_success(rep)
+                    finish = detail
+                    break
+                if outcome == "refused":
+                    self._request_refused(rep)
+                    self._placement_retries.inc()
+                    excluded.add(rep.name)
+                    refusals += 1
+                    if refusals >= self.cfg.place_attempts:
+                        if delivered:
+                            finish = "error"
+                            break
+                        raise RouteRefused(
+                            503,
+                            f"every placement refused ({detail})",
+                            self.cfg.retry_after_s)
+                    continue
+                if outcome == "client_error":
+                    self._request_refused(rep)
+                    if delivered:
+                        # a replay the fleet can no longer express (e.g.
+                        # the replayed prompt+delivered fills the
+                        # replica's window): the client keeps every
+                        # delivered token and gets a terminal — never a
+                        # torn stream, never a 400 that eats partials
+                        finish = "error"
+                        break
+                    raise RouteRefused(400, detail)
+                # hard failure: breaker feedback, then replay (tokens
+                # were delivered) or placement retry (none were)
+                self._request_failure(rep, detail)
+                excluded.add(rep.name)
+                if delivered:
+                    replays += 1
+                    if replays > self.cfg.replay_budget:
+                        finish = "error"
+                        break
+                    self._replays.inc()
+                    tracer.record("replay", self._clock(), self._clock(),
+                                  parent=root, request_id=rid,
+                                  from_replica=rep.name,
+                                  delivered=len(delivered), why=detail)
+                    self._event("replay", request_id=rid,
+                                from_replica=rep.name,
+                                delivered=len(delivered), why=detail)
+                else:
+                    self._placement_retries.inc()
+                    refusals += 1
+                    if refusals >= self.cfg.place_attempts:
+                        raise RouteRefused(
+                            503, f"every placement failed ({detail})",
+                            self.cfg.retry_after_s)
+            state = "completed" if finish in ("eos", "length", "timeout") \
+                else "failed"
+            return {"request_id": rid, "tokens": list(delivered),
+                    "finish_reason": finish, "replays": replays,
+                    "attempts": attempt, "replica": last_replica}
+        except RouteRefused as e:
+            state = "client_error" if e.status == 400 else "shed"
+            raise
+        except BaseException:
+            # a non-replica abort (the client dropped its connection):
+            # its own ledger state — "failed" is reserved for requests
+            # the FLEET could not finish, the signal operators page on
+            state = "abandoned"
+            raise
+        finally:
+            with self._ctr_mu:
+                self.requests[state] += 1
+            self._route_hist.observe(self._clock() - t0)
+            tracer.end(root, finish_reason=finish or "refused",
+                       tokens=len(delivered), replays=replays,
+                       state=state)
+            self._event("request", request_id=rid, state=state,
+                        finish_reason=finish, tokens=len(delivered),
+                        replays=replays, attempts=attempt,
+                        replica=last_replica)
+
+    def _attempt(self, rep: Replica, spec: dict, rid: str, n: int,
+                 prompt: list, delivered: list, max_new: int,
+                 on_token, root, tracer) -> tuple:
+        """One placement attempt: stream ``/generate`` from ``rep``,
+        appending tokens to ``delivered`` as they arrive. Returns
+        ``(outcome, detail)`` with outcome one of ``served`` (detail =
+        finish_reason), ``refused`` (shed — nothing streamed), ``failed``
+        (hard failure; ``delivered`` may have grown), ``client_error``."""
+        sub = {"prompt": prompt + delivered,
+               "max_new_tokens": max_new - len(delivered),
+               "stream": True, "uid": f"{rid}.a{n}", "request_id": rid}
+        for k in ("temperature", "top_k", "top_p", "eos_id", "timeout_s"):
+            if k in spec:
+                sub[k] = spec[k]
+        span = tracer.begin("attempt", parent=root, request_id=rid,
+                            replica=rep.name, n=n)
+        got = 0
+        conn = None
+        try:
+            try:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port,
+                    timeout=self.cfg.connect_timeout_s)
+                conn.request("POST", "/generate", json.dumps(sub),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status in (429, 503):
+                    body = json.loads(resp.read() or b"{}")
+                    return ("refused",
+                            f"{resp.status}: {body.get('error', 'shed')}")
+                if resp.status == 400:
+                    body = json.loads(resp.read() or b"{}")
+                    return ("client_error",
+                            body.get("error", "bad request"))
+                if resp.status != 200:
+                    raise ReplicaFailure(
+                        f"{rep.name}: POST /generate {resp.status}")
+                if conn.sock is not None:
+                    # connect deadline served its purpose; from here the
+                    # idle timeout bounds a silently wedged stream
+                    conn.sock.settimeout(self.cfg.stream_idle_timeout_s)
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        raise ReplicaFailure(
+                            f"{rep.name}: stream ended without done")
+                    if self.chaos is not None:
+                        self.chaos.on_stream_row(rep.name, got)
+                    row = json.loads(line)
+                    ev = row.get("event")
+                    if ev == "token":
+                        if row.get("request_id", rid) != rid:
+                            # a foreign row can only mean a replica-side
+                            # routing bug: drop it, keep the count visible
+                            self.registry.counter(
+                                "picotron_router_row_mismatch_total",
+                                "stream rows whose request_id was not "
+                                "ours").inc()
+                            continue
+                        tok = int(row["token"])
+                        delivered.append(tok)
+                        got += 1
+                        if on_token is not None:
+                            on_token(tok)
+                        continue
+                    if ev == "done":
+                        fr = row.get("finish_reason")
+                        if fr == "error":
+                            raise ReplicaFailure(
+                                f"{rep.name}: replica finished 'error'")
+                        if fr == "shed":
+                            if got:
+                                raise ReplicaFailure(
+                                    f"{rep.name}: shed after streaming "
+                                    f"{got} tokens")
+                            return ("refused", "shed at drain")
+                        if fr not in ("eos", "length", "timeout"):
+                            raise ReplicaFailure(
+                                f"{rep.name}: unknown finish_reason "
+                                f"{fr!r}")
+                        return ("served", fr)
+            except _TRANSPORT_ERRORS as e:
+                # connection drop, torn NDJSON row, idle timeout: the
+                # mid-stream death the replay path exists for
+                raise ReplicaFailure(
+                    f"{rep.name}: {type(e).__name__}: {e}") from e
+        except ReplicaFailure as e:
+            return ("failed", str(e))
+        finally:
+            if conn is not None:
+                conn.close()
+            tracer.end(span, tokens=got)
+
+    # ---- observability ----------------------------------------------------
+
+    def stats(self) -> dict:
+        now = self._clock()
+        reps = {name: rep.snapshot(now)
+                for name, rep in self.replicas.items()}
+        eligible = [rep.name for rep in self._eligible()]
+        self.registry.gauge(
+            "picotron_router_replicas_eligible",
+            "replicas currently placeable").set(len(eligible))
+        with self._ctr_mu:
+            requests = dict(self.requests)
+        return {
+            "replicas": reps,
+            "eligible": eligible,
+            "requests": requests,
+            "replays": int(self._replays.value),
+            "placement_retries": int(self._placement_retries.value),
+            "route_s": self._route_hist.percentiles(),
+            "uptime_s": round(now - self._start_t, 3),
+        }
+
+    def metrics_text(self) -> str:
+        self.stats()  # refresh the eligibility gauge for scrapers
+        return self.registry.prometheus() + GLOBAL_REGISTRY.prometheus()
+
+
+# --------------------------------------------------------------------------- #
+# HTTP surface (mirrors tools/serve.py)
+# --------------------------------------------------------------------------- #
+
+MAX_BODY_BYTES = 8 << 20
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.0"  # close-delimited NDJSON streaming
+
+    @property
+    def router(self) -> Router:
+        return self.server.router
+
+    def log_message(self, *a):  # the router's JSON lines replace these
+        pass
+
+    def _json(self, status: int, payload: dict, headers=()) -> None:
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        r = self.router
+        if self.path == "/healthz":
+            self._json(200, {"ok": True})
+        elif self.path == "/readyz":
+            n = len(r._eligible())
+            self._json(200 if n else 503,
+                       {"ok": n > 0, "eligible_replicas": n})
+        elif self.path == "/statz":
+            self._json(200, r.stats())
+        elif self.path == "/metrics":
+            body = r.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/tracez":
+            self._json(200, r.obs.tracer.chrome_trace())
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:
+        if self.path != "/generate":
+            self._json(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+        except ValueError as e:
+            self._json(400, {"error": f"bad Content-Length: {e}"})
+            return
+        if n < 0 or n > MAX_BODY_BYTES:
+            self._json(400 if n < 0 else 413,
+                       {"error": f"bad body length {n}"})
+            return
+        try:
+            spec = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._json(400, {"error": f"bad request body: {e}"})
+            return
+        if not isinstance(spec, dict):
+            self._json(400, {"error": "request body must be a JSON object"})
+            return
+        r = self.router
+        rid = str(spec.get("request_id") or r._next_rid())
+        if spec.get("stream"):
+            self._stream(spec, rid)
+        else:
+            try:
+                payload = r.route(spec, rid)
+            except RouteRefused as e:
+                headers = ([("Retry-After", str(e.retry_after))]
+                           if e.retry_after else [])
+                self._json(e.status,
+                           {"error": e.reason, "request_id": rid,
+                            "shed": e.status != 400}, headers)
+                return
+            status = 500 if payload["finish_reason"] == "error" else 200
+            self._json(status, payload)
+
+    def _stream(self, spec: dict, rid: str) -> None:
+        """NDJSON splice: the header is deferred until the route either
+        delivers a first token or refuses outright, so a full-fleet
+        outage is still a clean 503 + Retry-After instead of a 200 that
+        dies."""
+        started = threading.Event()
+
+        def emit(obj) -> None:
+            self.wfile.write((json.dumps(obj) + "\n").encode())
+            self.wfile.flush()
+
+        def on_token(tok: int) -> None:
+            if not started.is_set():
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()
+                started.set()
+            try:
+                emit({"event": "token", "request_id": rid, "token": tok})
+            except (BrokenPipeError, ConnectionResetError):
+                # the CLIENT went away: abort the route (counted
+                # "abandoned"; the replica finishes the in-flight
+                # generation under its own timeout contract)
+                started.set()
+                raise _ClientGone()
+
+        try:
+            try:
+                payload = self.router.route(spec, rid, on_token=on_token)
+            except RouteRefused as e:
+                if not started.is_set():
+                    headers = ([("Retry-After", str(e.retry_after))]
+                               if e.retry_after else [])
+                    self._json(e.status,
+                               {"error": e.reason, "request_id": rid,
+                                "shed": e.status != 400}, headers)
+                return
+            if not started.is_set():
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()
+                started.set()
+            emit({"event": "done", **payload})
+        except _ClientGone:
+            pass
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+class _ClientGone(Exception):
+    """The downstream client dropped its connection mid-stream."""
+
+
+class RouterServer:
+    """Router + ThreadingHTTPServer on background threads — the embedding
+    entry point for the CLI, the smoke drive, and the tests."""
+
+    def __init__(self, replicas, cfg: Optional[RouterConfig] = None, *,
+                 host: str = "127.0.0.1", port: int = 0, **router_kw):
+        self.router = Router(replicas, cfg, **router_kw)
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.router = self.router
+        self.port = self.httpd.server_address[1]
+        self._http_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.router.start()
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="router-http",
+            daemon=True)
+        self._http_thread.start()
+
+    def stop(self) -> None:
+        self.router.stop()
+        self.httpd.shutdown()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=10)
+        self.httpd.server_close()
+
+
+# --------------------------------------------------------------------------- #
+# smoke drive (`make router-chaos-smoke`) + CLI
+# --------------------------------------------------------------------------- #
+
+
+def _stream_post(port: int, spec: dict, on_token=None,
+                 host: str = "127.0.0.1", timeout: float = 300.0):
+    """Incremental NDJSON client: POSTs with stream=True, fires
+    ``on_token(i, row)`` per token row as it ARRIVES (the hook the chaos
+    drills key their kill timing off), returns (status, [rows])."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/generate", json.dumps({**spec, "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return resp.status, [json.loads(resp.read() or b"{}")]
+        rows = []
+        i = 0
+        while True:
+            line = resp.readline()
+            if not line:
+                return resp.status, rows
+            row = json.loads(line)
+            rows.append(row)
+            if row.get("event") == "token":
+                if on_token is not None:
+                    on_token(i, row)
+                i += 1
+            if row.get("event") == "done":
+                return resp.status, rows
+    finally:
+        conn.close()
+
+
+def _wait_for(cond, timeout: float = 20.0, poll: float = 0.02) -> bool:
+    """Poll ``cond()`` until true (True) or the deadline passes (False)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        if cond():
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(poll)
+
+
+def _breaker(router: Router, name: str) -> str:
+    rep = router.replicas[name]
+    with rep._mu:
+        return rep.breaker
+
+
+def _smoke_fleet(n: int):
+    """n in-process serve.py replicas over IDENTICAL tiny random-init
+    models (same seed -> same params -> greedy outputs are a shared
+    bit-exact oracle), streaming per token (decode_block_len 1)."""
+    import jax
+
+    from picotron_tpu.config import Config
+    from picotron_tpu.inference import InferenceEngine
+    from picotron_tpu.models import llama
+    from picotron_tpu.tools import serve
+    from picotron_tpu.tools.generate import SMOKE_CONFIG
+    from picotron_tpu.train import _ensure_devices
+
+    servers = []
+    cfg0 = Config.from_dict(SMOKE_CONFIG)
+    jit_init = jax.jit(lambda k: llama.init_params(k, cfg0.model))
+    for _ in range(n):
+        cfg = Config.from_dict(SMOKE_CONFIG)
+        cfg.inference.decode_block_len = 1
+        _ensure_devices(cfg)
+        engine = InferenceEngine(cfg, slots=2, max_seq_len=64)
+        params = engine.shard_params(jit_init(jax.random.PRNGKey(0)))
+        srv = serve.Server(engine, params, port=0,
+                           log=lambda *a, **k: None)
+        srv.start()
+        servers.append(srv)
+    return servers
+
+
+def _smoke() -> int:
+    """The `make router-chaos-smoke` drive — the ISSUE 12 acceptance
+    drill end to end. Returns an exit code."""
+    from picotron_tpu.resilience.chaos import RouterChaos
+    from picotron_tpu.tools import serve
+
+    fail: list = []
+
+    def check(name: str, ok) -> None:
+        print(f"router-chaos-smoke: {name}: {'ok' if ok else 'FAIL'}",
+              flush=True)
+        if not ok:
+            fail.append(name)
+
+    servers = _smoke_fleet(3)
+    ports = [s.port for s in servers]
+    names = [f"127.0.0.1:{p}" for p in ports]
+    by_name = dict(zip(names, servers))
+    chaos = RouterChaos()
+    cfg = RouterConfig(
+        probe_interval_s=0.05, probe_timeout_s=0.4,
+        breaker_failures=3, breaker_backoff_s=0.05,
+        breaker_backoff_max_s=0.4, breaker_probe_attempts=4,
+        scrape_stale_s=1.0, stream_idle_timeout_s=60.0,
+        connect_timeout_s=5.0)
+    rs = RouterServer(names, cfg, chaos=chaos, log=lambda *a, **k: None)
+    rs.start()
+    router = rs.router
+    killed: dict = {}
+    try:
+        check("fleet_eligible", router.wait_eligible(3, timeout=30))
+        check("healthz", serve._get(rs.port, "/healthz")[0] == 200)
+        check("readyz", serve._get(rs.port, "/readyz")[0] == 200)
+
+        # greedy oracle: one unfaulted single-replica run (all replicas
+        # hold identical params, so any one of them is the oracle)
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]
+        spec = {"prompt": prompt, "max_new_tokens": 24}
+        st, body = serve._post(ports[0], spec)
+        oracle = body["tokens"]
+        check("oracle", st == 200 and len(oracle) == 24)
+
+        # routed request matches the oracle; request_id echoes end to end
+        st, body = serve._post(rs.port, {**spec, "request_id": "smk-1"})
+        check("routed_generate", st == 200 and body["tokens"] == oracle
+              and body["request_id"] == "smk-1" and body["replays"] == 0)
+
+        # prefix affinity: page-aligned shared prefixes land on ONE replica
+        k = prefix_key(prompt, cfg.affinity_page_len)
+        want = router.place(prompt)
+        router._request_refused(want)  # release the probe placement
+        again = router.place(prompt)
+        router._request_refused(again)
+        check("affinity_stable",
+              k is not None and want is not None
+              and again.name == want.name)
+
+        # ---- the acceptance drill: SIGKILL (in-process) one replica ----
+        # holding an in-flight greedy stream; the spliced client stream
+        # must equal the unfaulted oracle bit for bit, with replays == 1.
+        def kill_at(i, row) -> None:
+            if i == 4 and not killed:
+                victim = None
+                for nm, rep in router.replicas.items():
+                    with rep._mu:
+                        busy = rep.inflight > 0
+                    if busy:
+                        victim = nm
+                        break
+                killed["name"] = victim or names[0]
+                chaos.kill(by_name[killed["name"]])
+
+        st, rows = _stream_post(rs.port, {**spec, "request_id": "smk-kill"},
+                                on_token=kill_at)
+        toks = [r["token"] for r in rows if r.get("event") == "token"]
+        done = [r for r in rows if r.get("event") == "done"]
+        check("kill_mid_stream_spliced",
+              st == 200 and len(done) == 1 and killed
+              and done[0]["finish_reason"] == "length"
+              and done[0]["replays"] == 1
+              and done[0]["tokens"] == toks)
+        check("kill_bit_identical", toks == oracle)
+        check("kill_request_id",
+              all(r.get("request_id") == "smk-kill" for r in rows))
+
+        # the dead replica's breaker opens once the prober sees it
+        check("dead_breaker_open", _wait_for(
+            lambda: _breaker(router, killed["name"]) == "open"))
+
+        survivors = [nm for nm in names if nm != killed["name"]]
+
+        # ---- flap + stall drill on one survivor: breaker opens, then ----
+        # recovers through half-open, with zero client-visible errors.
+        flappy = survivors[0]
+        chaos.flap(by_name[flappy], down=True)
+        check("flap_breaker_open", _wait_for(
+            lambda: _breaker(router, flappy) == "open"))
+        st, body = serve._post(rs.port, {**spec, "request_id": "smk-flap"})
+        check("flap_requests_survive",
+              st == 200 and body["tokens"] == oracle)
+        chaos.flap(by_name[flappy], down=False)
+        check("flap_recovered_closed", _wait_for(
+            lambda: _breaker(router, flappy) == "closed"))
+
+        # stall past the probe timeout: reads as a hard failure ladder
+        chaos.stall(by_name[flappy], seconds=cfg.probe_timeout_s * 2)
+        check("stall_breaker_open", _wait_for(
+            lambda: _breaker(router, flappy) == "open"))
+        chaos.unstall(by_name[flappy])
+        check("stall_recovered_closed", _wait_for(
+            lambda: _breaker(router, flappy) == "closed"))
+
+        # ---- scrape-failure injection: candidate drop WITHOUT a ----
+        # breaker trip, recovery once the scrape path heals
+        scrapey = survivors[1]
+
+        def scrapey_eligible() -> bool:
+            return scrapey in [r.name for r in router._eligible()]
+
+        chaos.fail_scrape(scrapey, on=True)
+        check("scrape_stale_drops_candidate",
+              _wait_for(lambda: not scrapey_eligible())
+              and _breaker(router, scrapey) == "closed")
+        st, body = serve._post(rs.port, {**spec, "request_id": "smk-scr"})
+        check("scrape_requests_survive",
+              st == 200 and body["tokens"] == oracle)
+        chaos.fail_scrape(scrapey, on=False)
+        check("scrape_recovers", _wait_for(scrapey_eligible))
+
+        # ---- drain drill: DURING the drain window (an in-flight ----
+        # request still finishing) the prober reads "draining" as
+        # graceful — candidate drop, breaker untouched. Once the drain
+        # completes the listener closes like the process exited, so the
+        # window needs a slow request holding it open.
+        slow: dict = {}
+
+        def bg() -> None:
+            slow["resp"] = serve._post(
+                by_name[flappy].port,
+                {"prompt": [9, 8, 7], "max_new_tokens": 48})
+
+        t = threading.Thread(target=bg)
+        t.start()
+        _wait_for(lambda: serve._get(by_name[flappy].port,
+                                     "/statz")[1].get("active_slots", 0)
+                  > 0, timeout=60)
+        by_name[flappy].front.begin_drain()
+        _wait_for(lambda: router.replicas[flappy].snapshot(
+            time.monotonic())["draining"])
+        snap = router.replicas[flappy].snapshot(time.monotonic())
+        check("drain_graceful",
+              snap["draining"] and snap["breaker"] == "closed"
+              and flappy not in [r.name for r in router._eligible()])
+        t.join(120)
+        check("drain_inflight_served",
+              slow.get("resp", (0, {}))[0] == 200)
+        st, body = serve._post(rs.port, {**spec, "request_id": "smk-end"})
+        check("post_drain_served",
+              st == 200 and body["tokens"] == oracle)
+
+        # ---- accounting: the router's own registry holds the story ----
+        mst, mtext = serve._get_text(rs.port, "/metrics")
+        prom = parse_prometheus(mtext)
+        stats = router.stats()
+        check("metrics_accounting",
+              mst == 200
+              and prom.get("picotron_router_replays_total") == 1
+              and prom.get(
+                  'picotron_router_requests_total{state="completed"}')
+              == stats["requests"]["completed"]
+              and stats["requests"]["completed"] == 5
+              and stats["requests"]["failed"] == 0
+              and stats["requests"]["shed"] == 0)
+        trace = router.obs.tracer.chrome_trace()
+        evs = trace["traceEvents"]
+        routes = [e for e in evs if e["name"] == "route"]
+        attempts = [e for e in evs if e["name"] == "attempt"]
+        replay_ids = {e["args"].get("parent") for e in evs
+                      if e["name"] == "replay"}
+        kill_roots = [e["args"]["id"] for e in routes
+                      if e["args"].get("request_id") == "smk-kill"]
+        check("trace_route_attempt_replay_chain",
+              len(routes) >= 5
+              and kill_roots and kill_roots[0] in replay_ids
+              and sum(1 for a in attempts
+                      if a["args"].get("parent") == kill_roots[0]) == 2)
+    finally:
+        rs.stop()
+        for nm, srv in by_name.items():
+            if nm != killed.get("name"):
+                try:
+                    srv.drain_and_join(timeout=60)
+                except OSError:
+                    pass
+    return 1 if fail else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="prefix-affinity router over N serve.py replicas "
+                    "(least-loaded placement, circuit breakers, "
+                    "mid-stream failover replay)")
+    ap.add_argument("--replica", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="one serve.py replica (repeatable)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9000,
+                    help="0 = ephemeral (printed at startup)")
+    ap.add_argument("--router-config", default="",
+                    help="JSON file of RouterConfig overrides")
+    ap.add_argument("--smoke", action="store_true",
+                    help="in-process 3-replica chaos drill (the `make "
+                         "router-chaos-smoke` target)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        rc = _smoke()
+        print(f"router-chaos-smoke: {'PASS' if rc == 0 else 'FAIL'}",
+              flush=True)
+        return rc
+
+    if not args.replica:
+        raise SystemExit("pass at least one --replica HOST:PORT "
+                         "(or --smoke)")
+    if args.router_config:
+        with open(args.router_config) as f:
+            cfg = RouterConfig.from_dict(json.load(f))
+    else:
+        cfg = RouterConfig()
+    rs = RouterServer(args.replica, cfg, host=args.host, port=args.port)
+    rs.start()
+    rs.router._event("routing", port=rs.port,
+                     replicas=list(rs.router.replicas))
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        rs.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,18 +14,23 @@ exotic dependency.
 API (all bodies JSON):
 
 - ``POST /generate`` — ``{"prompt": [ids], "max_new_tokens", "temperature",
-  "top_k", "top_p", "eos_id", "timeout_s", "stream", "uid"}`` (all but
-  ``prompt`` optional). Non-streaming: one JSON document with ``tokens``
-  and ``finish_reason`` (``eos|length|timeout|shed|error``); HTTP status
-  200 for served outcomes, 503 + ``Retry-After`` when shed, 500 on
-  ``error``. ``"stream": true``: NDJSON events ``{"event":"token",...}``
-  per generated token, then one ``{"event":"done", ...}`` carrying the
-  full result.
+  "top_k", "top_p", "eos_id", "timeout_s", "stream", "uid",
+  "request_id"}`` (all but ``prompt`` optional). Non-streaming: one JSON
+  document with ``tokens`` and ``finish_reason``
+  (``eos|length|timeout|shed|error``); HTTP status 200 for served
+  outcomes, 503 + ``Retry-After`` when shed, 500 on ``error``.
+  ``"stream": true``: NDJSON events ``{"event":"token",...}`` per
+  generated token, then one ``{"event":"done", ...}`` carrying the full
+  result. A client-supplied ``request_id`` (the router's correlation
+  key) is echoed on every token row, the done row, and the non-streaming
+  document, falling back to the server ``uid``.
 - ``GET /healthz`` — liveness: 200 while the dispatch loop is making
   progress, 503 once the watchdog sees a stall (supervisors restart on
   this, exactly like ``tools/supervise.py``'s heartbeat rule).
-- ``GET /readyz`` — readiness: 200 only when accepting work (503 while
-  draining or stalled — load balancers pull the replica first).
+- ``GET /readyz`` — readiness: 200 only when accepting work; the 503
+  body carries ``"state": "draining" | "stalled" | "dead"`` so a poller
+  (the multi-replica router, tools/router.py) can tell a GRACEFUL drain
+  (stop placing, no breaker action) from a sick replica.
 - ``GET /statz`` — the batcher's ``stats()`` (terminal-state counters,
   queue-wait / time-to-first-token percentiles) plus the server's
   admission-rejection counters and drain/stall state.
@@ -498,9 +503,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200 if ok else 503,
                        {"ok": ok, "stalled": f.stalled, "dead": f.dead})
         elif self.path == "/readyz":
+            # the body's "state" is the poller's contract: "draining" is
+            # GRACEFUL (a router stops placing, breaker untouched) while
+            # "stalled"/"dead" are failures — without it, drain and death
+            # are indistinguishable 503s (docs/SERVING.md)
             ok = f.ready()
+            state = ("dead" if f.dead else "stalled" if f.stalled
+                     else "draining" if f.draining else "ready")
             self._json(200 if ok else 503,
-                       {"ok": ok, "draining": f.draining,
+                       {"ok": ok, "state": state, "draining": f.draining,
                         "stalled": f.stalled, "dead": f.dead})
         elif self.path == "/statz":
             self._json(200, f.stats())
@@ -573,11 +584,16 @@ class _Handler(BaseHTTPRequestHandler):
                        if e.retry_after else [])
             self._json(e.status, {"error": e.reason, "shed": True}, headers)
             return
+        # client-supplied correlation id, echoed on every response row
+        # (falling back to the server uid): the observable a router's
+        # replay dedup keys off end to end
+        rid = str(spec.get("request_id") or uid)
         if spec.get("stream"):
-            self._stream(uid, waiter)
+            self._stream(uid, waiter, rid)
         else:
             res = self._await_result(waiter)
-            payload = {"uid": uid, "tokens": list(res.tokens),
+            payload = {"uid": uid, "request_id": rid,
+                       "tokens": list(res.tokens),
                        "finish_reason": res.finish_reason,
                        "queue_wait_s": _r(res.queue_wait_s),
                        "ttft_s": _r(res.ttft_s)}
@@ -594,7 +610,7 @@ class _Handler(BaseHTTPRequestHandler):
             if kind == "done":
                 return val
 
-    def _stream(self, uid: str, waiter: _Waiter) -> None:
+    def _stream(self, uid: str, waiter: _Waiter, request_id: str) -> None:
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.end_headers()
@@ -607,9 +623,11 @@ class _Handler(BaseHTTPRequestHandler):
             kind, val = waiter.events.get()
             try:
                 if kind == "token":
-                    emit({"event": "token", "uid": uid, "token": int(val)})
+                    emit({"event": "token", "uid": uid,
+                          "request_id": request_id, "token": int(val)})
                     continue
                 emit({"event": "done", "uid": uid,
+                      "request_id": request_id,
                       "tokens": list(val.tokens),
                       "finish_reason": val.finish_reason,
                       "queue_wait_s": _r(val.queue_wait_s),
@@ -913,13 +931,16 @@ def main(argv=None) -> int:
         print(f"serve-smoke: {'PASS' if rc == 0 else 'FAIL'}", flush=True)
         return rc
 
-    # foreground: wait for the drain (SIGTERM) to complete
+    # foreground: wait for the drain (SIGTERM) to complete. Exit 0 ONLY
+    # for a clean drain — a dead dispatch loop must exit nonzero so a
+    # supervisor (tools/supervise.py --serve) restarts the replica
+    # instead of reading the death as an intentional shutdown.
     try:
         while not server.front.stopped.is_set():
             server.front.join(timeout=1.0)
     except KeyboardInterrupt:
         pass  # second signal: abort now
-    return 0
+    return 1 if server.front.dead else 0
 
 
 if __name__ == "__main__":

@@ -20,6 +20,13 @@ preemptions, and dead hosts without ever looping forever.
 - **preemption aware** — exit code ``EXIT_PREEMPTED`` (75) means "resumable
   checkpoint written, re-run me"; it is restarted like any failure but the
   trainer's auto-resume makes the relaunch continue the run;
+- **serve mode** (``--serve``) — the child is a serving replica
+  (``tools/serve.py``): clean drains (exit 0) relaunch WITHOUT charging
+  the restart budget (a drain is a rollout, not a crash), nonzero exits
+  (serve exits 1 when its dispatch loop dies) walk the normal ladder,
+  and the supervisor's own SIGTERM/SIGINT forwards to the child and ends
+  supervision with its exit code — one supervisor per fleet member keeps
+  an N-replica router fabric (``tools/router.py``) populated;
 - **spot-quota aware** — a launch that dies within ``--quota-window``
   seconds never produced a step (no capacity, quota exhausted, a dead
   coordinator): those retry on their own long, capped backoff ladder
@@ -68,6 +75,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -197,11 +205,23 @@ def run_supervised(cmd, max_restarts: int = 3, backoff: float = 1.0,
                    quota_window: float = 0.0, quota_backoff: float = 30.0,
                    quota_backoff_max: float = 1800.0,
                    max_launch_retries: int = 120, epoch_file: str = "",
-                   metrics_jsonl: str = "", sleep=time.sleep) -> int:
+                   metrics_jsonl: str = "", serve_mode: bool = False,
+                   sleep=time.sleep) -> int:
     """Run ``cmd`` under supervision; returns the exit code to propagate.
     ``stall_timeout`` <= 0 disables stall detection; ``epoch_file`` joins a
     per-host pod (see the module docstring). Importable so the chaos suite
-    drives it in-process (the children are still real subprocesses)."""
+    drives it in-process (the children are still real subprocesses).
+
+    ``serve_mode`` (the ``--serve`` flag) supervises a serving replica
+    (``tools/serve.py``) instead of a trainer, with restart-ALWAYS fleet
+    semantics: a clean drain (exit 0) relaunches the replica after
+    ``backoff`` WITHOUT charging the restart budget — a drain is an
+    intentional event (SIGTERM rollout, a router pulling the replica),
+    not a crash — while nonzero exits (a dead dispatch loop exits 1)
+    walk the existing budget/backoff ladder. The fleet is stopped
+    through the SUPERVISOR: its own SIGTERM/SIGINT is forwarded to the
+    child (which drains) and supervision ends with the child's exit
+    code. Signal forwarding is installed only on the main thread."""
     env = dict(os.environ)
     if heartbeat:
         env["PICOTRON_HEARTBEAT"] = heartbeat
@@ -215,6 +235,38 @@ def run_supervised(cmd, max_restarts: int = 3, backoff: float = 1.0,
         quota_window=quota_window, quota_backoff=quota_backoff,
         quota_backoff_max=quota_backoff_max,
         max_launch_retries=max_launch_retries)
+    # serve mode: the supervisor is the fleet's stop surface — forward
+    # SIGTERM/SIGINT to the child (it drains) and end supervision with
+    # its exit code. Only installable from the main thread (tests drive
+    # this function from worker threads, where the default disposition
+    # already applies).
+    stop_req = {"flag": False, "proc": None}
+    restore: dict = {}
+    if serve_mode and threading.current_thread() is threading.main_thread():
+        def _forward(signum, frame):
+            stop_req["flag"] = True
+            p = stop_req["proc"]
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+        for s in (signal.SIGTERM, signal.SIGINT):
+            restore[s] = signal.signal(s, _forward)
+    try:
+        return _run_supervised_loop(
+            cmd, env, budget, stop_req, max_restarts=max_restarts,
+            backoff=backoff, heartbeat=heartbeat,
+            stall_timeout=stall_timeout, term_grace=term_grace,
+            poll_interval=poll_interval, epoch_file=epoch_file,
+            serve_mode=serve_mode, sleep=sleep)
+    finally:
+        for s, handler in restore.items():
+            signal.signal(s, handler)
+
+
+def _run_supervised_loop(cmd, env, budget, stop_req, *, max_restarts,
+                         backoff, heartbeat, stall_timeout, term_grace,
+                         poll_interval, epoch_file, serve_mode,
+                         sleep) -> int:
     while True:
         if heartbeat:
             _touch(heartbeat)  # launch counts as liveness: startup gets a full window
@@ -225,6 +277,11 @@ def run_supervised(cmd, max_restarts: int = 3, backoff: float = 1.0,
               f"{budget.attempt}/{max_restarts}): {' '.join(cmd)}",
               flush=True)
         proc = subprocess.Popen(cmd, env=env)
+        stop_req["proc"] = proc
+        if stop_req["flag"] and proc.poll() is None:
+            # the stop signal landed between launches: this child never
+            # saw the forward — deliver it now
+            proc.send_signal(signal.SIGTERM)
         stalled = peer_restart = False
         next_epoch_poll = 0.0  # epoch lives on shared storage: poll it on
         # its own >= 1s cadence, not every child-liveness tick
@@ -249,7 +306,22 @@ def run_supervised(cmd, max_restarts: int = 3, backoff: float = 1.0,
                     peer_restart = True
                     break
             sleep(poll_interval)
+        if stop_req["flag"]:
+            # operator stop: the forwarded SIGTERM drained the child —
+            # propagate its verdict (0 on a clean drain), never relaunch
+            code = _shell_code(rc)
+            print(f"supervise: stop requested; child exited {code}",
+                  flush=True)
+            return code
         if rc == 0 and not stalled and not peer_restart:
+            if serve_mode:
+                # a replica drain is intentional, not a crash: keep the
+                # fleet member alive without touching the restart budget
+                print(f"supervise: replica drained cleanly (exit 0); "
+                      f"relaunching in {backoff:.1f}s (not charged to "
+                      f"the restart budget)", flush=True)
+                sleep(backoff)
+                continue
             print("supervise: trainer exited cleanly", flush=True)
             return 0
         if peer_restart:
@@ -441,6 +513,14 @@ def main(argv=None) -> int:
     parser.add_argument("--max-launch-retries", type=int, default=120,
                         help="consecutive launch failures before giving up "
                              "(0 = unlimited)")
+    parser.add_argument("--serve", action="store_true",
+                        help="the child is a serving replica "
+                             "(tools/serve.py): clean drains (exit 0) "
+                             "relaunch WITHOUT charging the restart "
+                             "budget, nonzero exits walk the normal "
+                             "ladder, and the supervisor's own "
+                             "SIGTERM/SIGINT forwards to the child and "
+                             "ends supervision after its drain")
     parser.add_argument("--num-procs", type=int, default=1,
                         help="N > 1 supervises a local N-process pod "
                              "(JAX_PROCESS_ID/JAX_NUM_PROCESSES per rank)")
@@ -464,6 +544,10 @@ def main(argv=None) -> int:
         parser.error("no command given (usage: supervise [opts] -- cmd ...)")
     if args.stall_timeout > 0 and not args.heartbeat:
         parser.error("--stall-timeout needs --heartbeat")
+    if args.serve and args.num_procs > 1:
+        parser.error("--serve supervises one replica per supervisor "
+                     "(run N supervisors for an N-replica fleet); it is "
+                     "incompatible with --num-procs pods")
     if args.num_procs > 1 and args.epoch_file:
         parser.error("--epoch-file is for one-supervisor-per-host pods; "
                      "--num-procs already restarts its local pod together")
@@ -484,7 +568,8 @@ def main(argv=None) -> int:
     if args.num_procs > 1:
         return run_pod(cmd, args.num_procs, coordinator=args.coordinator,
                        **common)
-    return run_supervised(cmd, epoch_file=args.epoch_file, **common)
+    return run_supervised(cmd, epoch_file=args.epoch_file,
+                          serve_mode=args.serve, **common)
 
 
 if __name__ == "__main__":

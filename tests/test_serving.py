@@ -664,6 +664,125 @@ def test_watchdog_flags_latency_stall_and_recovers():
         srv.drain_and_join(timeout=60)
 
 
+def test_readyz_distinguishes_draining_from_dead():
+    """The readyz 503 body carries the POLLER'S contract (ISSUE 12): a
+    router must stop placing on a draining replica without tripping its
+    circuit breaker, and must treat a dead one as a failure — before the
+    "state" field, both were indistinguishable 503s."""
+    cfg, srv = _server(slots=1, inf={"decode_block_len": 1})
+    try:
+        port = srv.port
+        st, body = serve._get(port, "/readyz")
+        assert st == 200 and body["state"] == "ready"
+        # hold the drain window open with an in-flight request, exactly
+        # like a rolling restart catches a replica mid-generation
+        results = {}
+
+        def bg():
+            results["slow"] = serve._post(port, {"prompt": [1, 2, 3],
+                                                 "max_new_tokens": 40})
+
+        t = threading.Thread(target=bg)
+        t.start()
+        _poll_statz(port, lambda s: s.get("active_slots", 0) > 0)
+        srv.front.begin_drain()
+        st, body = serve._get(port, "/readyz")
+        assert st == 503
+        assert body["state"] == "draining" and body["draining"]
+        assert not body["dead"]
+        t.join(60)
+        assert results["slow"][0] == 200  # drain finished the in-flight
+    finally:
+        srv.drain_and_join(timeout=60)
+
+    # dead flavor: the dispatch loop died -> "dead", not "draining".
+    # Keep the listener up past the death (the serve CLI's window between
+    # loop death and process exit) so the surface is observable.
+    cfg, srv = _server()
+    try:
+        srv.front._on_drained = None
+
+        def boom(*a, **k):
+            raise RuntimeError("dispatch died")
+
+        srv.front._batcher.step = boom
+        st, body = serve._post(srv.port, {"prompt": [1], "max_new_tokens": 2})
+        assert st == 500
+        srv.front.join(timeout=60)
+        st, body = serve._get(srv.port, "/readyz")
+        assert st == 503 and body["state"] == "dead"
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+def test_request_id_echoed_on_every_stream_row():
+    """A client-supplied request_id rides every NDJSON token row, the
+    done row, and the non-streaming document (falling back to the server
+    uid) — the correlation key router-side replay dedup is audited by."""
+    cfg, srv = _server()
+    try:
+        spec = {"prompt": [5, 6, 7], "max_new_tokens": 4,
+                "request_id": "corr-77", "stream": True}
+        st, events = serve._post(srv.port, spec, stream=True)
+        assert st == 200 and len(events) == 5
+        assert all(e["request_id"] == "corr-77" for e in events)
+        st, body = serve._post(srv.port, {"prompt": [5, 6, 7],
+                                          "max_new_tokens": 2,
+                                          "request_id": "corr-78"})
+        assert st == 200 and body["request_id"] == "corr-78"
+        # no request_id -> the uid stands in, so the field is always there
+        st, events = serve._post(srv.port, {"prompt": [5, 6], "uid": "u9",
+                                            "max_new_tokens": 2,
+                                            "stream": True}, stream=True)
+        assert st == 200
+        assert all(e["request_id"] == "u9" for e in events)
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_killed_server_releases_streaming_waiters_with_error():
+    """A replica killed mid-generation (dispatch loop dies, the
+    in-process SIGKILL the router chaos drill uses) must release every
+    in-flight STREAM with a terminal ``finish_reason: "error"`` done row
+    — not strand the client — because that row is what triggers the
+    router's failover replay."""
+    from picotron_tpu.resilience.chaos import RouterChaos
+
+    cfg, srv = _server(slots=2, inf={"decode_block_len": 1})
+    try:
+        port = srv.port
+        rows = []
+        got_some = threading.Event()
+
+        def on_token(i, row):
+            got_some.set()
+
+        from picotron_tpu.tools.router import _stream_post
+
+        def bg():
+            rows.append(_stream_post(
+                port, {"prompt": [3, 1, 4], "max_new_tokens": 48,
+                       "request_id": "kill-1"}, on_token=on_token))
+
+        t = threading.Thread(target=bg)
+        t.start()
+        assert got_some.wait(60)  # mid-generation, tokens flowing
+        RouterChaos().kill(srv)
+        t.join(60)
+        assert not t.is_alive()  # the waiter was released, nobody hangs
+        st, events = rows[0]
+        done = [e for e in events if e.get("event") == "done"]
+        assert len(done) == 1
+        assert done[0]["finish_reason"] == "error"
+        assert done[0]["request_id"] == "kill-1"
+        assert srv.front.dead  # healthz tells the supervisor to restart
+        assert not srv.front._waiters  # nothing stranded
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
 # --------------------------------------------------------------------------- #
 # the serve-chaos acceptance: all three faults in one run
 # --------------------------------------------------------------------------- #

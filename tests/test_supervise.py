@@ -434,3 +434,67 @@ def test_main_rejects_conflicting_pod_modes():
     # single-process trainers racing on one save_dir
     with pytest.raises(SystemExit):
         main(["--num-procs", "2", "--", "true"])
+
+
+# --------------------------------------------------------------------------- #
+# serve mode (--serve): replica-fleet restart semantics
+# --------------------------------------------------------------------------- #
+
+
+def test_serve_mode_clean_drain_relaunches_without_budget_charge(tmp_path):
+    """A serving replica's clean drain (exit 0) is a rollout, not a
+    crash: serve mode relaunches it WITHOUT charging the restart budget,
+    while nonzero exits still walk the bounded ladder. Run sequence:
+    exit 0 (free relaunch), exit 7 (charges 1/1), exit 7 (budget
+    exhausted -> propagate)."""
+    count = tmp_path / "count"
+    script = _script(tmp_path, "replica.py", """
+        import pathlib, sys
+        p = pathlib.Path({count!r})
+        n = len(p.read_text()) if p.exists() else 0
+        p.write_text("x" * (n + 1))
+        sys.exit(0 if n == 0 else 7)
+    """.format(count=str(count)))
+    rc = run_supervised([sys.executable, script], max_restarts=1,
+                        backoff=0.01, backoff_max=0.02, healthy_reset=0,
+                        serve_mode=True, sleep=lambda s: None)
+    assert rc == 7
+    assert count.read_text() == "xxx"  # drained once + two crash runs
+
+
+def test_serve_mode_off_keeps_exit_zero_final(tmp_path):
+    """Without --serve, exit 0 still means done (trainer semantics are
+    untouched by the serve-mode addition)."""
+    script = _script(tmp_path, "once.py", "raise SystemExit(0)\n")
+    rc = run_supervised([sys.executable, script], max_restarts=3,
+                        backoff=0.01, sleep=lambda s: None)
+    assert rc == 0
+
+
+def test_serve_mode_sigterm_forwards_to_child_and_ends_supervision(
+        tmp_path):
+    """The supervisor is the fleet's stop surface: its own SIGTERM
+    forwards to the replica (which drains and exits 0) and supervision
+    ends with that code instead of relaunching. Runs on the main thread
+    (signal handlers are only installable there)."""
+    import signal as _signal
+
+    script = _script(tmp_path, "drain.py", """
+        import signal, sys, time
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+        while True:
+            time.sleep(0.05)
+    """)
+    threading.Timer(
+        0.8, lambda: os.kill(os.getpid(), _signal.SIGTERM)).start()
+    rc = run_supervised([sys.executable, script], max_restarts=3,
+                        backoff=0.01, serve_mode=True)
+    assert rc == 0
+    # the handler was restored: a later SIGTERM uses the default again
+    assert _signal.getsignal(_signal.SIGTERM) == _signal.SIG_DFL
+
+
+def test_main_rejects_serve_with_pod_mode():
+    with pytest.raises(SystemExit):
+        main(["--serve", "--num-procs", "2",
+              "--coordinator", "localhost:1", "--", "true"])
