@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke obs-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke obs-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -20,6 +20,7 @@ test: native lint
 test-all: native lint
 	python -m pytest tests/ -x -q
 	$(MAKE) obs-smoke
+	$(MAKE) quant-smoke
 	$(MAKE) router-chaos-smoke
 
 # picolint static analysis (picotron_tpu/analysis/, docs/ANALYSIS.md):
@@ -83,6 +84,25 @@ kernel-smoke:
 	  tests/test_sampling_epilogue.py -q
 	JAX_PLATFORMS=cpu python bench_decode.py --attend-impl flash \
 	  --kv-layout paged --kv-page-policy hot_bf16 --sample-on-device \
+	  --block-len 8
+
+# Quantized-weights smoke (ops/pallas/quant_matmul.py, docs/INFERENCE.md
+# "Quantized weights"): per-channel int8 weights through the full
+# generate CLI with --check-weight-parity — greedy generations must be
+# IDENTICAL to a bf16 engine fed the fake-quant reference (the
+# quantization error is in both; any difference is the fused dequant
+# pipeline itself), on tp=1 here and tp=1/2 in tier-1
+# (tests/test_quant_weights.py). Closes with the int8 bench so
+# weight_bytes_total/weight_bytes_per_token land in the JSON trajectory
+# next to the bf16 default's. The serving default stays bf16, so
+# decode/spec/paged-smoke output is unchanged.
+quant-smoke:
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
+	  --weight-dtype int8 --check-weight-parity
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
+	  --weight-dtype int8 --check-weight-parity --kv-cache-dtype int8 \
+	  --decode-block-len 4
+	JAX_PLATFORMS=cpu python bench_decode.py --weight-dtype int8 \
 	  --block-len 8
 
 # Paged-KV smoke (inference/paged_kv.py): a shared-prefix batch through

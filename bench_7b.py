@@ -51,6 +51,59 @@ def proxy_cfg(layers: int, mbs: int, seq: int, on_tpu: bool):
     })
 
 
+def weight_bytes(m, weight_dtype: str = "bf16") -> int:
+    """Serving-weight bytes of a model config: every matmul weight at the
+    storage format (bf16 = 2 bytes/element; int8 = 1 byte + one fp32
+    scale per output channel — ops/pallas/quant_matmul.py), embeddings
+    and norms always full precision. Pure arithmetic, mirroring
+    llama.param_bytes over the tree checkpoint.load_* builds."""
+    get = (m.__getitem__ if isinstance(m, dict)
+           else lambda k: getattr(m, k))  # dict geometry or ModelConfig
+    H, I, V, L = (get("hidden_size"), get("intermediate_size"),
+                  get("vocab_size"), get("num_hidden_layers"))
+    D = H // get("num_attention_heads")
+    Hq = get("num_attention_heads") * D
+    Hkv = get("num_key_value_heads") * D
+    # (in, out) shapes of the quantizable matmuls, per layer + the head
+    mats = [(H, Hq), (H, Hkv), (H, Hkv), (Hq, H),
+            (H, I), (H, I), (I, H)]
+    per_layer_mat = sum(i * o for i, o in mats)
+    per_layer_scales = sum(o for _, o in mats)
+    fp = 2  # bf16 bytes/element
+    full = (V * H + H) * fp + L * 2 * H * fp  # embed + final norm + norms
+    head = (H * V, V)
+    if weight_dtype == "int8":
+        return (full + L * (per_layer_mat + 4 * per_layer_scales)
+                + head[0] + 4 * head[1])
+    return full + fp * (L * per_layer_mat + head[0])
+
+
+def serve_fit_report(hbm_bytes: int = 16 << 30, seq: int = 4096) -> dict:
+    """The memory-headroom story int8 weights exist for: the deepest
+    (layers, micro_batch) serving point — layers of the Llama-2-7B
+    geometry, micro_batch = concurrent bf16-KV decode slots at the bench
+    seq length — that fits one chip's HBM, per weight format. ESTIMATED
+    from arithmetic (weights + per-slot KV bytes vs HBM), not measured —
+    the field the TPU A/B validates once the tunnel returns. At the full
+    32-layer depth, bf16 weights eat ~13.5 GB of a 16 GB v5e and strand
+    a single slot; int8 (~6.8 GB) serves the SAME checkpoint with ~4x
+    the decode batch — the whole point of the feature."""
+    out = {}
+    for wd in ("bf16", "int8"):
+        for layers in (32, 24, 16, 8):
+            m = dict(LLAMA2_7B_GEOM, num_hidden_layers=layers)
+            D = m["hidden_size"] // m["num_attention_heads"]
+            kv_slot = (2 * layers * seq
+                       * m["num_key_value_heads"] * D * 2)  # bf16 K+V
+            wb = weight_bytes(m, wd)
+            mb = (hbm_bytes - wb) // kv_slot
+            if mb >= 1:
+                out[wd] = {"layers": layers, "micro_batch": int(mb),
+                           "weight_bytes_total": wb}
+                break
+    return out
+
+
 def main():
     import os
 
@@ -100,16 +153,24 @@ def inner_main():
     m = cfg.model
     n_params = llama.num_params(m)
     peak = peak_flops_per_chip()
+    # the memory-headroom fields int8 weights exist for (ROADMAP item 3):
+    # the measured geometry's weight bytes in both storage formats, and
+    # the estimated deepest (layers, micro_batch) serving point per
+    # format — int8 must come in at <= 55% of bf16 (tests/test_bench.py)
+    weights = {"weight_dtype": "bf16",
+               "weight_bytes_total": weight_bytes(m, "bf16"),
+               "weight_bytes_total_int8": weight_bytes(m, "int8"),
+               "serve_fit": serve_fit_report()}
     if peak is None:
         print(json.dumps({"metric": "llama2_7b_proxy_tokens_per_sec_cpu_smoke",
                           "value": round(tok_s, 1), "unit": "tokens/s",
-                          "vs_baseline": 0.0}))
+                          "vs_baseline": 0.0, **weights}))
         return
     mfu = get_mfu(tok_s, n_params, m.num_hidden_layers, m.hidden_size,
                   cfg.training.seq_length, peak)
     print(json.dumps({"metric": BENCH_METRICS["bench_7b"],
                       "value": round(mfu, 2), "unit": "%",
-                      "vs_baseline": round(mfu / 38.0, 3)}))
+                      "vs_baseline": round(mfu / 38.0, 3), **weights}))
     print(f"# layers={m.num_hidden_layers} mbs={cfg.training.micro_batch_size} "
           f"seq={cfg.training.seq_length} flash={m.flash_layout} "
           f"tokens/s/chip={tok_s:.0f} "

@@ -193,25 +193,42 @@ def kv_bytes_per_token(engine, lengths) -> int:
     return int(round(m.num_hidden_layers * window * per_row))
 
 
+def bench_params(engine, cfg):
+    """Seed-derived weights in the engine's storage format (int8 engines
+    get the per-channel quantized tree), plus their total byte footprint
+    — the ``weight_bytes_total`` the int8 mode roughly halves."""
+    import jax
+
+    from picotron_tpu.models import llama
+
+    params = jax.jit(lambda k: llama.init_params(k, cfg.model))(
+        jax.random.PRNGKey(0))
+    if engine.quant_weights:
+        params = llama.quantize_params(params)
+    params = engine.shard_params(params)
+    return params, llama.param_bytes(params)
+
+
 def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
         steps: int, warmup: int = 8, block_len: int = 1,
         attend_impl: str = "dense", kv_layout: str = "contiguous",
-        kv_page_policy: str = "uniform", sample_on_device: bool = False):
+        kv_page_policy: str = "uniform", sample_on_device: bool = False,
+        weight_dtype: str = "bf16"):
     """Time ``steps`` decode rounds (tokens per slot). Returns
-    (tokens/s, dispatches_per_token, kv_bytes/token, engine)."""
+    (tokens/s, dispatches_per_token, kv_bytes/token, weight_bytes_total,
+    engine)."""
     import jax
     import numpy as np
 
     from picotron_tpu.inference import InferenceEngine
-    from picotron_tpu.models import llama
 
     engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
                              decode_block_len=block_len,
                              attend_impl=attend_impl, kv_layout=kv_layout,
                              kv_page_policy=kv_page_policy,
-                             sample_on_device=sample_on_device)
-    params = engine.shard_params(jax.jit(
-        lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
+                             sample_on_device=sample_on_device,
+                             weight_dtype=weight_dtype)
+    params, weight_bytes = bench_params(engine, cfg)
     cache = engine.init_cache()
     rng = np.random.default_rng(0)
     # greedy prefill epilogue (temp 0) == the host argmax it replaces
@@ -280,7 +297,8 @@ def run(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
 
     assert np.all((last >= 0) & (last < cfg.model.vocab_size))
     kv_bytes = kv_bytes_per_token(engine, cache["lengths"])
-    return slots * steps / dt, dispatches / steps, kv_bytes, engine
+    return slots * steps / dt, dispatches / steps, kv_bytes, weight_bytes, \
+        engine
 
 
 def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
@@ -288,7 +306,8 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
              spec_len: int = 4, attend_impl: str = "dense",
              kv_layout: str = "contiguous",
              kv_page_policy: str = "uniform",
-             sample_on_device: bool = False):
+             sample_on_device: bool = False,
+             weight_dtype: str = "bf16"):
     """Time ``steps`` speculative decode tokens per slot: the same
     protocol as ``run`` — prefill fills every slot OUTSIDE the timed
     window, warmup rounds absorb compilation, then the timed window runs
@@ -301,20 +320,20 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     ``run``'s normalization: with nothing accepted every round yields one
     token per slot and dpt == 1.0 (the spec-off per-token baseline);
     every accepted draft pushes it strictly below. Returns (tokens/s,
-    dispatches_per_token, accept_rate, kv_bytes/token, engine)."""
+    dispatches_per_token, accept_rate, kv_bytes/token,
+    weight_bytes_total, engine)."""
     import jax
     import numpy as np
 
     from picotron_tpu.inference import InferenceEngine, NgramDrafter
-    from picotron_tpu.models import llama
 
     engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
                              spec_len=spec_len, attend_impl=attend_impl,
                              kv_layout=kv_layout,
                              kv_page_policy=kv_page_policy,
-                             sample_on_device=sample_on_device)
-    params = engine.shard_params(jax.jit(
-        lambda k: llama.init_params(k, cfg.model))(jax.random.PRNGKey(0)))
+                             sample_on_device=sample_on_device,
+                             weight_dtype=weight_dtype)
+    params, weight_bytes = bench_params(engine, cfg)
     drafter = NgramDrafter(engine.spec_ngram)
     rng = np.random.default_rng(0)
     prompt = np.resize(rng.integers(1, cfg.model.vocab_size, 4), prompt_len)
@@ -381,7 +400,7 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     # the decode modes' one-walk-per-token accounting)
     dpt = dispatches / steps
     kv_bytes = int(round(kv_bytes_per_token(engine, cache["lengths"]) * dpt))
-    return slots * steps / dt, dpt, accept, kv_bytes, engine
+    return slots * steps / dt, dpt, accept, kv_bytes, weight_bytes, engine
 
 
 def main(argv=None) -> None:
@@ -421,6 +440,12 @@ def main(argv=None) -> None:
                          "and ship token ids, never [B, vocab] logits — "
                          "logits_bytes_to_host_per_token drops from "
                          "vocab*4 to O(B)")
+    ap.add_argument("--weight-dtype", choices=("bf16", "int8"),
+                    default="bf16",
+                    help="weight storage: bf16 (the model dtype, "
+                         "default) or per-channel int8 served through "
+                         "the fused dequant matmul — weight_bytes_total "
+                         "in the JSON drops to ~half the bf16 bytes")
     args = ap.parse_args(argv)
     if args.spec_len > 0 and args.block_len != 1:
         ap.error("--spec-len replaces blocked decode; drop --block-len")
@@ -478,19 +503,21 @@ def main(argv=None) -> None:
     accept = None
     try:
         if args.spec_len > 0:
-            tok_s, dpt, accept, kv_bytes, engine = run_spec(
+            tok_s, dpt, accept, kv_bytes, weight_bytes, engine = run_spec(
                 cfg, spec_len=args.spec_len,
                 attend_impl=args.attend_impl,
                 kv_layout=args.kv_layout,
                 kv_page_policy=args.kv_page_policy,
-                sample_on_device=args.sample_on_device, **sizes)
+                sample_on_device=args.sample_on_device,
+                weight_dtype=args.weight_dtype, **sizes)
         else:
-            tok_s, dpt, kv_bytes, engine = run(
+            tok_s, dpt, kv_bytes, weight_bytes, engine = run(
                 cfg, block_len=args.block_len,
                 attend_impl=args.attend_impl,
                 kv_layout=args.kv_layout,
                 kv_page_policy=args.kv_page_policy,
-                sample_on_device=args.sample_on_device, **sizes)
+                sample_on_device=args.sample_on_device,
+                weight_dtype=args.weight_dtype, **sizes)
     except Exception as e:  # noqa: BLE001 - the record IS the error channel
         print(json.dumps({
             "metric": BENCH_METRICS["bench_decode"], "value": None,
@@ -508,6 +535,7 @@ def main(argv=None) -> None:
           f"sample_on_device={args.sample_on_device} "
           + (f"accept_rate={accept:.3f} " if accept is not None else "")
           + f"dispatches/token={dpt:.3f} kv_bytes/token={kv_bytes} "
+          f"weight_dtype={args.weight_dtype} weight_bytes={weight_bytes} "
           f"tokens/s={tok_s:.1f}",
           file=sys.stderr)
     logit_bytes = logits_bytes_to_host_per_token(
@@ -521,6 +549,17 @@ def main(argv=None) -> None:
               "kv_page_policy": args.kv_page_policy,
               "sample_on_device": args.sample_on_device,
               "kv_bytes_per_token": kv_bytes,
+              # the weight-side bytes story: the whole tree (int8 values
+              # + scales included) and what one generated token costs in
+              # weight HBM reads — every decode step streams all weights
+              # once and emits one token per active slot, so per-token =
+              # total / slots; speculative rounds amortize by emitting
+              # ~1/dpt tokens per weight walk
+              "weight_dtype": args.weight_dtype,
+              "weight_bytes_total": weight_bytes,
+              "weight_bytes_per_token": int(round(
+                  weight_bytes * (dpt if args.spec_len > 0 else 1.0)
+                  / sizes["slots"])),
               "logits_bytes_to_host_per_token": logit_bytes,
               # the per-rung A/B referee: dispatch-latency percentiles
               # from the PR 10 histograms, so flipping ONE flag (pipeline,

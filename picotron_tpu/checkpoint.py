@@ -516,14 +516,25 @@ class CheckpointManager:
         return None
 
     def load_params(self, params_like, step: Optional[int] = None,
-                    layout: Optional[tuple] = None):
+                    layout: Optional[tuple] = None,
+                    weight_dtype: str = "bf16"):
         """Params-only restore — the inference path: no optimizer state is
         read (a serving host never allocates the 2x-param AdamW moments).
         ``layout`` is the RESTORING run's (num_hidden_layers, pp_size
         [, interleave]); an inference engine wants ``(L, 1)``, which remaps
         pp-padded or interleave-permuted stacks to the contiguous order the
         decode scan expects. Returns (params, step, trained_tokens).
-        Shares the corrupt-latest fallback with ``load``."""
+        Shares the corrupt-latest fallback with ``load``.
+
+        ``weight_dtype="int8"`` quantizes every matmul weight per output
+        channel as it comes off the restore (llama.quantize_params —
+        checkpoints always store full precision; the int8 form is a
+        SERVING format, derived at load). The returned quantized leaves
+        carry default placement — place them with ``engine.shard_params``
+        (whose pspecs mirror the quantized tree)."""
+        if weight_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"unknown weight_dtype {weight_dtype!r} (bf16|int8)")
         ocp = self._ocp
         state: dict = {}
 
@@ -542,6 +553,13 @@ class CheckpointManager:
         params = restored["params"]
         if state["remap"] is not None:
             params = _remap_tree(params, params_like, state["remap"])
+        if weight_dtype == "int8":
+            # leaf-by-leaf eager quantization off the restore: pass a
+            # SHARDED ``params_like`` (the dense pspecs — checkpoints
+            # store dense) so both the restored tree and the fp32
+            # quantization transients stay sharded; a 7B tree never
+            # concentrates on one device on its way to int8
+            params = llama.quantize_params(params)
         return params, int(meta["step"]), int(meta["trained_tokens"])
 
     def wait_until_finished(self) -> None:
@@ -656,6 +674,7 @@ def load_hf_safetensors(
     dtype: Optional[str] = None,
     interleave: int = 1,
     fsdp: bool = False,
+    weight_dtype: Optional[str] = None,
 ) -> llama.Params:
     """Build our parameter pytree from an HF-format Llama checkpoint.
 
@@ -665,11 +684,32 @@ def load_hf_safetensors(
     — the role of adjust_tensor_size + per-rank selective reads in the
     reference, checkpoint.py:150-211).
 
+    ``weight_dtype="int8"`` quantizes each matmul weight per output
+    channel AS IT STREAMS off the file (quant_matmul.quantize_weight_host
+    per 2-D layer weight, before stacking) — host peak stays near one
+    layer's fp copy plus the int8 stack, and the weights land on device
+    at ~half the bf16 bytes (embedding/norms stay full precision; scales
+    shard over 'tp' with their channels when ``topo`` is given).
+
     Memory note: the full tree is materialized in host RAM before device_put
     (fine through ~10B params on standard hosts). Multi-host bootstrap of
     larger models should read per-host slices via safetensors ``get_slice``
     against each host's addressable shards — not needed for the reference's
     model ladder (SmolLM-1.7B, Llama-2-7B)."""
+    if weight_dtype not in (None, "bf16", "int8"):
+        raise ValueError(
+            f"unknown weight_dtype {weight_dtype!r} (bf16|int8)")
+    quant = weight_dtype == "int8"
+    if quant and (interleave > 1 or (topo is not None and topo.pp_size > 1)):
+        raise ValueError(
+            "weight_dtype='int8' is a serving format: load with the "
+            "engine's contiguous pp=1 layout (pad/permuted stacks would "
+            "stack per-layer scales into pipeline layouts serving never "
+            "reads)")
+    if quant and fsdp:
+        raise ValueError(
+            "weight_dtype='int8' and fsdp are mutually exclusive "
+            "(quantized weights serve; FSDP trains)")
     dt = jnp.dtype(dtype or m.dtype)
     L = m.num_hidden_layers
     pp = topo.pp_size if topo is not None else 1
@@ -688,16 +728,34 @@ def load_hf_safetensors(
             out[pos] = per_layer[g]
         return out
 
+    from picotron_tpu.ops.pallas.quant_matmul import quantize_weight_host
+
     with _SafetensorsReader(path) as reader:
 
         def grab(tmpl: str, transpose: bool, i: Optional[int] = None) -> np.ndarray:
             t = reader.get(tmpl.format(i=i))
             return np.ascontiguousarray(t.T if transpose else t)
 
+        def grab_layers(k: str, tmpl: str, tr: bool):
+            if quant and k in llama.QUANT_WEIGHT_LEAVES:
+                # quantize each 2-D (in, out) weight as it streams off the
+                # file, then stack the int8 values and per-channel scales
+                # separately — one layer's fp copy in RAM at a time. The
+                # weight is cast to the MODEL dtype first, exactly like
+                # the dense path casts before serving: quantizing the
+                # file's own dtype (e.g. an fp16 export under a bf16
+                # config) would bake in values the fake-quant parity
+                # oracle (quantize-after-cast) can never reproduce
+                qs = [quantize_weight_host(grab(tmpl, tr, i).astype(dt))
+                      for i in range(L)]
+                return {"q": stack_layers([d["q"] for d in qs]),
+                        "s": stack_layers([d["s"] for d in qs])}
+            return stack_layers([grab(tmpl, tr, i) for i in range(L)])
+
         params: llama.Params = {
             "embed": grab(*_TOP_MAP["embed"]),
             "layers": {
-                k: stack_layers([grab(tmpl, tr, i) for i in range(L)])
+                k: grab_layers(k, tmpl, tr)
                 for k, (tmpl, tr) in _LAYER_MAP.items()
             },
             "final_norm": grab(*_TOP_MAP["final_norm"]),
@@ -709,12 +767,29 @@ def load_hf_safetensors(
             # (checkpoint.py:88-91); we untie by copying the embedding
             # transpose, which preserves the tied model's function.
             params["lm_head"] = np.ascontiguousarray(params["embed"].T)
+        if quant:
+            params["lm_head"] = quantize_weight_host(
+                params["lm_head"].astype(dt))
 
-    params = jax.tree.map(lambda x: jnp.asarray(x, dt), params)
+    def to_device(leaf):
+        # quantized pairs keep their storage dtypes (int8 values, fp32
+        # scales); full-precision leaves cast to the model dtype
+        if isinstance(leaf, dict):
+            return {k: jnp.asarray(v) for k, v in leaf.items()}
+        return jnp.asarray(leaf, dt)
+
+    params = {
+        "embed": to_device(params["embed"]),
+        "layers": {k: to_device(v) for k, v in params["layers"].items()},
+        "final_norm": to_device(params["final_norm"]),
+        "lm_head": to_device(params["lm_head"]),
+    }
     if topo is not None:
         params = jax.tree.map(
             jax.device_put, params,
-            named_shardings(topo, llama.param_pspecs(m, fsdp=fsdp)))
+            named_shardings(topo, llama.param_pspecs(
+                m, fsdp=fsdp,
+                weight_dtype="int8" if quant else "bf16")))
     return params
 
 
@@ -775,6 +850,14 @@ def save_hf_safetensors(params: llama.Params, path: str, layout) -> None:
     silently export layer-scrambled weights (round-3 ADVICE)."""
     from safetensors.numpy import save_file
 
+    from picotron_tpu.ops.pallas.quant_matmul import is_quant_weight
+
+    if is_quant_weight(params.get("lm_head")) or any(
+            is_quant_weight(v) for v in params.get("layers", {}).values()):
+        raise ValueError(
+            "int8-quantized params cannot be exported to HF safetensors "
+            "(quantization is a lossy serving format); export from the "
+            "full-precision source checkpoint instead")
     if hasattr(layout, "distributed"):  # a Config
         L = layout.model.num_hidden_layers
         pp_size = layout.distributed.pp_size

@@ -346,6 +346,19 @@ class InferenceConfig:
     # classic per-token loop (one dispatch per token). Also bounds admission
     # latency: the batcher admits/retires only at block boundaries.
     decode_block_len: int = 8
+    # Weight storage format for serving: "bf16" (the model's param dtype,
+    # the bit-pinned default — every existing smoke is unchanged) or
+    # "int8" = per-output-channel absmax quantization of every matmul
+    # weight (wq/wk/wv/wo, w_gate/w_up/w_down, lm_head; embeddings and
+    # norms stay full precision), applied at load
+    # (checkpoint.load_params / load_hf_safetensors) so a 7B-class
+    # checkpoint's weights land on device at ~half the bf16 bytes.
+    # Matmuls consume the int8 storage directly through the fused
+    # dequant kernel (ops/pallas/quant_matmul.py) — no dequantized
+    # weight copy ever exists; scales shard over 'tp' with their output
+    # channels. Generations are allclose to bf16 (and pinned exactly
+    # against the fake-quant reference — tests/test_quant_weights.py).
+    weight_dtype: str = "bf16"
     # KV cache storage dtype: "auto" = the model's param dtype; "int8" =
     # per-row per-kv-head absmax-quantized storage with fp32 scales
     # (kv_cache.quantize_kv) — ~2x the slots or context at the same HBM
@@ -817,6 +830,12 @@ class Config:
             raise ValueError("inference.decode_block_len must be >= 1")
         if inf.prefill_chunk < 1:
             raise ValueError("inference.prefill_chunk must be >= 1")
+        if inf.weight_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"unknown inference.weight_dtype {inf.weight_dtype!r} "
+                "(bf16|int8) — set 'int8' for per-channel quantized "
+                "weights served through the fused dequant matmul, or "
+                "keep the 'bf16' full-precision default")
         if inf.kv_cache_dtype not in ("auto", "int8"):
             raise ValueError(
                 f"unknown inference.kv_cache_dtype {inf.kv_cache_dtype!r} "

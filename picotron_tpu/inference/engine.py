@@ -118,6 +118,7 @@ class InferenceEngine:
                  kv_num_pages: Optional[int] = None,
                  kv_page_policy: Optional[str] = None,
                  sample_on_device: Optional[bool] = None,
+                 weight_dtype: Optional[str] = None,
                  hooks=None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
@@ -179,6 +180,20 @@ class InferenceEngine:
         if sample_on_device is not None:
             inf.sample_on_device = bool(sample_on_device)
         self.sample_on_device = inf.sample_on_device
+        # Weight storage format (inference.weight_dtype): "bf16" keeps the
+        # dense params tree; "int8" expects the per-channel quantized tree
+        # (checkpoint.load_* with weight_dtype="int8", or
+        # llama.quantize_params) — every matmul site dispatches on the
+        # LEAF form at trace time (models/llama.py::matmul), so the only
+        # engine-side difference is the pspec tree shard_params places
+        # against (scales shard over 'tp' with their channels).
+        if weight_dtype is not None:
+            if weight_dtype not in ("bf16", "int8"):
+                raise ValueError(
+                    f"unknown weight_dtype {weight_dtype!r} (bf16|int8)")
+            inf.weight_dtype = weight_dtype
+        self.weight_dtype = inf.weight_dtype
+        self.quant_weights = self.weight_dtype == "int8"
         # Telemetry (picotron_tpu/obs, docs/OBSERVABILITY.md): every
         # engine owns a fresh metrics registry (counters start at zero
         # per server) and shares the process span ring. The batcher and
@@ -266,7 +281,7 @@ class InferenceEngine:
         self._cos, self._sin = precompute_rope(
             self.max_seq_len, m.head_dim, m.rope_theta, self._dt)
 
-        self._pspecs = llama.param_pspecs(m)
+        self._pspecs = llama.param_pspecs(m, weight_dtype=self.weight_dtype)
         if self.paged is not None:
             self._cspecs = paged_kv.cache_pspecs(self.quantized,
                                                  policy=self.page_policy)

@@ -186,6 +186,83 @@ def init_params(key, m: ModelConfig, pp_size: int = 1,
     }
 
 
+# The matmul weights eligible for per-channel int8 quantization
+# (inference.weight_dtype: "int8"): the seven decoder-layer projections
+# plus the LM head ("lm_head" at the tree top). Embedding and norms stay
+# full precision — they are tiny next to the stack and their error
+# characteristics differ (the embedding is a gather, not a matmul).
+QUANT_WEIGHT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def matmul(x, w):
+    """``x @ w`` dispatching on the weight leaf's form: a plain array runs
+    the dense matmul; a quantized ``{"q": int8, "s": fp32}`` pair (see
+    ops/pallas/quant_matmul.py) runs the fused dequant matmul — the
+    Pallas kernel on TPU, the XLA int8-einsum fallback elsewhere. A
+    trace-time Python branch, exactly like the attend_impl dispatch: each
+    leaf form traces its own program, no runtime cost. Output dtype
+    follows ``x`` on the quantized path (the dense path's promotion rule
+    for same-dtype operands)."""
+    from picotron_tpu.ops.pallas.quant_matmul import (
+        is_quant_weight,
+        quant_matmul,
+    )
+
+    if is_quant_weight(w):
+        return quant_matmul(x, w["q"], w["s"])
+    return x @ w
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize every eligible matmul weight (QUANT_WEIGHT_LEAVES +
+    lm_head) to per-output-channel int8 pairs; embedding/norms pass
+    through untouched. The stacked layer axis rides along (scales come
+    out [L, out] — one scale vector per layer per leaf). The in-memory
+    counterpart of checkpoint.load_* with ``weight_dtype="int8"`` (used
+    by the random-init serving path and tests).
+
+    Deliberately EAGER, leaf by leaf — op-by-op dispatch keeps scales
+    bit-identical across every quantization path (this, the host numpy
+    streamer, a restored sharded tree; a jitted variant drifts a ulp
+    when XLA rewrites the /127), transients are bounded to one leaf's
+    fp32 copy (sharded when the leaf is — restore against sharded
+    ShapeDtypeStructs so a 7B tree never concentrates on one device),
+    and each dense leaf frees as soon as the caller drops its tree."""
+    from picotron_tpu.ops.pallas.quant_matmul import quantize_weight
+
+    layers = {k: (quantize_weight(v) if k in QUANT_WEIGHT_LEAVES else v)
+              for k, v in params["layers"].items()}
+    return {**params, "layers": layers,
+            "lm_head": quantize_weight(params["lm_head"])}
+
+
+def dequantize_params(params: Params, dtype) -> Params:
+    """The fake-quant reference tree: every quantized leaf dequantized
+    back to ``dtype``. TESTS ONLY — a dense engine fed this tree is the
+    oracle the int8 engine's generations are pinned against (the
+    quantization error is in both; only the fused-matmul plumbing
+    differs)."""
+    from picotron_tpu.ops.pallas.quant_matmul import (
+        dequantize_weight,
+        is_quant_weight,
+    )
+
+    def deq(leaf):
+        if is_quant_weight(leaf):
+            return dequantize_weight(leaf["q"], leaf["s"], dtype)
+        return leaf
+
+    layers = {k: deq(v) for k, v in params["layers"].items()}
+    return {**params, "layers": layers, "lm_head": deq(params["lm_head"])}
+
+
+def param_bytes(params: Params) -> int:
+    """Total bytes the parameter tree occupies (int8 values + fp32
+    scales included) — the ``weight_bytes_total`` metric the int8 mode
+    roughly halves (kv_cache.cache_bytes' weight-side twin)."""
+    return sum(a.size * a.dtype.itemsize for a in jax.tree.leaves(params))
+
+
 # FSDP: the axis (AFTER the scan slices off the leading layer-stack axis)
 # each layer param rests dp-sharded on and is all-gathered over just in
 # time inside decoder_layer. Every entry is an H-sized axis, so the single
@@ -196,7 +273,8 @@ FSDP_GATHER_AXIS = {
 }
 
 
-def param_pspecs(_: ModelConfig, fsdp: bool = False) -> Params:
+def param_pspecs(_: ModelConfig, fsdp: bool = False,
+                 weight_dtype: str = "bf16") -> Params:
     """PartitionSpecs: layer stack sharded over 'pp' (contiguous stage slices,
     the rule at reference pipeline_parallel.py:33-36), column-parallel weights
     shard out-features over 'tp', row-parallel shard in-features, embedding is
@@ -204,7 +282,13 @@ def param_pspecs(_: ModelConfig, fsdp: bool = False) -> Params:
     lm_head are replicated across 'pp' stages. Everything replicated over
     'dp' and 'cp' — except with ``fsdp``, where each LAYER param additionally
     rests dp-sharded on its H-sized axis (FSDP_GATHER_AXIS) and is gathered
-    just in time in decoder_layer."""
+    just in time in decoder_layer.
+
+    ``weight_dtype="int8"`` mirrors the quantized tree's shape: every
+    eligible matmul leaf becomes a ``{"q", "s"}`` pair whose int8 values
+    keep the dense spec and whose per-output-channel scales drop the
+    contraction axis — scales shard WITH their channels (a tp-sharded
+    column split carries its own channels' scales, replicated nowhere)."""
     layers = {
         "attn_norm": P("pp", None),
         "wq": P("pp", None, "tp"),
@@ -217,17 +301,35 @@ def param_pspecs(_: ModelConfig, fsdp: bool = False) -> Params:
         "w_down": P("pp", "tp", None),
     }
     if fsdp:
+        if weight_dtype == "int8":
+            # FSDP is a training rewrite; quantized weights are a serving
+            # format (inference_config turns fsdp off) — reject the combo
+            # rather than invent gather semantics for scale leaves
+            raise ValueError(
+                "fsdp and int8 weight quantization are mutually exclusive "
+                "(quantized weights serve; FSDP trains)")
         for name, ax in FSDP_GATHER_AXIS.items():
             spec = list(layers[name])
             assert spec[ax + 1] is None, (name, spec)  # +1: stack axis
             spec[ax + 1] = "dp"
             layers[name] = P(*spec)
-    return {
+    specs = {
         "embed": P("tp", None),
         "layers": layers,
         "final_norm": P(),
         "lm_head": P(None, "tp"),
     }
+    if weight_dtype == "int8":
+        def qspec(spec):
+            t = tuple(spec)
+            return {"q": spec, "s": P(*t[:-2], t[-1])}
+
+        specs["layers"] = {
+            k: (qspec(v) if k in QUANT_WEIGHT_LEAVES else v)
+            for k, v in layers.items()
+        }
+        specs["lm_head"] = qspec(specs["lm_head"])
+    return specs
 
 
 # --------------------------------------------------------------------------- #
@@ -370,9 +472,9 @@ def decoder_layer(lp, h, cos, sin, cfg: Config, cache=None, pos=None,
     # tagged residual in pinned host memory — layers_forward docstring)
     x = _ckpt_name(enter(_norm(h, lp["attn_norm"], cfg)), "attn_in")
     B, S, _ = x.shape
-    q = (x @ lp["wq"]).reshape(B, S, nh, D)
-    k = (x @ lp["wk"]).reshape(B, S, nkv, D)
-    v = _ckpt_name((x @ lp["wv"]).reshape(B, S, nkv, D), "v_proj")
+    q = matmul(x, lp["wq"]).reshape(B, S, nh, D)
+    k = matmul(x, lp["wk"]).reshape(B, S, nkv, D)
+    v = _ckpt_name(matmul(x, lp["wv"]).reshape(B, S, nkv, D), "v_proj")
     q = _ckpt_name(apply_rope(q, cos, sin), "q_rope")
     k = _ckpt_name(apply_rope(k, cos, sin), "k_rope")
 
@@ -401,14 +503,14 @@ def decoder_layer(lp, h, cos, sin, cfg: Config, cache=None, pos=None,
             v = jnp.repeat(v, nh // nkv, axis=2)
         o = _attention(q, k, v, cfg)
     o = o.reshape(B, S, nh * D)
-    h = h + leave(o @ lp["wo"])
+    h = h + leave(matmul(o, lp["wo"]))
 
     # MLP sub-block: column(gate,up) -> SwiGLU -> row(down)  (model.py:163-185)
     x = _ckpt_name(enter(_norm(h, lp["mlp_norm"], cfg)), "mlp_in")
-    g = _ckpt_name(x @ lp["w_gate"], "mlp_gate")
-    u = _ckpt_name(x @ lp["w_up"], "mlp_up")
+    g = _ckpt_name(matmul(x, lp["w_gate"]), "mlp_gate")
+    u = _ckpt_name(matmul(x, lp["w_up"]), "mlp_up")
     y = _ckpt_name(jax.nn.silu(g) * u, "mlp_act")
-    out = h + leave(y @ lp["w_down"])
+    out = h + leave(matmul(y, lp["w_down"]))
     if new_cache is not None:
         return out, new_cache
     return (out, kv_compact) if return_kv else out
@@ -507,8 +609,10 @@ def _head_input(params, h, cfg: Config):
 
 def head_logits(params, h, cfg: Config):
     """Final norm + untied LM head (the reference always creates a fresh
-    untied head, checkpoint.py:88-91); logits stay vocab-sharded."""
-    return _head_input(params, h, cfg) @ params["lm_head"]
+    untied head, checkpoint.py:88-91); logits stay vocab-sharded. The
+    head matmul dispatches on the leaf form, so an int8-quantized head
+    serves through the same fused dequant matmul as the layer stack."""
+    return matmul(_head_input(params, h, cfg), params["lm_head"])
 
 
 def loss_from_hidden(params, h, targets, cfg: Config):

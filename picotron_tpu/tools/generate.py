@@ -42,33 +42,50 @@ SMOKE_CONFIG = {
 
 
 def _load_weights(args, cfg, engine):
-    """Resolve --load-path / --hf-path / --random-init to sharded params."""
+    """Resolve --load-path / --hf-path / --random-init to sharded params.
+    An ``engine`` built with ``weight_dtype="int8"`` gets the per-channel
+    quantized tree: the HF path quantizes as it streams off the file,
+    the orbax path quantizes off the restore, the random-init path
+    quantizes the fresh tree — all three land as the same
+    ``{"q", "s"}``-leaf form the engine's matmul sites dispatch on."""
     import jax
 
     from picotron_tpu import checkpoint as ckpt
     from picotron_tpu.models import llama
     from picotron_tpu.topology import named_shardings
 
+    quant = getattr(engine, "quant_weights", False)
+    wdt = "int8" if quant else "bf16"
     if args.hf_path:
-        return ckpt.load_hf_safetensors(args.hf_path, cfg.model, engine.topo)
+        return ckpt.load_hf_safetensors(args.hf_path, cfg.model, engine.topo,
+                                        weight_dtype=wdt)
     if args.load_path:
+        # the restore is SHARDED for both weight formats (checkpoints
+        # store dense, so the dense pspecs describe what orbax reads);
+        # the int8 path then quantizes leaf by leaf on the sharded tree
+        # — sharding, not donation, is what keeps a big model's dense
+        # tree and fp32 quantization transients off any single device
+        # (llama.quantize_params explains why donation is rejected)
         like = jax.eval_shape(partial(llama.init_params, m=cfg.model),
                               jax.random.PRNGKey(0))
         shardings = named_shardings(engine.topo,
                                     llama.param_pspecs(cfg.model))
         like = jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
             like, shardings)
         mgr = ckpt.CheckpointManager(
             args.load_path, mirror_dir=cfg.resilience.ckpt_mirror_dir)
         params, step, tokens = mgr.load_params(
-            like, layout=(cfg.model.num_hidden_layers, 1))
+            like, layout=(cfg.model.num_hidden_layers, 1), weight_dtype=wdt)
         mgr.close()
         print(f"loaded step {step} ({tokens} trained tokens) "
               f"from {args.load_path}")
-        return params
+        return engine.shard_params(params) if quant else params
     params = jax.jit(lambda k: llama.init_params(k, cfg.model))(
         jax.random.PRNGKey(args.seed))
+    if quant:
+        params = llama.quantize_params(params)
     return engine.shard_params(params)
 
 
@@ -143,6 +160,19 @@ def main(argv=None) -> int:
                          "default: config inference.kv_page_policy) — "
                          "hot_bf16 reads radix-shared prefix pages at "
                          "full precision, exclusive tails as int8")
+    ap.add_argument("--weight-dtype", choices=["bf16", "int8"],
+                    default=None,
+                    help="weight storage (default: config "
+                         "inference.weight_dtype) — int8 = per-channel "
+                         "quantized matmul weights served through the "
+                         "fused dequant matmul, ~half the bf16 bytes")
+    ap.add_argument("--check-weight-parity", action="store_true",
+                    help="run the batch again on a bf16 engine fed the "
+                         "FAKE-QUANT reference (dequantized int8 weights "
+                         "through the dense matmul) and fail unless every "
+                         "request's tokens match — the `make quant-smoke` "
+                         "gate proving the fused int8 pipeline implements "
+                         "fake-quant semantics exactly")
     ap.add_argument("--sample-on-device", action="store_true",
                     help="fused sampling epilogue: prefill/decode "
                          "dispatches sample inside the jitted program "
@@ -191,6 +221,19 @@ def main(argv=None) -> int:
         cfg.inference.kv_page_policy = args.kv_page_policy
     if args.sample_on_device:
         cfg.inference.sample_on_device = True
+    if args.weight_dtype is not None:
+        cfg.inference.weight_dtype = args.weight_dtype
+    if args.check_weight_parity and cfg.inference.weight_dtype != "int8":
+        ap.error("--check-weight-parity compares int8 against the "
+                 "fake-quant reference; pass --weight-dtype int8")
+    if args.check_weight_parity and args.temperature != 0.0:
+        # the gate's contract is token IDENTITY, which only greedy decode
+        # guarantees: the fused and dense matmuls agree to allclose, not
+        # bitwise, so a seeded categorical draw can flip at a near-tie —
+        # same exactness rule as --check-layout-parity's hot_bf16 guard
+        ap.error("--check-weight-parity is a greedy-only gate (fused vs "
+                 "dense logits are allclose, not bit-equal; sampling can "
+                 "flip at near-ties); drop --temperature")
     if args.check_layout_parity and cfg.inference.kv_page_policy != "uniform":
         # checked on the EFFECTIVE config (flag or config file): mixed
         # pages quantize cold tails, so contiguous-vs-paged would be
@@ -212,6 +255,39 @@ def main(argv=None) -> int:
     batcher = ContinuousBatcher(engine, params, seed=args.seed)
     results = batcher.run(requests)
     gen_s = time.perf_counter() - t0
+
+    if args.check_weight_parity:
+        # same batch, same seed, a bf16 engine fed the FAKE-QUANT
+        # reference (quantize -> dequantize through the dense matmul):
+        # every request's tokens must match exactly. The quantization
+        # error is identical on both sides, so any difference is the
+        # fused int8 pipeline itself (kernel/fallback, scale sharding,
+        # dispatch wiring) — the weight-side counterpart of
+        # --check-layout-parity's equivalence gate.
+        import jax.numpy as jnp
+
+        from picotron_tpu.models import llama
+
+        eng2 = InferenceEngine(cfg, slots=args.slots,
+                               max_seq_len=args.max_seq_len,
+                               decode_block_len=args.decode_block_len,
+                               prefill_chunk=args.prefill_chunk,
+                               spec_len=args.spec_len,
+                               spec_ngram=args.spec_ngram,
+                               weight_dtype="bf16")
+        dense = _load_weights(args, cfg, eng2)
+        fakeq = llama.dequantize_params(llama.quantize_params(dense),
+                                        jnp.dtype(cfg.model.dtype))
+        results2 = ContinuousBatcher(
+            eng2, eng2.shard_params(fakeq), seed=args.seed,
+        ).run(_build_requests(args, tokenizer))
+        bad = [u for u in results if results[u].tokens != results2[u].tokens]
+        if bad:
+            print(f"FAILED: weight parity mismatch (int8 vs fake-quant "
+                  f"bf16) for {bad}", file=sys.stderr)
+            return 1
+        print(f"weight parity: int8 == fake-quant reference for "
+              f"{len(results)} requests")
 
     if args.check_layout_parity:
         # same batch, same seed/weights, the OTHER cache layout: every
@@ -267,6 +343,7 @@ def main(argv=None) -> int:
           f"setup {setup_s:.1f}s, slots={engine.slots}, "
           f"tp={engine.topo.tp_size}, block={engine.decode_block_len}, "
           f"kv={'int8' if engine.quantized else str(engine.cache_dtype)}, "
+          f"weights={engine.weight_dtype}, "
           f"{spec}{batcher.decode_dispatches} decode dispatches = "
           f"{dpt:.3f}/token)")
     if failed:

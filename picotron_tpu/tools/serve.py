@@ -152,6 +152,18 @@ class FrontEnd:
         self._waiters: dict = {}
         self._batcher = ContinuousBatcher(engine, params, seed=seed,
                                           on_token=self._on_token)
+        # model-memory gauge: the router's /metrics scrape (tools/
+        # router.py) can see per-replica resident weight bytes — int8
+        # values + per-channel scales included, so a quantized replica
+        # reports ~half its bf16 twin (docs/INFERENCE.md "Quantized
+        # weights"); set once: weights never change size mid-serve
+        from picotron_tpu.models import llama
+
+        self.weight_bytes = llama.param_bytes(params)
+        self.obs.registry.gauge(
+            "picotron_weight_bytes",
+            "model weight bytes resident on this replica").set(
+                float(self.weight_bytes))
         self.draining = False
         self.stopped = threading.Event()  # dispatch loop has exited
         self.dead = False  # loop died on an exception (vs clean drain)
@@ -459,6 +471,8 @@ class FrontEnd:
             d = {"snapshot": "partial (dispatch in progress)"}
         with self._rej_mu:
             d["rejected"] = dict(self.rejections)
+        d["weight_bytes"] = self.weight_bytes
+        d["weight_dtype"] = self.engine.weight_dtype
         d["draining"] = self.draining
         d["dead"] = self.dead
         d["stalled"] = self.stalled

@@ -254,6 +254,39 @@ def test_j003_negative_read_before_the_loop(tmp_path):
     assert found == []
 
 
+def test_j003_quant_matmul_shaped_contraction_walk(tmp_path):
+    """The quant_matmul kernel pattern (ISSUE 13): a fori_loop contraction
+    walk slicing refs with pl.ds. Using program_id to compute the slice
+    start INSIDE the body is the hazard variant — J003 must catch it —
+    while the shipped shape (ids unused, ds offsets from the loop index
+    alone) stays silent."""
+    found = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, q_ref, o_ref):
+            def body(j, acc):
+                n = pl.program_id(1)  # the trap: resolve OUTSIDE the loop
+                wb = q_ref[pl.ds(j * 8, 8), pl.ds(n * 8, 8)]
+                return acc + x_ref[:, pl.ds(j * 8, 8)] @ wb
+            o_ref[:] = lax.fori_loop(0, 4, body, 0.0)
+        """)
+    assert _rules(found) == ["PICO-J003"]
+
+    clean = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, q_ref, s_ref, o_ref):
+            def body(j, acc):
+                wb = q_ref[pl.ds(j * 8, 8), :].astype(x_ref.dtype)
+                return acc + x_ref[:, pl.ds(j * 8, 8)] @ wb
+            acc = lax.fori_loop(0, 4, body, 0.0)
+            o_ref[:] = acc * s_ref[0, :]
+        """, name="fix_clean.py")
+    assert clean == []
+
+
 def test_j003_lambda_body(tmp_path):
     found = _scan(tmp_path, """
         from jax import lax

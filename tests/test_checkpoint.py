@@ -348,6 +348,106 @@ def test_hf_import_sharded_and_tied(tiny_model_kwargs, tmp_path):
         np.asarray(loaded["layers"]["wq"]), np.asarray(params["layers"]["wq"]))
 
 
+def test_hf_int8_load_matches_bf16_within_scale(tiny_model_kwargs, tmp_path):
+    """HF safetensors -> ``load_hf_safetensors(weight_dtype="int8")``:
+    every matmul weight lands as a per-channel (int8, scales) pair whose
+    dequantization matches the full-precision load within half a
+    quantization step per channel; the streamed quantization is
+    bit-identical to quantizing the loaded tree; embeddings/norms are
+    untouched; TP sharding places scales with their channels."""
+    from picotron_tpu.ops.pallas import quant_matmul as qm
+
+    cfg = make_config(tiny_model_kwargs, tp=2)
+    params = llama.init_params(jax.random.PRNGKey(3), cfg.model)
+    sft = str(tmp_path / "model.safetensors")
+    ckpt.save_hf_safetensors(params, sft, cfg)
+
+    topo = topology_from_config(cfg)
+    dense = ckpt.load_hf_safetensors(sft, cfg.model, topo)
+    quant = ckpt.load_hf_safetensors(sft, cfg.model, topo,
+                                     weight_dtype="int8")
+    # streamed per-layer quantization == quantizing the whole loaded tree
+    want = llama.quantize_params(dense)
+    for k in llama.QUANT_WEIGHT_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(quant["layers"][k]["q"]),
+            np.asarray(want["layers"][k]["q"]))
+        np.testing.assert_array_equal(
+            np.asarray(quant["layers"][k]["s"]),
+            np.asarray(want["layers"][k]["s"]))
+        # dequant sits inside the per-channel absmax grid of the source
+        deq = np.asarray(qm.dequantize_weight(
+            quant["layers"][k]["q"], quant["layers"][k]["s"]))
+        src = np.asarray(dense["layers"][k], np.float32)
+        step = np.asarray(quant["layers"][k]["s"])
+        assert np.all(np.abs(deq - src) <= step[:, None, :] / 2 + 1e-8), k
+    np.testing.assert_array_equal(np.asarray(quant["embed"]),
+                                  np.asarray(dense["embed"]))
+    # scales shard over tp with their output channels (wq: column split)
+    s = quant["layers"]["wq"]["s"]
+    assert s.sharding.shard_shape(s.shape)[-1] == s.shape[-1] // 2
+
+    # quantized params cannot round-trip back to HF (lossy serving format)
+    with pytest.raises(ValueError, match="cannot be exported"):
+        ckpt.save_hf_safetensors(quant, str(tmp_path / "no.safetensors"),
+                                 cfg)
+
+
+def test_hf_int8_quantizes_after_model_dtype_cast(tiny_model_kwargs,
+                                                  tmp_path):
+    """A file whose storage dtype differs from the model dtype (fp32
+    export served under a bf16 config) must quantize the CAST weights —
+    exactly what the dense path serves and what the fake-quant parity
+    oracle (quantize-after-cast) reproduces — not the file's raw
+    values."""
+    cfg32 = make_config(tiny_model_kwargs)
+    params = llama.init_params(jax.random.PRNGKey(9), cfg32.model)
+    sft = str(tmp_path / "fp32.safetensors")
+    ckpt.save_hf_safetensors(params, sft, cfg32)
+
+    cfg16 = make_config(dict(tiny_model_kwargs, dtype="bfloat16"))
+    dense = ckpt.load_hf_safetensors(sft, cfg16.model)  # casts to bf16
+    quant = ckpt.load_hf_safetensors(sft, cfg16.model, weight_dtype="int8")
+    want = llama.quantize_params(dense)
+    for k in llama.QUANT_WEIGHT_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(quant["layers"][k]["q"]),
+            np.asarray(want["layers"][k]["q"]), err_msg=k)
+        np.testing.assert_array_equal(
+            np.asarray(quant["layers"][k]["s"]),
+            np.asarray(want["layers"][k]["s"]), err_msg=k)
+
+
+def test_load_params_int8_with_layout_remap(tiny_model_kwargs, tmp_path):
+    """Orbax params-only restore with ``weight_dtype="int8"``: an
+    uneven-pp-trained stack remaps to the contiguous pp=1 layout FIRST,
+    then quantizes — the served tree equals quantizing a full-precision
+    load, layer for layer (pad rows vanish before any scale exists)."""
+    model = dict(tiny_model_kwargs, num_hidden_layers=5)
+    cfg = make_config(model, pp=2, acc=2, mbs=2)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg.model, pp_size=2)
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, params, {"dummy": jax.numpy.zeros(())}, trained_tokens=3,
+             layout=(5, 2))
+    mgr.wait_until_finished()
+
+    like = jax.eval_shape(
+        lambda k: llama.init_params(k, cfg.model), jax.random.PRNGKey(0))
+    dense, step, _ = mgr.load_params(like, layout=(5, 1))
+    quant, step_q, _ = mgr.load_params(like, layout=(5, 1),
+                                       weight_dtype="int8")
+    assert (step, step_q) == (1, 1)
+    want = llama.quantize_params(dense)
+    np.testing.assert_array_equal(np.asarray(quant["layers"]["wq"]["q"]),
+                                  np.asarray(want["layers"]["wq"]["q"]))
+    np.testing.assert_array_equal(np.asarray(quant["layers"]["wq"]["s"]),
+                                  np.asarray(want["layers"]["wq"]["s"]))
+    assert quant["layers"]["wq"]["q"].shape[0] == 5  # contiguous stack
+    with pytest.raises(ValueError, match="weight_dtype"):
+        mgr.load_params(like, weight_dtype="fp8")
+    mgr.close()
+
+
 def test_model_config_from_hf(tmp_path):
     import json
 

@@ -421,6 +421,12 @@ def test_serve_metrics_tracez_profilez(tmp_path):
         assert prom['picotron_requests_total{state="completed"}'] == \
             stats["completed"]
         assert prom['picotron_rejections_total{reason="queue_full"}'] == 0
+        # the model-memory gauge (ISSUE 13): /statz and /metrics agree on
+        # resident weight bytes — what the router's scrape reads to see
+        # per-replica model memory (int8 replicas report ~half bf16)
+        assert stats["weight_bytes"] == srv.front.weight_bytes > 0
+        assert stats["weight_dtype"] == "bf16"
+        assert prom["picotron_weight_bytes"] == stats["weight_bytes"]
         # /tracez: the request's chain is COMPLETE (queue wait ->
         # prefill -> >= 1 dispatch -> delivery), all parented
         tst, trace = serve._get(port, "/tracez")
