@@ -59,11 +59,17 @@ decode-smoke:
 # Speculative-decoding smoke: draft-verify generation (prompt-lookup
 # drafter, one verify dispatch per accepted run) through the CLI, then
 # the spec bench on repetitive prompts — dispatches-per-token under the
-# spec-off baseline of 1 with a nonzero accept rate in the JSON line.
+# spec-off baseline of 1 with a nonzero accept rate in the JSON line —
+# and the CONTROLLER run: a mixed repetitive/random-prompt workload
+# through the real batcher with inference.spec_controller enabled, so
+# spec_len_effective / accept_rate_by_drafter / controller-decision
+# counts land in the JSON trajectory (docs/INFERENCE.md "Self-tuning
+# speculation").
 spec-smoke:
 	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
 	  --spec-len 4
 	JAX_PLATFORMS=cpu python bench_decode.py --spec-len 4
+	JAX_PLATFORMS=cpu python bench_decode.py --spec-len 4 --spec-auto
 
 # Flash-decode kernel parity (ops/pallas/decode_attention.py) in Pallas
 # interpret mode on CPU: flash vs dense allclose across S=1 decode,

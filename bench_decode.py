@@ -307,7 +307,7 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
              kv_layout: str = "contiguous",
              kv_page_policy: str = "uniform",
              sample_on_device: bool = False,
-             weight_dtype: str = "bf16"):
+             weight_dtype: str = "bf16", drafter: str = "ngram"):
     """Time ``steps`` speculative decode tokens per slot: the same
     protocol as ``run`` — prefill fills every slot OUTSIDE the timed
     window, warmup rounds absorb compilation, then the timed window runs
@@ -323,18 +323,22 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     dispatches_per_token, accept_rate, kv_bytes/token,
     weight_bytes_total, engine)."""
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
-    from picotron_tpu.inference import InferenceEngine, NgramDrafter
+    from picotron_tpu.inference import (
+        InferenceEngine,
+        LearnedDrafter,
+        NgramDrafter,
+    )
 
     engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
                              spec_len=spec_len, attend_impl=attend_impl,
                              kv_layout=kv_layout,
                              kv_page_policy=kv_page_policy,
                              sample_on_device=sample_on_device,
-                             weight_dtype=weight_dtype)
+                             weight_dtype=weight_dtype, drafter=drafter)
     params, weight_bytes = bench_params(engine, cfg)
-    drafter = NgramDrafter(engine.spec_ngram)
     rng = np.random.default_rng(0)
     prompt = np.resize(rng.integers(1, cfg.model.vocab_size, 4), prompt_len)
     assert (prompt_len + 1 + warmup_rounds * (spec_len + 1) + steps
@@ -342,17 +346,25 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
 
     cache = engine.init_cache()
     toks = np.zeros(slots, np.int32)
+    learned = engine.return_hidden  # drafter == "learned"
+    hidden = (jnp.zeros((slots, cfg.model.hidden_size),
+                        jnp.dtype(cfg.model.dtype)) if learned else None)
     # greedy prefill epilogue (temp 0) == the host argmax it replaces
     pf_sample = ((jax.random.PRNGKey(1), 0.0, 0, 1.0)
                  if sample_on_device else None)
     hist = []
     for s in range(slots):
-        kv, logits = engine.prefill(params, prompt, sample=pf_sample)
+        out = engine.prefill(params, prompt, sample=pf_sample)
+        kv, logits = out[:2]
+        if learned:
+            hidden = hidden.at[s].set(jnp.asarray(out[2])[0])
         cache = engine.insert(cache, kv, s, prompt_len)
         # epilogue engines return the greedy token id directly
         toks[s] = (np.asarray(logits).reshape(-1)[0] if sample_on_device
                    else np.argmax(np.asarray(logits)[0]))
         hist.append(list(prompt) + [int(toks[s])])
+    proposer = (LearnedDrafter(engine, params) if learned
+                else NgramDrafter(engine.spec_ngram))
 
     eos = np.full(slots, -1, np.int32)  # bench streams never stop early
     temp = np.zeros(slots, np.float32)
@@ -363,17 +375,29 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     stats = np.zeros(2, np.int64)  # proposed, accepted
 
     def spec_round(cache, key, budget):
+        nonlocal hidden
+        import jax.numpy as jnp
+
         tokens = np.zeros((slots, spec_len + 1), np.int32)
         active = budget > 0
+        if learned:
+            td = time.perf_counter()
+            batch = proposer.propose_batch(toks, hidden, spec_len)
+            engine.observe_dispatch("draft", time.perf_counter() - td)
         for s in np.flatnonzero(active):
             tokens[s, 0] = toks[s]
-            tokens[s, 1:] = drafter.propose(hist[s], spec_len)
+            tokens[s, 1:] = (batch[s] if learned
+                             else proposer.propose(hist[s], spec_len))
         key, sub = jax.random.split(key)
         td = time.perf_counter()
-        cache, emitted, counts, accepted = engine.verify(
+        out = engine.verify(
             params, cache, tokens, sub, eos, budget, temp, top_k, top_p)
+        cache, emitted, counts, accepted = out[:4]
         emitted = np.asarray(emitted)  # ONE host sync per dispatch
         counts = np.asarray(counts)
+        if learned:
+            hidden = jnp.where(jnp.asarray(counts > 0)[:, None], out[4],
+                               hidden)
         engine.observe_dispatch("verify", time.perf_counter() - td)
         for s in np.flatnonzero(counts):
             hist[s].extend(int(t) for t in emitted[s, : counts[s]])
@@ -403,6 +427,135 @@ def run_spec(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
     return slots * steps / dt, dpt, accept, kv_bytes, weight_bytes, engine
 
 
+def run_spec_auto(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
+                  steps: int, spec_len: int = 4, drafter: str = "ngram",
+                  attend_impl: str = "dense",
+                  kv_layout: str = "contiguous",
+                  kv_page_policy: str = "uniform",
+                  sample_on_device: bool = False,
+                  weight_dtype: str = "bf16"):
+    """The CONTROLLER run: a mixed repetitive/random workload through the
+    real ContinuousBatcher with ``inference.spec_controller`` enabled.
+    Half the requests carry the repetitive prompt ``run_spec`` uses (the
+    regime speculation serves — their slots should converge to
+    spec_len > 0 and per-request dispatches/token < 1), half carry
+    RANDOM prompts (hard traffic — their slots should converge to
+    spec_len == 0, speculation out of the way). Greedy, so output is
+    bit-identical to spec-off regardless of what the controller decides.
+
+    Returns (tokens/s, dispatches_per_token, accept_rate, kv_bytes/token,
+    weight_bytes_total, engine, auto) where ``auto`` carries the
+    controller story: spec_len_effective (mean final per-slot draft
+    length), accept_rate_by_drafter, controller-decision counts, and
+    per-regime dispatches-per-token."""
+    import numpy as np
+
+    from picotron_tpu.config import Config
+    from picotron_tpu.inference import ContinuousBatcher, InferenceEngine, \
+        Request
+
+    raw = cfg.to_dict()
+    raw["inference"].update(dict(
+        spec_len=spec_len, drafter=drafter,
+        spec_controller=dict(raw["inference"].get("spec_controller", {}),
+                             enabled=True, window=max(4, spec_len),
+                             hysteresis=2)))
+    cfg = Config.from_dict(raw)
+    import jax
+
+    engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
+                             attend_impl=attend_impl, kv_layout=kv_layout,
+                             kv_page_policy=kv_page_policy,
+                             sample_on_device=sample_on_device,
+                             weight_dtype=weight_dtype)
+    params, weight_bytes = bench_params(engine, cfg)
+    rng = np.random.default_rng(0)
+    rep_prompt = [int(t) for t in np.resize(
+        rng.integers(1, cfg.model.vocab_size, 4), prompt_len)]
+    # warmup: absorb compilation OUTSIDE the timed window, run/run_spec's
+    # protocol — a throwaway batcher on the same engine compiles the
+    # prefill bucket, the verify program, and (learned) the draft
+    # dispatch; the decode_block fallback program (reached mid-run once
+    # the controller turns slots off) is compiled explicitly against a
+    # scratch cache with zero budgets
+    warm = ContinuousBatcher(engine, params)
+    warm.run([Request("w_rep", list(rep_prompt),
+                      max_new_tokens=spec_len + 2),
+              Request("w_rand",
+                      [int(t) for t in rng.integers(
+                          1, cfg.model.vocab_size, prompt_len)],
+                      max_new_tokens=spec_len + 2)])
+    keys = np.stack([np.asarray(jax.random.PRNGKey(i))
+                     for i in range(engine.decode_block_len)])
+    zero = np.zeros(slots, np.int32)
+    engine.decode_block(params, engine.init_cache(), zero, keys,
+                        np.full(slots, -1, np.int32), zero,
+                        np.zeros(slots, np.float32), zero,
+                        np.ones(slots, np.float32))
+    batcher = ContinuousBatcher(engine, params)
+    # registry counters are engine-lifetime: snapshot what the warmup
+    # drafted so the per-drafter split below covers the timed run only
+    reg = batcher.obs.registry
+    base = {kind: (reg.counter("picotron_drafter_proposed_total",
+                               drafter=kind).value,
+                   reg.counter("picotron_drafter_accepted_total",
+                               drafter=kind).value)
+            for kind in batcher._drafters}
+    reqs = []
+    for s in range(slots):
+        if s % 2 == 0:
+            reqs.append(Request(f"rep{s}", list(rep_prompt),
+                                max_new_tokens=steps))
+        else:
+            prompt = [int(t) for t in
+                      rng.integers(1, cfg.model.vocab_size, prompt_len)]
+            reqs.append(Request(f"rand{s}", prompt, max_new_tokens=steps))
+    t0 = time.perf_counter()
+    results = batcher.run(reqs)
+    dt = time.perf_counter() - t0
+    total_toks = sum(len(r.tokens) for r in results.values())
+    dpt = batcher.decode_dispatches / max(total_toks, 1)
+
+    def regime_dpt(prefix):
+        rs = [r for u, r in results.items() if u.startswith(prefix)]
+        toks = sum(len(r.tokens) for r in rs)
+        return round(sum(r.dispatches for r in rs) / max(toks, 1), 4)
+
+    by_drafter = {}
+    for kind in batcher._drafters:
+        bp, ba = base.get(kind, (0.0, 0.0))
+        prop = reg.counter("picotron_drafter_proposed_total",
+                           drafter=kind).value - bp
+        if prop:
+            acc = reg.counter("picotron_drafter_accepted_total",
+                              drafter=kind).value - ba
+            by_drafter[kind] = round(acc / prop, 4)
+    auto = {
+        "spec_len_effective": round(float(np.mean(
+            [r.spec_len_final or 0 for r in results.values()])), 3),
+        "spec_len_by_regime": {
+            "repetitive": round(float(np.mean(
+                [r.spec_len_final or 0 for u, r in results.items()
+                 if u.startswith("rep")])), 3),
+            "random": round(float(np.mean(
+                [r.spec_len_final or 0 for u, r in results.items()
+                 if u.startswith("rand")])), 3)},
+        "dispatches_per_token_by_regime": {
+            "repetitive": regime_dpt("rep"), "random": regime_dpt("rand")},
+        "accept_rate_by_drafter": by_drafter,
+        "controller_decisions": batcher.controller.decisions,
+    }
+    # end-of-stream live window per slot: retired slots have released
+    # their cache lengths to 0, so reconstruct what each request held
+    # when it finished (run/run_spec sample lengths while still parked)
+    final_lengths = np.asarray(
+        [len(r.prompt) + len(r.tokens) for r in results.values()],
+        np.int64)
+    kv_bytes = int(round(kv_bytes_per_token(engine, final_lengths) * dpt))
+    return (total_toks / dt, dpt, batcher.accept_rate or 0.0, kv_bytes,
+            weight_bytes, engine, auto)
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="decode throughput bench")
     ap.add_argument("--block-len", type=int, default=1,
@@ -413,6 +566,22 @@ def main(argv=None) -> None:
                     help="speculative decoding: draft tokens per verify "
                          "dispatch on repetitive prompts (0 = off; "
                          "mutually exclusive with --block-len > 1)")
+    ap.add_argument("--drafter", choices=("ngram", "learned"),
+                    default="ngram",
+                    help="draft model for --spec-len runs: the model-free "
+                         "prompt-lookup drafter (default) or the "
+                         "EAGLE-style learned head over the target's own "
+                         "last hidden state (shares the target's "
+                         "embedding + lm_head; one small jitted draft "
+                         "dispatch per round)")
+    ap.add_argument("--spec-auto", action="store_true",
+                    help="closed-loop controller run: a mixed "
+                         "repetitive/random-prompt workload through the "
+                         "real batcher with inference.spec_controller "
+                         "enabled — the JSON gains spec_len_effective, "
+                         "accept_rate_by_drafter, per-regime "
+                         "dispatches/token, and controller-decision "
+                         "counts (requires --spec-len)")
     ap.add_argument("--attend-impl", choices=("dense", "flash"),
                     default="dense",
                     help="KV-cache attention kernel: the dense "
@@ -449,6 +618,9 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     if args.spec_len > 0 and args.block_len != 1:
         ap.error("--spec-len replaces blocked decode; drop --block-len")
+    if args.spec_auto and args.spec_len < 1:
+        ap.error("--spec-auto tunes speculation per slot; give it a "
+                 "ceiling with --spec-len N")
     if args.kv_page_policy != "uniform" and args.kv_layout != "paged":
         ap.error("--kv-page-policy hot_bf16 requires --kv-layout paged "
                  "(per-page refcounts decide which pages read as int8)")
@@ -501,10 +673,20 @@ def main(argv=None) -> None:
         "dataset": {"name": "synthetic"},
     })
     accept = None
+    auto = None
     try:
-        if args.spec_len > 0:
+        if args.spec_auto:
+            (tok_s, dpt, accept, kv_bytes, weight_bytes, engine,
+             auto) = run_spec_auto(
+                cfg, spec_len=args.spec_len, drafter=args.drafter,
+                attend_impl=args.attend_impl,
+                kv_layout=args.kv_layout,
+                kv_page_policy=args.kv_page_policy,
+                sample_on_device=args.sample_on_device,
+                weight_dtype=args.weight_dtype, **sizes)
+        elif args.spec_len > 0:
             tok_s, dpt, accept, kv_bytes, weight_bytes, engine = run_spec(
-                cfg, spec_len=args.spec_len,
+                cfg, spec_len=args.spec_len, drafter=args.drafter,
                 attend_impl=args.attend_impl,
                 kv_layout=args.kv_layout,
                 kv_page_policy=args.kv_page_policy,
@@ -596,8 +778,15 @@ def main(argv=None) -> None:
         record["preflight"] = preflight_note
     if args.spec_len > 0:
         record["spec_len"] = args.spec_len
+        record["drafter"] = args.drafter
         record["accept_rate"] = round(accept, 4)
         reg.gauge("picotron_accept_rate").set(accept)
+    if auto is not None:
+        # the controller story: converged per-slot draft lengths,
+        # per-drafter accept split, per-regime dispatches/token, and
+        # what the policy loop actually decided
+        record["spec_auto"] = True
+        record.update(auto)
     # the engine registry's compact snapshot (dispatch count/latency
     # histograms, pool/accept gauges) rides along — one structured blob
     # instead of growing the hand-picked field list forever

@@ -335,6 +335,48 @@ class ResilienceConfig:
 
 
 @dataclass
+class SpecControllerConfig:
+    """Closed-loop speculation tuning (inference/speculative.py::
+    SpecController, docs/INFERENCE.md "Self-tuning speculation"). The
+    first consumer of the obs registry as a CONTROL surface: the batcher
+    mirrors per-slot draft-proposed/accepted counts and per-kind dispatch
+    latencies into the registry, and the controller reads those live
+    instruments to set ``spec_len`` per slot each round — ramping up where
+    acceptance pays, ramping to 0 (speculation off; the batcher falls back
+    to blocked decode when every slot is off) where it does not, and
+    switching drafters per slot — with hysteresis so adversarial traffic
+    cannot make it oscillate."""
+
+    # Master switch. Inert unless inference.spec_len > 0 (there is no
+    # speculation to tune); the batcher builds the controller only on
+    # speculative engines.
+    enabled: bool = False
+    # Windowed accept rate at or above which a slot ramps its spec_len UP
+    # (doubling toward inference.spec_len).
+    target: float = 0.5
+    # Windowed accept rate below which a slot ramps DOWN (halving toward
+    # 0). The [low, target) band holds steady — the hysteresis band that
+    # keeps borderline traffic from dithering.
+    low: float = 0.25
+    # Proposed-draft tokens per slot per evaluation window: the controller
+    # re-decides only after a slot has proposed this many tokens since its
+    # last decision, so one unlucky round cannot flip the policy.
+    window: int = 32
+    # Consecutive same-direction evaluations required before a ramp is
+    # applied. With flip-flopping accept rates the direction alternates,
+    # the streak never completes, and spec_len holds — test-pinned.
+    hysteresis: int = 2
+    # Rounds a slot sits at spec_len 0 before the controller re-probes
+    # with a length-1 draft (traffic changes; a slot turned off on hard
+    # traffic must be able to rediscover easy traffic).
+    cooloff: int = 64
+    # Minimum per-kind dispatch-latency samples (picotron_dispatch_seconds
+    # histograms) before the measured verify-vs-decode cost ratio joins
+    # the decision; below it the accept-rate thresholds decide alone.
+    latency_min_samples: int = 16
+
+
+@dataclass
 class InferenceConfig:
     """Serving knobs (picotron_tpu/inference/, docs/INFERENCE.md). These
     only affect the InferenceEngine / ContinuousBatcher path; training
@@ -441,6 +483,35 @@ class InferenceConfig:
     # against the slot's own token history (tried spec_ngram down to 1) to
     # propose continuations. Only consulted when spec_len > 0.
     spec_ngram: int = 3
+    # Which draft model proposes speculative continuations (spec_len > 0):
+    # "ngram" = the model-free prompt-lookup drafter (host-side, free);
+    # "learned" = the EAGLE-style learned drafter
+    # (inference/speculative.py::LearnedDrafter) — a tiny head over the
+    # target's own last hidden state that shares the target's embedding
+    # and lm_head weights (no separate checkpoint; optional tiny-head
+    # params ride a params tree), drafting spec_len tokens in one small
+    # jitted dispatch. "learned" makes the engine plumb the last hidden
+    # state out of every decode/verify dispatch (the return_hidden hook).
+    drafter: str = "ngram"
+    # Token window the n-gram drafter's suffix match scans (most recent N
+    # history tokens). 0 = unbounded. The drafter's index is incremental
+    # (append-only) either way; the window caps how far back a match may
+    # land, keeping long-running slots' lookups O(1) per round.
+    spec_history_window: int = 0
+    # Closed-loop per-slot spec_len tuning — see SpecControllerConfig.
+    spec_controller: SpecControllerConfig = field(
+        default_factory=SpecControllerConfig)
+
+    def __post_init__(self):
+        # from_dict hands nested blocks through as plain dicts; coerce so
+        # cfg.inference.spec_controller.target always works (unknown keys
+        # ignored, matching Config.from_dict's build())
+        if isinstance(self.spec_controller, dict):
+            known = {f.name for f in
+                     dataclasses.fields(SpecControllerConfig)}
+            self.spec_controller = SpecControllerConfig(
+                **{k: v for k, v in self.spec_controller.items()
+                   if k in known})
     # Graceful degradation for the flash attend path: when a
     # attend_impl="flash" dispatch fails, log once, rebuild the engine's
     # compiled programs on "dense", and keep serving — for the REST OF THE
@@ -888,6 +959,47 @@ class Config:
             raise ValueError("inference.spec_len must be >= 0 (0 = off)")
         if inf.spec_ngram < 1:
             raise ValueError("inference.spec_ngram must be >= 1")
+        if inf.drafter not in ("ngram", "learned"):
+            raise ValueError(
+                f"unknown inference.drafter {inf.drafter!r} (ngram|learned)"
+                " — 'ngram' is the model-free prompt-lookup drafter, "
+                "'learned' the EAGLE-style head over the target's last "
+                "hidden state")
+        if inf.spec_history_window < 0:
+            raise ValueError(
+                "inference.spec_history_window must be >= 0 (0 = "
+                "unbounded match scan)")
+        sc = inf.spec_controller
+        if not isinstance(sc.enabled, bool):
+            raise ValueError(
+                f"inference.spec_controller.enabled must be a JSON "
+                f"boolean, got {sc.enabled!r}")
+        if sc.enabled and inf.spec_len < 1:
+            raise ValueError(
+                "inference.spec_controller.enabled requires "
+                "inference.spec_len > 0 (spec_len is the controller's "
+                "per-slot ceiling; there is no speculation to tune at 0)"
+                " — set inference.spec_len, or disable the controller")
+        if not 0.0 < sc.target <= 1.0:
+            raise ValueError(
+                "inference.spec_controller.target must be in (0, 1]")
+        if not 0.0 <= sc.low <= sc.target:
+            raise ValueError(
+                "inference.spec_controller.low must satisfy 0 <= low <= "
+                f"target (got low={sc.low}, target={sc.target}) — the "
+                "[low, target) band is the hysteresis hold region")
+        if sc.window < 1:
+            raise ValueError("inference.spec_controller.window must be >= 1")
+        if sc.hysteresis < 1:
+            raise ValueError(
+                "inference.spec_controller.hysteresis must be >= 1")
+        if sc.cooloff < 0:
+            raise ValueError(
+                "inference.spec_controller.cooloff must be >= 0 rounds")
+        if sc.latency_min_samples < 1:
+            raise ValueError(
+                "inference.spec_controller.latency_min_samples must be "
+                ">= 1")
         if r.consensus_interval < 0:
             raise ValueError("consensus_interval must be >= 0 (0 = off)")
         if r.peer_timeout_s < 0:

@@ -22,12 +22,19 @@ into a batched generation engine:
 - ``batcher``: continuous batching — admit/retire variable-length requests
   into the engine's fixed slots, consuming whole decode blocks (or
   draft-verify dispatches on a speculative engine);
-- ``speculative``: host-side drafters for speculative decoding — the
-  ``Drafter`` interface plus the model-free prompt-lookup ``NgramDrafter``;
-  ``engine.verify`` scores ``spec_len + 1`` positions per slot in one
-  dispatch and ``sampling.speculative_accept`` keeps the matching prefix
-  (exact for greedy, rejection-sampled for stochastic) — one model pass
-  per ACCEPTED RUN instead of per token.
+- ``speculative``: the draft side of speculative decoding plus its
+  policy loop — the ``Drafter`` interface, the model-free prompt-lookup
+  ``NgramDrafter`` (incremental append-only suffix index, windowed match
+  scan), the EAGLE-style ``LearnedDrafter`` (tiny head over the target's
+  own last hidden state sharing the target's embedding/lm_head — the
+  engine's ``return_hidden`` hook keeps that state on device), and the
+  ``SpecController`` closed loop that reads the obs registry's live
+  accept counters + dispatch latencies and sets ``spec_len`` per slot
+  each round; ``engine.verify`` scores ``spec_len + 1`` positions per
+  slot in one (per-slot RAGGED) dispatch and
+  ``sampling.speculative_accept`` keeps the matching prefix (exact for
+  greedy, rejection-sampled for stochastic) — one model pass per
+  ACCEPTED RUN instead of per token.
 
 Design notes and CLI usage: docs/INFERENCE.md.
 """
@@ -43,5 +50,8 @@ from picotron_tpu.inference.engine import (  # noqa: F401
 )
 from picotron_tpu.inference.speculative import (  # noqa: F401
     Drafter,
+    LearnedDrafter,
     NgramDrafter,
+    SpecController,
+    init_draft_head,
 )

@@ -87,6 +87,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from picotron_tpu.inference import sampling
@@ -136,6 +137,15 @@ class GenerationResult:
     # off): late children — the serve front end's delivery span — parent
     # onto it after the batcher has already retired the slot
     span_id: Optional[int] = None
+    # decode/verify rounds this request's slot took part in — this
+    # request's own dispatches-per-token is dispatches / len(tokens),
+    # the per-slot convergence metric the spec controller is judged on
+    dispatches: int = 0
+    # speculative engines: the slot's spec_len and drafter kind at
+    # retirement (the controller's converged choice; the static config
+    # values without a controller)
+    spec_len_final: Optional[int] = None
+    drafter: Optional[str] = None
 
 
 @dataclass
@@ -146,6 +156,7 @@ class _Slot:
     submit_t: Optional[float] = None  # clock() at submit (stats)
     queue_wait_s: Optional[float] = None
     ttft_s: Optional[float] = None
+    dispatches: int = 0  # rounds this slot was active in
 
 
 class ContinuousBatcher:
@@ -176,14 +187,53 @@ class ContinuousBatcher:
         # request emits, from inside step()/run() — the serve front end
         # pushes these straight into the response stream
         self.on_token = on_token
-        # speculative engines get a drafter (the prompt-lookup default, or
-        # an injected one — e.g. a scripted drafter in tests, a draft
-        # model later); spec-off engines ignore it
+        # speculative engines get a drafter (selected by
+        # inference.drafter — the prompt-lookup n-gram default or the
+        # EAGLE-style learned head — or injected, e.g. a scripted drafter
+        # in tests); spec-off engines ignore it
+        inf = engine.cfg.inference
         if drafter is None and engine.spec_len > 0:
-            from picotron_tpu.inference.speculative import NgramDrafter
+            from picotron_tpu.inference.speculative import (
+                LearnedDrafter,
+                NgramDrafter,
+            )
 
-            drafter = NgramDrafter(engine.spec_ngram)
+            if engine.drafter_kind == "learned":
+                drafter = LearnedDrafter(engine, params)
+            else:
+                drafter = NgramDrafter(engine.spec_ngram,
+                                       window=inf.spec_history_window)
         self.drafter = drafter
+        # the drafter pool the controller switches between, primary
+        # first: a learned primary always carries the free n-gram
+        # fallback; an injected custom drafter runs alone
+        self._drafters: dict = {}
+        if engine.spec_len > 0 and drafter is not None:
+            self._drafters[drafter.kind] = drafter
+            if drafter.kind == "learned":
+                from picotron_tpu.inference.speculative import NgramDrafter
+
+                self._drafters["ngram"] = NgramDrafter(
+                    engine.spec_ngram, window=inf.spec_history_window)
+        # the closed-loop spec_len policy (inference.spec_controller):
+        # per-slot draft lengths + drafter choice, fed by the registry's
+        # live accept counters and dispatch-latency histograms
+        self.controller = None
+        if engine.spec_len > 0 and inf.spec_controller.enabled:
+            from picotron_tpu.inference.speculative import SpecController
+
+            self.controller = SpecController(
+                inf.spec_controller, self.obs.registry,
+                slots=engine.slots, max_spec_len=engine.spec_len,
+                block_len=engine.decode_block_len,
+                kinds=tuple(self._drafters))
+        # the learned drafter's input: each slot's last hidden state,
+        # kept ON DEVICE between dispatches (engine.return_hidden)
+        self._hidden = None
+        if engine.return_hidden:
+            self._hidden = jnp.zeros(
+                (engine.slots, engine.cfg.model.hidden_size),
+                jnp.dtype(engine.cfg.model.dtype))
         self._cache = engine.init_cache()
         self._slots: list = [None] * engine.slots
         self._pending: deque = deque()
@@ -399,7 +449,29 @@ class ContinuousBatcher:
             reg.gauge("picotron_kv_pool_utilization",
                       "live / usable KV pool pages").set(
                           live / max(total, 1))
+        if self.engine.spec_len > 0:
+            # speculation health on the scrape (refreshed on render like
+            # the depth gauges above): the fabric's router — and any
+            # Prometheus scraper — sees each replica's live accept rate
+            # and effective per-slot draft length
+            reg.gauge("picotron_spec_accept_rate",
+                      "fraction of proposed draft tokens accepted").set(
+                          self.accept_rate or 0.0)
+            reg.gauge("picotron_spec_len",
+                      "mean effective draft length over occupied slots"
+                      ).set(self.spec_len_effective())
         return queued, active
+
+    def spec_len_effective(self) -> float:
+        """Mean draft length across occupied slots: the controller's live
+        per-slot choices, or the static ``engine.spec_len`` without one
+        (0.0 when nothing is parked or speculation is off)."""
+        occ = [i for i, s in enumerate(self._slots) if s is not None]
+        if self.engine.spec_len <= 0 or not occ:
+            return 0.0
+        if self.controller is not None:
+            return self.controller.spec_len_mean(occ)
+        return float(self.engine.spec_len)
 
     def stats(self) -> dict:
         """Serving counters + latency percentiles (the ``/statz`` payload):
@@ -420,6 +492,10 @@ class ContinuousBatcher:
         )
         if self.draft_proposed:
             d["accept_rate"] = self.accept_rate
+        if self.engine.spec_len > 0:
+            d["spec_len_effective"] = self.spec_len_effective()
+            if self.controller is not None:
+                d["spec_controller"] = self.controller.decisions
         if self.paged is not None:
             # pool occupancy + prefix-cache effectiveness (kv_pages_*,
             # prefix_hit_rate, cow_copies, ...) ride into /statz
@@ -443,10 +519,22 @@ class ContinuousBatcher:
         if span is not None:
             self.obs.tracer.end(span, finish_reason=reason,
                                 tokens=len(s.generated))
+        spec_len = drafter_kind = None
+        if self.engine.spec_len > 0:
+            if self.controller is not None:
+                spec_len = int(self.controller.lens()[i])
+                drafter_kind = self.controller.drafter_kinds()[i]
+            else:
+                spec_len = self.engine.spec_len
+                drafter_kind = (self.drafter.kind if self.drafter is not None
+                                else None)
         self._results[s.req.uid] = GenerationResult(
             s.req.uid, list(s.req.prompt), list(s.generated), reason,
             queue_wait_s=s.queue_wait_s, ttft_s=s.ttft_s,
-            span_id=_sid(span))
+            span_id=_sid(span), dispatches=s.dispatches,
+            spec_len_final=spec_len, drafter=drafter_kind)
+        for d in self._drafters.values():
+            d.forget(s.req.uid)
         self._slots[i] = None
         self._cache = self.engine.release(self._cache, i)
         self._last_tok[i] = 0
@@ -497,30 +585,40 @@ class ContinuousBatcher:
         the longest radix-cached prefix is shared (no dispatches) and only
         the suffix prefills."""
         sample = None
+        rh = self.engine.return_hidden
+        hidden = None
         if self.engine.sample_on_device:
             sample = (key, req.temperature, req.top_k, req.top_p)
         if self.paged is not None:
             self.paged.priced[i] = self.page_commitment(req)
-            self._cache, logits, n, cached = self.engine.prefill_paged(
+            out = self.engine.prefill_paged(
                 self.params, self._cache, req.prompt, i, sample=sample)
+            self._cache, logits, n, cached = out[:4]
+            hidden = out[4] if rh else None
             self.prefill_dispatches += n
             self._last_prefill = {"dispatches": n, "cached_tokens": cached}
-            return logits
-        if len(req.prompt) > self.engine.prefill_chunk:
+        elif len(req.prompt) > self.engine.prefill_chunk:
             # long prompt: fixed-width chunks straight into the slot —
             # O(1) compiled shapes in prompt length
             n_chunks = -(-len(req.prompt) // self.engine.prefill_chunk)
-            self._cache, logits = self.engine.prefill_chunked(
+            out = self.engine.prefill_chunked(
                 self.params, self._cache, req.prompt, i, sample=sample)
+            self._cache, logits = out[:2]
+            hidden = out[2] if rh else None
             self.prefill_dispatches += n_chunks
             self._last_prefill = {"dispatches": n_chunks}
         else:
-            kv, logits = self.engine.prefill(self.params, req.prompt,
-                                             sample=sample)
+            out = self.engine.prefill(self.params, req.prompt,
+                                      sample=sample)
+            kv, logits = out[:2]
+            hidden = out[2] if rh else None
             self._cache = self.engine.insert(
                 self._cache, kv, i, len(req.prompt))
             self.prefill_dispatches += 1
             self._last_prefill = {"dispatches": 1}
+        if hidden is not None:
+            # the prompt's last hidden state seeds the slot's drafting row
+            self._hidden = self._hidden.at[i].set(jnp.asarray(hidden)[0])
         return logits
 
     def _pages_admit(self) -> bool:
@@ -608,6 +706,12 @@ class ContinuousBatcher:
                 slot.queue_wait_s = now - submit_t
                 self._queue_wait_hist.observe(slot.queue_wait_s)
             self._slots[i] = slot
+            # fresh request: the controller restarts the slot's policy
+            # and stateful drafters drop any previous occupant's index
+            if self.controller is not None:
+                self.controller.reset(i)
+            for d in self._drafters.values():
+                d.begin(req.uid)
             self._temp[i] = req.temperature
             self._top_k[i] = req.top_k
             self._top_p[i] = req.top_p
@@ -635,15 +739,49 @@ class ContinuousBatcher:
             if s is not None and s.deadline is not None and now >= s.deadline:
                 self._finish(i, "timeout")
 
+    def _plan_spec(self):
+        """Per-slot draft lengths + drafter kinds for the next round, or
+        (None, None) when the controller has turned EVERY occupied slot
+        off — the batcher then falls back to a blocked decode round
+        (speculation out of the way entirely, not a 0-draft verify)."""
+        n = len(self._slots)
+        lens = np.zeros(n, np.int32)
+        kinds: list = [None] * n
+        occupied = [i for i, s in enumerate(self._slots) if s is not None]
+        if self.controller is not None:
+            clens = self.controller.lens()
+            ckinds = self.controller.drafter_kinds()
+            for i in occupied:
+                lens[i] = clens[i]
+                kinds[i] = ckinds[i]
+            if occupied and not lens.any():
+                return None, None
+        else:
+            for i in occupied:
+                lens[i] = self.engine.spec_len
+                kinds[i] = self.drafter.kind
+        return lens, kinds
+
+    def _merge_hidden(self, hid, counts) -> None:
+        """Fold one dispatch's hidden states into the per-slot device
+        rows: only slots that produced tokens this dispatch advance (a
+        solo isolation re-dispatch merges exactly its own row)."""
+        if self._hidden is not None and hid is not None:
+            self._hidden = jnp.where(
+                jnp.asarray(np.asarray(counts) > 0)[:, None],
+                hid, self._hidden)
+
     def step(self) -> None:
         """Expire overdue slots, admit waiting requests into free slots,
         then advance every occupied slot by one decode block (up to
         ``engine.decode_block_len`` tokens per slot, one dispatch) — or,
         on a speculative engine, by one draft-verify dispatch (1 to
-        ``engine.spec_len + 1`` tokens per slot). A dispatch failure that
-        survives the retry budget is isolated to the slots that fail
-        alone (see module docstring) — step() itself never raises for an
-        engine-side fault."""
+        ``engine.spec_len + 1`` tokens per slot; with the controller, a
+        RAGGED dispatch at each slot's own draft length, or the blocked-
+        decode fallback once every slot's speculation is off). A dispatch
+        failure that survives the retry budget is isolated to the slots
+        that fail alone (see module docstring) — step() itself never
+        raises for an engine-side fault."""
         self._expire_deadlines()
         self._admit()
         if not any(s is not None for s in self._slots):
@@ -652,8 +790,12 @@ class ContinuousBatcher:
             self._budget[i] = self._remaining(i) if s is not None else 0
         budget = self._budget.copy()
         t_round = self._clock()
+        spec_lens = spec_kinds = None
         if self.engine.spec_len > 0:
-            toks, counts, failed = self._spec_round(budget)
+            spec_lens, spec_kinds = self._plan_spec()
+        if spec_lens is not None:
+            toks, counts, failed = self._spec_round(budget, spec_lens,
+                                                    spec_kinds)
         else:
             block = self.engine.decode_block_len
             keys = np.stack([np.asarray(self._split())
@@ -661,12 +803,18 @@ class ContinuousBatcher:
 
             def dispatch(b):
                 t0 = self._clock()
-                self._cache, toks, counts = self.engine.decode_block(
+                out = self.engine.decode_block(
                     self.params, self._cache, self._last_tok, keys,
                     self._eos, b, self._temp, self._top_k, self._top_p)
+                if self.engine.return_hidden:
+                    self._cache, toks, counts, hid = out
+                else:
+                    self._cache, toks, counts = out
+                    hid = None
                 self.decode_dispatches += 1
                 t_sync = self._clock()
                 out = np.asarray(toks), np.asarray(counts), None
+                self._merge_hidden(hid, out[1])
                 t1 = self._clock()
                 self._host_sync_s = t1 - t_sync
                 self.engine.observe_dispatch("decode", t1 - t0,
@@ -679,6 +827,13 @@ class ContinuousBatcher:
 
             toks, counts, _, failed = self._guarded_round(dispatch, budget)
             self._slot_spans("decode", t_round, budget, counts, failed)
+        for i, s in enumerate(self._slots):
+            if s is not None and budget[i] > 0 and i not in failed:
+                s.dispatches += 1
+                if self.controller is not None:
+                    # policy tick AFTER this round's counters landed in
+                    # the registry; idle slots advance their cooloff
+                    self.controller.after_round(i)
         for i in failed:
             if self._slots[i] is not None:
                 self._finish(i, "error")
@@ -790,37 +945,77 @@ class ContinuousBatcher:
             toks_out = np.zeros((n, 1), np.int32)
         return toks_out, counts_out, aux_out, failed
 
-    def _spec_round(self, budget) -> tuple:
-        """One draft-verify round: propose ``spec_len`` tokens per occupied
-        slot from its own history (prompt + generated — the drafter runs
-        host-side while the device is free), dispatch ONE ``engine.verify``
+    def _spec_round(self, budget, lens, kinds) -> tuple:
+        """One draft-verify round: propose ``lens[i]`` tokens per occupied
+        slot (per-slot RAGGED under the controller; the full
+        ``engine.spec_len`` otherwise), dispatch ONE ``engine.verify``
         pass (fault-isolated like the decode round), and return its
-        (emitted tokens, per-slot counts, failed slots). Acceptance stats
-        accumulate here; the shared step() tail walks the emitted prefixes
+        (emitted tokens, per-slot counts, failed slots).
+
+        Drafting is per kind: "learned" slots draft TOGETHER in one small
+        jitted dispatch from the device-resident hidden states
+        (LearnedDrafter.propose_batch — timed into the "draft" latency
+        histogram the controller's cost model reads); host drafters
+        (n-gram, scripted) propose per slot from the slot's own history
+        while the device is free. Acceptance stats accumulate here — the
+        lifetime totals, the per-slot and per-drafter registry counter
+        families the controller and the bench read, and the controller's
+        obs-off shadow; the shared step() tail walks the emitted prefixes
         through ``_token_done`` exactly like a decode block's."""
         g = self.engine.spec_len
         n = len(self._slots)
         t_round = self._clock()
+        reg = self.obs.registry
         tokens = np.zeros((n, g + 1), np.int32)
         with self.obs.tracer.span("draft", spec_len=g):
+            learned = [i for i, s in enumerate(self._slots)
+                       if s is not None and kinds[i] == "learned"
+                       and lens[i] > 0]
+            batch = None
+            if learned:
+                ld = self._drafters["learned"]
+                t0 = self._clock()
+                batch = ld.propose_batch(self._last_tok, self._hidden, g)
+                reg.counter("picotron_dispatch_total",
+                            "engine dispatches by kind",
+                            kind="draft").inc()
+                self.engine.observe_dispatch("draft",
+                                             self._clock() - t0)
             for i, s in enumerate(self._slots):
                 if s is None:
                     continue
                 tokens[i, 0] = self._last_tok[i]
+                gi = int(lens[i])
+                if gi == 0:
+                    continue  # this slot rides the dispatch draft-free
+                if kinds[i] == "learned":
+                    tokens[i, 1: 1 + gi] = batch[i, :gi]
+                    continue
+                d = self._drafters.get(kinds[i], self.drafter)
                 hist = np.asarray(list(s.req.prompt) + s.generated,
                                   np.int32)
-                tokens[i, 1:] = self.drafter.propose(hist, g)
+                if getattr(d, "stateful", False):
+                    tokens[i, 1: 1 + gi] = d.propose(hist, gi,
+                                                     ctx=s.req.uid)
+                else:
+                    tokens[i, 1: 1 + gi] = d.propose(hist, gi)
         key = self._split()
 
         def dispatch(b):
             t0 = self._clock()
-            self._cache, emitted, counts, accepted = self.engine.verify(
+            out = self.engine.verify(
                 self.params, self._cache, tokens, key, self._eos,
-                b, self._temp, self._top_k, self._top_p)
+                b, self._temp, self._top_k, self._top_p, draft_len=lens)
+            if self.engine.return_hidden:
+                self._cache, emitted, counts, accepted, hid = out
+            else:
+                self._cache, emitted, counts, accepted = out
+                hid = None
             self.decode_dispatches += 1
             t_sync = self._clock()
             out = (np.asarray(emitted), np.asarray(counts),
                    np.asarray(accepted))
+            self._merge_hidden(hid, out[1])
             t1 = self._clock()
             self._host_sync_s = t1 - t_sync
             self.engine.observe_dispatch("verify", t1 - t0,
@@ -834,15 +1029,36 @@ class ContinuousBatcher:
         emitted, counts, accepted, failed = self._guarded_round(
             dispatch, budget)
         for i, s in enumerate(self._slots):
-            if s is not None and i not in failed and budget[i] > 0:
-                self.draft_proposed += g
-                self._draft_proposed_total.inc(g)
-                if accepted is not None:
-                    self.draft_accepted += int(accepted[i])
-                    self._draft_accepted_total.inc(int(accepted[i]))
+            if s is None or i in failed or budget[i] <= 0:
+                continue
+            gi = int(lens[i])
+            if gi == 0:
+                continue
+            acc = int(accepted[i]) if accepted is not None else 0
+            self.draft_proposed += gi
+            self._draft_proposed_total.inc(gi)
+            self.draft_accepted += acc
+            self._draft_accepted_total.inc(acc)
+            # the labeled families the CONTROLLER reads back (telemetry
+            # as a control surface) and the bench's per-drafter split
+            reg.counter("picotron_slot_draft_proposed_total",
+                        "draft tokens proposed, by slot",
+                        slot=str(i)).inc(gi)
+            reg.counter("picotron_slot_draft_accepted_total",
+                        "draft tokens accepted, by slot",
+                        slot=str(i)).inc(acc)
+            kind = kinds[i] or "unknown"
+            reg.counter("picotron_drafter_proposed_total",
+                        "draft tokens proposed, by drafter",
+                        drafter=kind).inc(gi)
+            reg.counter("picotron_drafter_accepted_total",
+                        "draft tokens accepted, by drafter",
+                        drafter=kind).inc(acc)
+            if self.controller is not None:
+                self.controller.record(i, gi, acc)
         self._slot_spans(
             "verify", t_round, budget, counts, failed,
-            extra=lambda i: {"draft_len": g,
+            extra=lambda i: {"draft_len": int(lens[i]),
                              "accepted": (int(accepted[i])
                                           if accepted is not None else 0)})
         return emitted, counts, failed

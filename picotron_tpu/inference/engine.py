@@ -119,6 +119,8 @@ class InferenceEngine:
                  kv_page_policy: Optional[str] = None,
                  sample_on_device: Optional[bool] = None,
                  weight_dtype: Optional[str] = None,
+                 drafter: Optional[str] = None,
+                 return_hidden: Optional[bool] = None,
                  hooks=None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
@@ -154,6 +156,21 @@ class InferenceEngine:
                               else inf.spec_ngram)
         if self.spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
+        # Drafter selection (inference.drafter): "ngram" keeps drafting
+        # host-side; "learned" is the EAGLE-style head over the target's
+        # last hidden state, which needs that state plumbed out of every
+        # dispatch — the return_hidden hook below (PR 1's return_kv
+        # pattern: a trace-time output the programs grow only when asked).
+        if drafter is not None:
+            if drafter not in ("ngram", "learned"):
+                raise ValueError(
+                    f"unknown drafter {drafter!r} (ngram|learned)")
+            inf.drafter = drafter
+        self.drafter_kind = inf.drafter
+        if return_hidden is None:
+            return_hidden = (self.spec_len > 0
+                             and self.drafter_kind == "learned")
+        self.return_hidden = bool(return_hidden)
         # KV-cache attention kernel for decode/verify/chunked prefill:
         # "dense" (whole-window reference) or "flash" (length-aware Pallas
         # flash decode). A Python-level choice, so every jitted program
@@ -332,20 +349,26 @@ class InferenceEngine:
         # the whole point is that they never leave the device
         sod = self.sample_on_device
         samp = (P(), P(), P(), P()) if sod else ()
+        # the return_hidden hook grows every program family by one
+        # replicated [*, H] output (the residual stream is tp-replicated
+        # after each layer's reduce) — a trace-time choice like the
+        # sampling epilogue, so hidden-less engines compile byte-identical
+        # programs
+        hid = (P(),) if self.return_hidden else ()
         self._prefill_jit = jax.jit(shard_map(
             self._prefill_impl, mesh,
             in_specs=(self._pspecs, P(), P()) + samp,
-            out_specs=(kv_spec, P())))
+            out_specs=(kv_spec, P()) + hid))
         self._prefill_chunk_jit = jax.jit(shard_map(
             chunk_impl, mesh,
             in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P()) + samp,
-            out_specs=(self._cspecs, P())),
+            out_specs=(self._cspecs, P()) + hid),
             donate_argnums=(1,))
         self._decode_jit = jax.jit(shard_map(
             self._decode_impl, mesh,
             in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P(), P()),
-            out_specs=(self._cspecs, P()) if sod
-            else (self._cspecs, P(), P())),
+            out_specs=((self._cspecs, P()) if sod
+                       else (self._cspecs, P(), P())) + hid),
             donate_argnums=(1,))
         self._decode_block_jit = self._make_decode_block_jit()
         self._decode_block_poison_jit = None  # chaos-only; built on demand
@@ -355,11 +378,12 @@ class InferenceEngine:
             self._verify_jit = self._make_verify_jit()
 
     def _make_verify_jit(self, poison: bool = False):
+        hid = (P(),) if self.return_hidden else ()
         return jax.jit(shard_map(
             partial(self._verify_impl, poison=poison), self.topo.mesh,
             in_specs=(self._pspecs, self._cspecs,
-                      P(), P(), P(), P(), P(), P(), P()),
-            out_specs=(self._cspecs, P(), P(), P())),
+                      P(), P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(self._cspecs, P(), P(), P()) + hid),
             donate_argnums=(1,))
 
     def _verify_prog(self, poison: bool):
@@ -372,11 +396,12 @@ class InferenceEngine:
         return self._verify_poison_jit
 
     def _make_decode_block_jit(self, poison: bool = False):
+        hid = (P(),) if self.return_hidden else ()
         return jax.jit(shard_map(
             partial(self._decode_block_impl, poison=poison), self.topo.mesh,
             in_specs=(self._pspecs, self._cspecs,
                       P(), P(), P(), P(), P(), P(), P()),
-            out_specs=(self._cspecs, P(), P())),
+            out_specs=(self._cspecs, P(), P()) + hid),
             donate_argnums=(1,))
 
     def _decode_block_prog(self, poison: bool):
@@ -507,9 +532,11 @@ class InferenceEngine:
         h_last = jnp.take_along_axis(h, (length - 1)[:, None, None], axis=1)
         last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
         last = last.astype(jnp.float32)
-        if self.sample_on_device:
-            return self._pack_kv(K, V), self._epilogue(last, *sample)
-        return self._pack_kv(K, V), last
+        out = self._epilogue(last, *sample) if self.sample_on_device \
+            else last
+        if self.return_hidden:
+            return self._pack_kv(K, V), out, h_last[:, 0]
+        return self._pack_kv(K, V), out
 
     def _split_cache(self, cache):
         """(per-layer K/V leaves to scan, lengths) — the scan consumes every
@@ -554,31 +581,37 @@ class InferenceEngine:
         read)."""
         return {**new_leaves, **self._meta(cache), "lengths": lengths}
 
-    def _model_block(self, params, cache, tokens, rows, pos):
+    def _model_block(self, params, cache, tokens, rows, pos,
+                     extra_meta=None):
         """The shared incremental-decode model body: embed ``tokens``
         [B, S] at RoPE positions ``rows`` [B, S], scan the layer stack
         writing each slot's S new K/V rows from ``pos`` [B]
         (kv_cache.cache_write), attend causally over cache prefix + block,
-        and return (updated per-layer leaves, logits [B, S, V] fp32).
-        S == 1 is the decode step; S > 1 the speculative verify block.
-        Lengths are NOT advanced here — callers apply their own activity
-        rule."""
+        and return (updated per-layer leaves, logits [B, S, V] fp32,
+        pre-final-norm hidden states [B, S, H]). S == 1 is the decode
+        step; S > 1 the speculative verify block. ``extra_meta`` rides
+        into each layer's cache dict alongside the paged metadata (the
+        ragged verify's ``draft_valid`` write mask). Lengths are NOT
+        advanced here — callers apply their own activity rule."""
         cos_b, sin_b = rope_at_positions(self._cos, self._sin, rows)
         h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
         leaves, _ = self._split_cache(cache)
-        body = self._layer_body(cos_b, sin_b, pos, self._meta(cache))
+        meta = self._meta(cache)
+        if extra_meta:
+            meta = {**meta, **extra_meta}
+        body = self._layer_body(cos_b, sin_b, pos, meta)
         h, new_leaves = lax.scan(body, h, (params["layers"], leaves))
         logits = tp_gather(llama.head_logits(params, h, self.cfg))
-        return new_leaves, logits.astype(jnp.float32)
+        return new_leaves, logits.astype(jnp.float32), h
 
     def _decode_core(self, params, cache, tokens):
         """One model step for all slots: ``tokens`` [B] at each slot's own
         ``cache['lengths']`` position -> (updated per-layer leaves,
-        logits [B, V] fp32)."""
+        logits [B, V] fp32, hidden [B, H])."""
         pos = cache["lengths"]  # [B] write index of the incoming token
-        new_leaves, logits = self._model_block(
+        new_leaves, logits, h = self._model_block(
             params, cache, tokens[:, None], pos[:, None], pos)
-        return new_leaves, logits[:, 0]
+        return new_leaves, logits[:, 0], h[:, 0]
 
     def _decode_impl(self, params, cache, tokens, key, temperature,
                      top_k, top_p):
@@ -586,17 +619,19 @@ class InferenceEngine:
         current last token), cache lengths give every slot its position.
         Sampling always runs on device; with the epilogue enabled the
         [B, V] logits are additionally DROPPED from the outputs, so the
-        dispatch's host payload is the [B] token ids alone."""
+        dispatch's host payload is the [B] token ids alone. A
+        ``return_hidden`` engine appends the step's pre-final-norm hidden
+        states [B, H] — the learned drafter's input."""
         pos = cache["lengths"]
-        new_leaves, logits = self._decode_core(params, cache, tokens)
+        new_leaves, logits, h = self._decode_core(params, cache, tokens)
         next_tok = sampling.sample(logits, key, temperature, top_k, top_p)
         # free slots (length 0) ride along for shape stability but stay at
         # length 0 — their row-0 writes are never visible
         new_cache = self._rebuild(cache, new_leaves,
                                   jnp.where(pos > 0, pos + 1, 0))
-        if self.sample_on_device:
-            return new_cache, next_tok
-        return new_cache, next_tok, logits
+        out = ((new_cache, next_tok) if self.sample_on_device
+               else (new_cache, next_tok, logits))
+        return out + (h,) if self.return_hidden else out
 
     def _decode_block_impl(self, params, cache, tokens, keys, eos_id,
                            budget, temperature, top_k, top_p,
@@ -620,13 +655,21 @@ class InferenceEngine:
         with NaN — the build that proves the sampler's non-finite gate
         keeps emitting defined tokens, the exact counterpart of
         train_step's ``poison_nonfinite``.
+
+        A ``return_hidden`` engine also returns hidden [B, H]: each
+        slot's pre-final-norm hidden state at its LAST active step — the
+        position whose logits produced the slot's final emitted token,
+        exactly what the learned drafter needs to draft its continuation.
         """
+        rh = self.return_hidden
+        hid0 = jnp.zeros((tokens.shape[0], self.cfg.model.hidden_size),
+                         self._dt)
 
         def step(carry, key_t):
-            cache, tok, budget = carry
+            cache, tok, budget, hid = carry
             pos = cache["lengths"]
             active = (pos > 0) & (budget > 0)
-            new_leaves, logits = self._decode_core(params, cache, tok)
+            new_leaves, logits, h = self._decode_core(params, cache, tok)
             if poison:
                 logits = jnp.full_like(logits, jnp.nan)
             sampled = sampling.sample(logits, key_t, temperature,
@@ -638,18 +681,27 @@ class InferenceEngine:
             new_cache = self._rebuild(cache, new_leaves,
                                       jnp.where(active, pos + 1, pos))
             next_tok = jnp.where(active, sampled, tok)
-            return (new_cache, next_tok, new_budget), (emit, active)
+            new_hid = jnp.where(active[:, None], h, hid) if rh else hid
+            return (new_cache, next_tok, new_budget, new_hid), (emit, active)
 
-        (cache, _, _), (toks, actives) = lax.scan(
-            step, (cache, tokens, budget), keys)
-        return (cache, jnp.swapaxes(toks, 0, 1),
-                jnp.sum(actives.astype(jnp.int32), axis=0))
+        (cache, _, _, hid), (toks, actives) = lax.scan(
+            step, (cache, tokens, budget, hid0), keys)
+        out = (cache, jnp.swapaxes(toks, 0, 1),
+               jnp.sum(actives.astype(jnp.int32), axis=0))
+        return out + (hid,) if rh else out
 
-    def _verify_impl(self, params, cache, tokens, key, eos_id, budget,
-                     temperature, top_k, top_p, poison=False):
+    def _verify_impl(self, params, cache, tokens, valid, key, eos_id,
+                     budget, temperature, top_k, top_p, poison=False):
         """The speculative verify pass: tokens [B, S] (S = spec_len + 1 —
         each slot's current last token followed by its spec_len drafted
-        continuation tokens), scored in ONE model dispatch.
+        continuation tokens), scored in ONE model dispatch. ``valid`` [B]
+        int32 is each slot's count of REAL fed tokens (its draft length
+        + 1) — the RAGGED hook: the compiled shape stays [B, spec_len+1]
+        while each slot speculates at its own controller-chosen length
+        (pad columns past ``valid`` are forced rejections in the accept
+        rule and masked out of the K/V write — kv_cache.cache_write's
+        ``draft_valid``); ``valid == S`` everywhere reproduces the
+        fixed-length verify bit for bit.
 
         All S positions embed at each slot's own offsets
         (``cache['lengths'] + 0..S-1``), their K/V are written into the
@@ -672,20 +724,25 @@ class InferenceEngine:
 
         Returns (cache, emitted [B, S], counts [B], accepted [B]) where
         ``accepted`` is the number of DRAFT tokens that made it into the
-        emitted stream (the accept-rate numerator).
+        emitted stream (the accept-rate numerator). A ``return_hidden``
+        engine appends hidden [B, H]: each slot's pre-final-norm hidden
+        state at the position whose logits produced its final emitted
+        token (row ``counts - 1``) — the learned drafter's next input.
         """
         B, S = tokens.shape
         pos0 = cache["lengths"]
         rows = pos0[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
-        new_leaves, logits = self._model_block(
-            params, cache, tokens, rows, pos0)  # logits [B, S, V]
+        new_leaves, logits, h = self._model_block(
+            params, cache, tokens, rows, pos0,
+            extra_meta={"draft_valid": valid})  # logits [B, S, V]
         if poison:
             # chaos only (trace-time): the build that proves
             # speculative_accept's sanitized argmax keeps the emitted
             # stream defined — decode_block's ``poison`` counterpart
             logits = jnp.full_like(logits, jnp.nan)
         emitted, counts = sampling.speculative_accept(
-            logits, tokens[:, 1:], key, temperature, top_k, top_p)
+            logits, tokens[:, 1:], key, temperature, top_k, top_p,
+            draft_len=valid - 1)
         raw = counts  # pre-clip: accepted drafts + 1 fresh token
         active = (pos0 > 0) & (budget > 0)
         counts = jnp.where(active, jnp.minimum(counts, budget), 0)
@@ -701,7 +758,13 @@ class InferenceEngine:
         accepted = jnp.minimum(raw - 1, counts)
         new_cache = self._rebuild(cache, new_leaves,
                                   jnp.where(active, pos0 + counts, pos0))
-        return new_cache, emitted, counts, accepted
+        out = (new_cache, emitted, counts, accepted)
+        if not self.return_hidden:
+            return out
+        # the last emitted token (greedy: == argmax over this row's
+        # logits) came from row counts - 1; clip covers inactive rows
+        idx = jnp.clip(counts - 1, 0, S - 1)[:, None, None]
+        return out + (jnp.take_along_axis(h, idx, axis=1)[:, 0],)
 
     def _prefill_chunk_impl(self, params, cache, tokens, slot, start, valid,
                             *sample):
@@ -747,9 +810,11 @@ class InferenceEngine:
         last = last.astype(jnp.float32)
         new_cache = {**new_leaves,
                      "lengths": lengths.at[slot].set(start + valid)}
-        if self.sample_on_device:
-            return new_cache, self._epilogue(last, *sample)
-        return new_cache, last
+        out = self._epilogue(last, *sample) if self.sample_on_device \
+            else last
+        if self.return_hidden:
+            return new_cache, out, h_last[:, 0]
+        return new_cache, out
 
     def _prefill_chunk_impl_paged(self, params, cache, tokens, slot, start,
                                   valid, *sample):
@@ -779,9 +844,11 @@ class InferenceEngine:
         last = last.astype(jnp.float32)
         new_cache = self._rebuild(cache, new_leaves,
                                   lengths.at[slot].set(start + valid))
-        if self.sample_on_device:
-            return new_cache, self._epilogue(last, *sample)
-        return new_cache, last
+        out = self._epilogue(last, *sample) if self.sample_on_device \
+            else last
+        if self.return_hidden:
+            return new_cache, out, h_last[:, 0]
+        return new_cache, out
 
     # ---- host-facing API ---------------------------------------------------
 
@@ -800,6 +867,63 @@ class InferenceEngine:
         if self.paged is not None:
             self.paged.reset()
         return self._init_cache_jit()
+
+    def make_draft_program(self, with_head: bool = False):
+        """Build the learned drafter's jitted dispatch (EAGLE-style —
+        Li et al. 2024: draft from the target's own last hidden state,
+        reusing its embedding and lm_head; Medusa-style cheap heads are
+        the degenerate no-trunk case). One small program proposes
+        ``spec_len`` greedy continuation tokens for EVERY slot:
+
+            (params[, head], hidden [B, H], tokens [B]) -> drafts [B, G]
+
+        Each step folds the current token's embedding into the running
+        pseudo-hidden state (``hidden + embed(tok)`` — the residual-merge
+        default that needs NO extra parameters, or ``tanh(concat(embed,
+        hidden) @ head['w'])`` when tiny-head params are supplied, e.g.
+        via ``checkpoint.load_params``), reads the shared LM head over it
+        (final norm included — the exact logits path the target uses) and
+        takes the argmax. Deterministic by construction, so the proposal
+        is the point-mass distribution ``sampling.speculative_accept``
+        assumes. No KV is read or written: the whole draft costs
+        ``spec_len`` embedding rows + head matmuls — the "small jitted
+        dispatch" next to a verify's full model pass."""
+        if self.spec_len < 1:
+            raise ValueError(
+                "make_draft_program needs a speculative engine "
+                "(spec_len > 0)")
+        G = self.spec_len
+        cfg = self.cfg
+
+        def impl(params, *args):
+            if with_head:
+                head, hidden, tok = args
+            else:
+                hidden, tok = args
+                head = None
+
+            def step(carry, _):
+                h, t = carry
+                e = llama.embed_lookup(
+                    params["embed"], t[:, None])[:, 0].astype(h.dtype)
+                if head is not None:
+                    x = jnp.tanh(jnp.concatenate([e, h], axis=-1)
+                                 @ head["w"].astype(h.dtype))
+                else:
+                    x = h + e
+                logits = tp_gather(
+                    llama.head_logits(params, x[:, None, :], cfg))[:, 0]
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (x, nxt), nxt
+
+            (_, _), out = lax.scan(step, (hidden, tok), None, length=G)
+            return jnp.swapaxes(out, 0, 1)  # [B, G]
+
+        head_spec = ({"w": P()},) if with_head else ()
+        return jax.jit(shard_map(
+            impl, self.topo.mesh,
+            in_specs=(self._pspecs,) + head_spec + (P(), P()),
+            out_specs=P()))
 
     # ---- paged-layout host plumbing ---------------------------------------
 
@@ -887,8 +1011,9 @@ class InferenceEngine:
         temperature, top_k, top_p)``), (kv_blocks, sampled token [1]
         int32): the fused epilogue draws the first generated token inside
         the dispatch and the full-vocab logits never cross to the host.
-        Pads to the prompt's bucket host-side; jit reuses one executable
-        per bucket size."""
+        A ``return_hidden`` engine appends the prompt's last-token
+        pre-final-norm hidden state [1, H]. Pads to the prompt's bucket
+        host-side; jit reuses one executable per bucket size."""
         samp = self._sample_args(sample)
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
@@ -930,6 +1055,7 @@ class InferenceEngine:
                 f"{ids.size} tokens")
         C = self.prefill_chunk
         logits = None
+        hidden = None
         for s0 in range(start, ids.size, C):
             end = min(s0 + C, ids.size)
             if self.paged is None:
@@ -957,13 +1083,20 @@ class InferenceEngine:
                 cache = self._ensure(cache, slot, w0, end)
                 cache = self._sync_tables(cache)
             self._hook("prefill_chunk")
-            cache, logits = self._dispatch(lambda: self._prefill_chunk_jit(
+            out = self._dispatch(lambda: self._prefill_chunk_jit(
                 params, cache, jnp.asarray(padded),
                 jnp.asarray(slot, jnp.int32),
                 jnp.asarray(w0, jnp.int32),
                 jnp.asarray(chunk.size, jnp.int32), *samp))
+            if self.return_hidden:
+                cache, logits, hidden = out
+            else:
+                cache, logits = out
             if self.paged is not None:
                 self.paged.set_len(slot, end)
+        if self.return_hidden:
+            # the FINAL chunk's last-token hidden state is the prompt's
+            return cache, logits, hidden
         return cache, logits
 
     def prefill_paged(self, params, cache, prompt_ids, slot: int,
@@ -988,24 +1121,32 @@ class InferenceEngine:
         ids = [int(t) for t in np.asarray(prompt_ids, np.int32).reshape(-1)]
         if not ids:
             raise ValueError("empty prompt")
+        rh = self.return_hidden
+        hidden = None
         cached = self.paged.match_prefix(slot, ids)
         if cached > 0:
             cache = self._set_length_jit(self._sync_tables(cache), slot,
                                          cached)
-            cache, logits = self.prefill_chunked(params, cache, ids, slot,
-                                                 start=cached,
-                                                 sample=sample)
+            out = self.prefill_chunked(params, cache, ids, slot,
+                                       start=cached, sample=sample)
+            cache, logits = out[:2]
+            hidden = out[2] if rh else None
             n = -(-(len(ids) - cached) // self.prefill_chunk)
         elif len(ids) <= self.prefill_chunk:
-            kv, logits = self.prefill(params, ids, sample=sample)
+            out = self.prefill(params, ids, sample=sample)
+            kv, logits = out[:2]
+            hidden = out[2] if rh else None
             cache = self.insert(cache, kv, slot, len(ids))
             n = 1
         else:
-            cache, logits = self.prefill_chunked(params, cache, ids, slot,
-                                                 sample=sample)
+            out = self.prefill_chunked(params, cache, ids, slot,
+                                       sample=sample)
+            cache, logits = out[:2]
+            hidden = out[2] if rh else None
             n = -(-len(ids) // self.prefill_chunk)
         self.paged.register_prompt(slot, ids)
-        return cache, logits, n, cached
+        base = (cache, logits, n, cached)
+        return base + (hidden,) if rh else base
 
     def insert(self, cache, kv, slot: int, length: int) -> dict:
         """Park a prefill's blocks into ``slot`` (consumes ``cache``).
@@ -1033,7 +1174,9 @@ class InferenceEngine:
         [slots] host or device arrays; returns (cache, next_tokens [slots],
         logits [slots, V] fp32). On a ``sample_on_device`` engine the
         logits slot is None — the [B, V] array never leaves the device
-        (the [B] token ids are the dispatch's whole host payload).
+        (the [B] token ids are the dispatch's whole host payload). A
+        ``return_hidden`` engine appends hidden [slots, H] (the step's
+        pre-final-norm hidden states — the learned drafter's input).
         Consumes ``cache``."""
         self._hook("decode")
         if self.paged is not None:
@@ -1048,6 +1191,9 @@ class InferenceEngine:
             # mirror the device rule: parked slots advanced by one
             self.paged.advance((self.paged.host_len > 0).astype(np.int64))
         if self.sample_on_device:
+            if self.return_hidden:
+                cache, toks, hid = out
+                return cache, toks, None, hid
             cache, toks = out
             return cache, toks, None
         return out
@@ -1058,8 +1204,9 @@ class InferenceEngine:
         ``keys`` is [decode_block_len, 2] (one PRNG key per in-block step);
         ``eos_id`` [slots] int32 (−1 = none), ``budget`` [slots] int32
         remaining tokens (0 for free slots). Returns (cache,
-        tokens [slots, decode_block_len], produced counts [slots]).
-        Consumes ``cache``."""
+        tokens [slots, decode_block_len], produced counts [slots]); a
+        ``return_hidden`` engine appends hidden [slots, H] — each slot's
+        hidden state at its last active step. Consumes ``cache``."""
         keys = jnp.asarray(keys)
         if keys.shape[0] != self.decode_block_len:
             raise ValueError(
@@ -1088,16 +1235,23 @@ class InferenceEngine:
         return out
 
     def verify(self, params, cache, tokens, key, eos_id, budget,
-               temperature, top_k, top_p) -> tuple:
+               temperature, top_k, top_p, draft_len=None) -> tuple:
         """One speculative draft-verify dispatch for every slot
         (``spec_len > 0`` engines only). ``tokens`` is
         [slots, spec_len + 1] int32 — column 0 is each slot's current last
         token, columns 1..spec_len its drafted continuation; the remaining
         arguments are [slots] arrays exactly as ``decode_block`` takes
-        them. Returns (cache, emitted [slots, spec_len + 1], counts
+        them. ``draft_len`` [slots] int32 (optional) makes the dispatch
+        RAGGED: slot b proposed only ``draft_len[b] <= spec_len`` real
+        drafts (the controller's per-slot choice) — pad columns past it
+        are masked out of acceptance and the K/V write while the compiled
+        shape stays [slots, spec_len + 1], so mixed per-slot lengths cost
+        no recompile. None = every slot drafted the full spec_len.
+        Returns (cache, emitted [slots, spec_len + 1], counts
         [slots], accepted-draft counts [slots]) — ``counts[b]`` leading
         entries of emitted row b are the tokens slot b produced this
-        dispatch (1..spec_len + 1 per active slot). Consumes ``cache``."""
+        dispatch (1..spec_len + 1 per active slot); a ``return_hidden``
+        engine appends hidden [slots, H]. Consumes ``cache``."""
         if self._verify_jit is None:
             raise ValueError(
                 "speculative decoding is off for this engine (spec_len == "
@@ -1109,6 +1263,19 @@ class InferenceEngine:
                 f"verify tokens must be [slots, spec_len + 1] = "
                 f"[{self.slots}, {self.spec_len + 1}]; got "
                 f"{tokens.shape}")
+        if draft_len is None:
+            valid = np.full(self.slots, self.spec_len + 1, np.int32)
+        else:
+            draft_len = np.asarray(draft_len, np.int32)
+            if draft_len.shape != (self.slots,):
+                raise ValueError(
+                    f"draft_len must be [slots] = [{self.slots}]; got "
+                    f"{draft_len.shape}")
+            if np.any(draft_len < 0) or np.any(draft_len > self.spec_len):
+                raise ValueError(
+                    f"draft_len entries must be in [0, spec_len = "
+                    f"{self.spec_len}]; got {draft_len.tolist()}")
+            valid = draft_len + 1
         self._hook("verify", budget)
         poison = self._poison("verify")
         if self.paged is not None:
@@ -1119,7 +1286,7 @@ class InferenceEngine:
             cache = self._pre_write(cache, self.spec_len + 1)
         # resolved inside the lambda, exactly like decode_block's program
         out = self._dispatch(lambda: self._verify_prog(poison)(
-            params, cache, jnp.asarray(tokens), key,
+            params, cache, jnp.asarray(tokens), jnp.asarray(valid), key,
             jnp.asarray(np.asarray(eos_id, np.int32)),
             jnp.asarray(np.asarray(budget, np.int32)),
             jnp.asarray(np.asarray(temperature, np.float32)),

@@ -148,17 +148,30 @@ def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
     int8 caches quantize on write; the scale rows land at the same
     positions in ``k_scale``/``v_scale``.
 
+    RAGGED verify (the per-slot spec_len controller): a ``draft_valid``
+    [B] int32 entry in ``layer_cache`` (spliced per dispatch by
+    engine._verify_impl, never a stored leaf) caps each slot's write at
+    its own count of REAL fed tokens — rows at or past it are redirected
+    out of the window and DROP under jax's out-of-bounds scatter
+    semantics, so a short-drafting slot never parks another slot's pad
+    junk. Only the batched scatter honors it (the verify shape); the
+    B == 1 dynamic-slice branch writes its whole block as before (a
+    one-slot verify's pad rows land beyond the post-acceptance length,
+    stale and unreachable — the pre-ragged contract).
+
     Paged caches (``inference.kv_layout: "paged"`` — the per-layer dict
     carries ``block_tables``) route to the page-indirect scatter
     (inference/paged_kv.py): same three write shapes, rows land in pool
-    pages instead of a contiguous strip.
+    pages instead of a contiguous strip (ragged rows hit the NULL page).
     """
     if "block_tables" in layer_cache:
         from picotron_tpu.inference import paged_kv
 
         return paged_kv.cache_write(layer_cache, k_new, v_new, pos)
-    B, S = k_new.shape[0], k_new.shape[1]
     out = dict(layer_cache)
+    valid = out.pop("draft_valid", None)
+    B, S = k_new.shape[0], k_new.shape[1]
+    T = layer_cache["k"].shape[1]
 
     def store(name, sname, new):
         if quantized(layer_cache):
@@ -181,6 +194,11 @@ def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
                     (0, start, 0))
         else:
             rows = pos[:, None] + jnp.arange(S, dtype=pos.dtype)[None, :]
+            if valid is not None:
+                # ragged mask: rows past the slot's own real-token count
+                # go out of bounds, where the scatter drops them
+                cols = jnp.arange(S, dtype=jnp.int32)[None, :]
+                rows = jnp.where(cols < valid[:, None], rows, T)
             bidx = jnp.arange(B)[:, None]
             out[name] = layer_cache[name].at[bidx, rows].set(vals)
             if scales is not None:

@@ -179,13 +179,23 @@ def cache_write(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
     exclusively owned by the writing slot (COW ran before the dispatch),
     so a shared page's bytes are never mutated — including by the
     speculative verify's optimistic writes that a rollback later strands.
+
+    A ``draft_valid`` [B] int32 entry (the ragged-verify mask, spliced
+    per dispatch — see kv_cache.cache_write) caps each slot's write at
+    its own real-token count: masked rows are pushed out of the logical
+    window, which ``_targets`` routes to the NULL page.
     """
+    out = dict(layer_cache)
+    valid = out.pop("draft_valid", None)
     B, S = k_new.shape[0], k_new.shape[1]
     bt = layer_cache["block_tables"]  # [B, max_pages] int32
     page_len = layer_cache["k"].shape[1]
     rows = pos[:, None].astype(jnp.int32) + jnp.arange(S, dtype=jnp.int32)
+    if valid is not None and S > 1:
+        cols = jnp.arange(S, dtype=jnp.int32)[None, :]
+        rows = jnp.where(cols < valid[:, None], rows,
+                         bt.shape[-1] * page_len)
     pid, off = _targets(bt, rows, page_len)  # [B, S] each
-    out = dict(layer_cache)
     policy = is_policy(layer_cache)
 
     def store(name, qname, sname, new):

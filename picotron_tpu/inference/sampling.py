@@ -28,6 +28,8 @@ the epilogue seeded-identical to the host sampler by construction.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -167,7 +169,8 @@ def _leading_true(ok: jnp.ndarray) -> jnp.ndarray:
 
 def speculative_accept(logits: jnp.ndarray, draft: jnp.ndarray, key,
                        temperature: jnp.ndarray, top_k: jnp.ndarray,
-                       top_p: jnp.ndarray) -> tuple:
+                       top_p: jnp.ndarray,
+                       draft_len: Optional[jnp.ndarray] = None) -> tuple:
     """Distribution-preserving draft acceptance (Leviathan et al. 2023 /
     Chen et al. 2023 speculative sampling, specialized to a DETERMINISTIC
     drafter: the proposal q is a point mass at the drafted token, so the
@@ -193,14 +196,29 @@ def speculative_accept(logits: jnp.ndarray, draft: jnp.ndarray, key,
     bit-identical to non-speculative greedy decode. An all-greedy batch
     (the serving default) short-circuits past the filter/softmax/draw
     pipeline entirely.
+
+    ``draft_len`` [B] int32 (optional) makes the verify RAGGED: row b
+    proposed only ``draft_len[b] <= gamma`` real drafts, the rest of its
+    draft row is pad. Columns at or past a row's draft_len are forced
+    mismatches — never accepted, never treated as a rejection event — so
+    the fresh token draws from position ``min(acc, draft_len)``'s own
+    distribution: a row with draft_len 0 reduces exactly to one
+    non-speculative decode step (counts == 1), and every row's emitted
+    run is the one its own draft length would have produced solo. None =
+    every row drafted the full gamma (the pre-ragged contract).
     """
     B, S, V = logits.shape
     G = S - 1
+    cols_g = jnp.arange(G, dtype=jnp.int32)[None, :]
+    real = (cols_g < draft_len[:, None]) if draft_len is not None else None
     # sanitized argmax: a poisoned verify row degrades to a defined greedy
     # chain instead of NaN-ordering garbage (identity on finite logits)
     preds = greedy(sanitize_logits(
         logits.reshape(B * S, V))).reshape(B, S)  # [B, S] argmax
-    acc_greedy = _leading_true(draft == preds[:, :G])
+    ok_greedy = draft == preds[:, :G]
+    if real is not None:
+        ok_greedy &= real
+    acc_greedy = _leading_true(ok_greedy)
     last_greedy = jnp.take_along_axis(
         preds, acc_greedy[:, None], axis=1)[:, 0]
 
@@ -217,7 +235,12 @@ def speculative_accept(logits: jnp.ndarray, draft: jnp.ndarray, key,
         p_draft = jnp.take_along_axis(
             probs[:, :G], draft[:, :, None], axis=-1)[..., 0]  # [B, G]
         u = jax.random.uniform(key_u, (B, G))
-        acc = _leading_true(u < p_draft)
+        ok = u < p_draft
+        if real is not None:
+            # ragged rows: pad columns can neither accept nor count as a
+            # rejection — acceptance simply ends at the row's draft_len
+            ok &= real
+        acc = _leading_true(ok)
         # the fresh token's distribution: the residual at the rejection
         # position (p with the rejected draft token removed, renormalized),
         # or the untouched bonus-position p when every draft accepted
@@ -225,8 +248,11 @@ def speculative_accept(logits: jnp.ndarray, draft: jnp.ndarray, key,
                                      axis=1)[:, 0]  # [B, V]
         rej = jnp.take_along_axis(
             draft, jnp.minimum(acc, G - 1)[:, None], axis=1)[:, 0]
+        # a rejection EVENT happened iff acceptance stopped before the
+        # row's own draft run ended (ragged rows: before draft_len, not G)
+        rejected = (acc < draft_len) if draft_len is not None else (acc < G)
         strip = ((jnp.arange(V)[None, :] == rej[:, None])
-                 & (acc < G)[:, None])
+                 & rejected[:, None])
         res = jnp.where(strip, 0.0, p_next)
         res = res / jnp.maximum(jnp.sum(res, axis=-1, keepdims=True), 1e-20)
         fresh = jax.random.categorical(

@@ -38,6 +38,13 @@ API (all bodies JSON):
   front-end registry plus the process-wide resilience counters
   (picotron_tpu/obs, docs/OBSERVABILITY.md). The counters are the SAME
   instruments ``/statz`` reads, so the two surfaces cannot disagree.
+  Speculative engines additionally export ``picotron_spec_accept_rate``
+  and ``picotron_spec_len`` gauges, refreshed on render exactly like the
+  queue-depth gauges (batcher.refresh_gauges) — the fabric's router can
+  see each replica's live speculation health off the scrape, and
+  ``/statz`` mirrors them as ``accept_rate`` / ``spec_len_effective``
+  (plus the controller's decision counts when
+  ``inference.spec_controller`` is on).
 - ``GET /tracez`` — the process span ring as Chrome-trace JSON: each
   request's queue-wait -> prefill -> per-dispatch -> delivery chain,
   parented. Validate/query with ``tools/trace_dump.py``.

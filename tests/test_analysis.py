@@ -149,6 +149,45 @@ def test_j001_negative_subscript_index_stays_untainted(tmp_path):
     assert found == []
 
 
+def test_j001_hidden_state_hook_shape(tmp_path):
+    """The ISSUE-14 return_hidden hook shape: a jitted verify-like body
+    that scans a hidden-state carry and selects the row the traced
+    counts point at (take_along_axis over clip(counts - 1)) must stay
+    SILENT — all on-device ops; the hazard variant (host-syncing the
+    traced hidden/counts with float()/np.asarray inside the program)
+    must be caught by exactly J001."""
+    found = _scan(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def verify(h, counts):
+            def step(carry, x):
+                hid = carry
+                active = counts > 0
+                hid = jnp.where(active[:, None], x, hid)
+                return hid, None
+            hid, _ = lax.scan(step, h[:, 0], jnp.swapaxes(h, 0, 1))
+            idx = jnp.clip(counts - 1, 0, h.shape[1] - 1)[:, None, None]
+            return jnp.take_along_axis(h, idx, axis=1)[:, 0], hid
+        """)
+    assert found == []
+
+    bad = _scan(tmp_path, """
+        import jax
+        import numpy as np
+        import jax.numpy as jnp
+
+        @jax.jit
+        def verify(h, counts):
+            sel = np.asarray(h)          # host sync on the traced hidden
+            return sel[float(counts[0])]  # and on the traced count
+        """, name="fix_bad.py")
+    assert _rules(bad) == ["PICO-J001"]
+    assert len(bad) == 2
+
+
 # --------------------------------------------------------------------------- #
 # PICO-J002: host nondeterminism under trace
 # --------------------------------------------------------------------------- #
@@ -297,6 +336,46 @@ def test_j003_lambda_body(tmp_path):
                 0, 4, lambda j, acc: acc + pl.program_id(0), 0)
         """)
     assert _rules(found) == ["PICO-J003"]
+
+
+def test_j003_ragged_mask_loop_shape(tmp_path):
+    """The ISSUE-14 ragged-verify kernel shape: a per-slot fori_loop whose
+    body builds a where-mask from the loop index and a valid-count row.
+    The shipped form (slot id resolved OUTSIDE the loop, mask from jnp
+    ops inside) must stay silent; reading program_id inside the masked
+    body is the J003 hazard and must be caught — precision both ways, so
+    the baseline stays empty."""
+    found = _scan(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(v_ref, k_ref, o_ref):
+            def body(j, acc):
+                b = pl.program_id(0)  # the trap: resolve before the loop
+                cols = jnp.arange(8)
+                rows = jnp.where(cols < v_ref[b], cols, 8)
+                return acc + k_ref[pl.ds(j * 8, 8), :] * rows[:, None]
+            o_ref[:] = lax.fori_loop(0, 4, body, 0.0)
+        """)
+    assert _rules(found) == ["PICO-J003"]
+
+    clean = _scan(tmp_path, """
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(v_ref, k_ref, o_ref):
+            b = pl.program_id(0)
+            valid = v_ref[b]
+
+            def body(j, acc):
+                cols = jnp.arange(8)
+                rows = jnp.where(cols < valid, cols, 8)
+                return acc + k_ref[pl.ds(j * 8, 8), :] * rows[:, None]
+            o_ref[:] = lax.fori_loop(0, 4, body, 0.0)
+        """, name="fix_clean.py")
+    assert clean == []
 
 
 # --------------------------------------------------------------------------- #

@@ -30,17 +30,21 @@ import jax
 import jax.numpy as jnp
 
 from conftest import make_config
-from picotron_tpu.config import Config
+from picotron_tpu.config import Config, SpecControllerConfig
 from picotron_tpu.inference import (
     ContinuousBatcher,
     InferenceEngine,
+    LearnedDrafter,
     NgramDrafter,
     Request,
+    SpecController,
+    init_draft_head,
     kv_cache,
     sampling,
 )
 from picotron_tpu.inference.speculative import Drafter
 from picotron_tpu.models import llama
+from picotron_tpu.obs.metrics import MetricsRegistry
 
 MAX_LEN = 96
 
@@ -423,3 +427,549 @@ def test_spec_config_validation(tiny_model_kwargs):
     assert InferenceEngine(cfg, max_seq_len=MAX_LEN).spec_len == 3
     assert InferenceEngine(cfg, max_seq_len=MAX_LEN,
                            spec_len=0).spec_len == 0
+
+
+def test_controller_and_drafter_config_validation():
+    with pytest.raises(ValueError, match="drafter"):
+        Config.from_dict({"inference": {"drafter": "oracle"}})
+    with pytest.raises(ValueError, match="spec_history_window"):
+        Config.from_dict({"inference": {"spec_history_window": -1}})
+    with pytest.raises(ValueError, match="spec_len > 0"):
+        Config.from_dict(
+            {"inference": {"spec_controller": {"enabled": True}}})
+    with pytest.raises(ValueError, match="low"):
+        Config.from_dict({"inference": {
+            "spec_len": 4,
+            "spec_controller": {"low": 0.9, "target": 0.5}}})
+    with pytest.raises(ValueError, match="hysteresis"):
+        Config.from_dict({"inference": {
+            "spec_len": 4, "spec_controller": {"hysteresis": 0}}})
+    # the nested block round-trips through to_dict/from_dict (the engine's
+    # inference_config() path)
+    cfg = Config.from_dict({
+        "dataset": {"name": "synthetic"},
+        "inference": {"spec_len": 4, "drafter": "learned",
+                      "spec_controller": {"enabled": True, "window": 8}}})
+    cfg2 = Config.from_dict(cfg.to_dict())
+    assert cfg2.inference.spec_controller.window == 8
+    assert cfg2.inference.drafter == "learned"
+
+
+# --------------------------------------------------------------------------- #
+# incremental n-gram index == full rebuild
+# --------------------------------------------------------------------------- #
+
+
+def test_ngram_incremental_matches_full_rebuild():
+    """The append-only per-request index (ctx path) must answer every
+    lookup exactly like the stateless full suffix scan, across growing
+    histories — windowed and unbounded."""
+    rng = np.random.default_rng(7)
+    for window in (0, 12):
+        inc = NgramDrafter(3, window=window)
+        ref = NgramDrafter(3, window=window)
+        inc.begin("r")
+        hist = list(rng.integers(0, 6, 5))
+        for round_ in range(40):
+            h = np.asarray(hist, np.int32)
+            got = inc.propose(h, 4, ctx="r")
+            want = ref.propose(h, 4)  # stateless: full rebuild each call
+            np.testing.assert_array_equal(got, want,
+                                          err_msg=f"w={window} r={round_}")
+            # append-only growth, mixing repeats (matches) and fresh noise
+            if round_ % 3 == 0:
+                hist.extend(hist[-3:])
+            hist.append(int(rng.integers(0, 6)))
+        inc.forget("r")
+        assert "r" not in inc._idx
+
+
+def test_ngram_window_caps_match_scan():
+    """A match whose continuation lives beyond the window must be ignored
+    (falls back to shorter grams / last-token repeat)."""
+    hist = np.asarray([7, 8, 9, 1, 1, 1, 1, 1, 1, 1, 7, 8], np.int32)
+    # unbounded: suffix [7, 8] matches at position 0 -> proposes 9
+    assert NgramDrafter(2).propose(hist, 1)[0] == 9
+    # window 4: that match is out of reach; 1-gram 8 has no earlier
+    # occurrence in the window either -> last-token fallback (8)
+    assert NgramDrafter(2, window=4).propose(hist, 1)[0] == 8
+    # the incremental path applies the same cap
+    d = NgramDrafter(2, window=4)
+    assert d.propose(hist, 1, ctx="x")[0] == 8
+
+
+def test_ngram_stale_ctx_rebuilds_on_shrunk_history():
+    """A slot recycled without begin() (history shrinks) must not answer
+    from the dead request's index."""
+    d = NgramDrafter(3)
+    long_h = np.asarray([1, 2, 3, 4, 5, 1, 2, 3, 4], np.int32)
+    d.propose(long_h, 2, ctx="s")
+    short_h = np.asarray([9, 8], np.int32)
+    np.testing.assert_array_equal(
+        d.propose(short_h, 2, ctx="s"),
+        NgramDrafter(3).propose(short_h, 2))
+
+
+# --------------------------------------------------------------------------- #
+# ragged verify: per-slot draft lengths in ONE dispatch
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("tp,impl,layout,quant,temp", [
+    (1, "dense", "contiguous", False, 0.0),
+    (1, "dense", "contiguous", True, 0.0),
+    (1, "dense", "contiguous", False, 1.0),
+    (1, "flash", "contiguous", False, 0.0),
+    (1, "dense", "paged", False, 0.0),
+    (1, "flash", "paged", True, 0.0),
+    (2, "dense", "contiguous", False, 0.0),
+    (2, "dense", "paged", True, 0.0),
+])
+def test_ragged_verify_matches_per_slot_sequential(tiny_model_kwargs, tp,
+                                                   impl, layout, quant,
+                                                   temp):
+    """One RAGGED verify dispatch (per-slot draft_len) must emit, count,
+    accept, and advance lengths exactly as per-slot SEQUENTIAL solo
+    verifies (each slot alone with its own draft length) — across tp,
+    attend kernels, KV layouts, and int8 storage. Row b's acceptance
+    depends only on row b's logits and the shared key, so the group
+    dispatch is the sum of its solo parts."""
+    slots = 3
+    cfg, engine = _engine(
+        tiny_model_kwargs, tp=tp, slots=slots, spec_len=4,
+        attend_impl=impl, kv_layout=layout,
+        cache_dtype="int8" if quant else None)
+    params = _params(cfg, engine)
+    prompts = [[1, 2, 3, 1, 2, 3], [9, 8, 7, 6], [4, 4, 5]]
+    draft_len = np.asarray([3, 1, 0], np.int32)
+    rng = np.random.default_rng(0)
+    drafts = rng.integers(1, cfg.model.vocab_size,
+                          (slots, engine.spec_len)).astype(np.int32)
+    key = jax.random.PRNGKey(5)
+    eos = np.full(slots, -1, np.int32)
+    temps = np.full(slots, temp, np.float32)
+    tk = np.full(slots, 4 if temp > 0 else 0, np.int32)
+    tp_ = np.ones(slots, np.float32)
+
+    def one_run(budget):
+        """Fresh cache + parked prompts, one verify dispatch."""
+        cache = engine.init_cache()
+        for s, p in enumerate(prompts):
+            if layout == "paged":
+                out = engine.prefill_paged(params, cache, p, s)
+                cache = out[0]
+            else:
+                kv, _ = engine.prefill(params, p)
+                cache = engine.insert(cache, kv, s, len(p))
+        tokens = np.concatenate(
+            [np.asarray([[p[-1]] for p in prompts], np.int32), drafts],
+            axis=1)
+        cache, emitted, counts, accepted = engine.verify(
+            params, cache, tokens, key, eos, budget, temps, tk, tp_,
+            draft_len=draft_len)
+        return (np.asarray(emitted), np.asarray(counts),
+                np.asarray(accepted), np.asarray(cache["lengths"]))
+
+    full_budget = np.asarray([8, 2, 8], np.int32)  # slot 1: budget clip
+    g_em, g_ct, g_ac, g_len = one_run(full_budget)
+    for s in range(slots):
+        solo = np.zeros(slots, np.int32)
+        solo[s] = full_budget[s]
+        em, ct, ac, ln = one_run(solo)
+        assert ct[s] == g_ct[s], (s, ct, g_ct)
+        assert ac[s] == g_ac[s]
+        np.testing.assert_array_equal(em[s], g_em[s])
+        assert ln[s] == g_len[s]
+    # the ragged contract itself: counts bounded by the slot's own draft
+    assert np.all(g_ct <= draft_len + 1)
+    assert g_ct[2] == 1  # a 0-draft slot is exactly one decode step
+    assert np.all(g_ac <= draft_len)
+
+
+def test_ragged_zero_draft_row_matches_decode_step(tiny_model_kwargs):
+    """A draft_len == 0 row through the RAGGED verify must emit exactly
+    the greedy decode_step token — pad drafts can never leak in."""
+    cfg, engine = _engine(tiny_model_kwargs, slots=2, spec_len=3)
+    params = _params(cfg, engine)
+    prompts = [[1, 2, 3, 4], [5, 6, 7]]
+
+    def park():
+        cache = engine.init_cache()
+        for s, p in enumerate(prompts):
+            kv, _ = engine.prefill(params, p)
+            cache = engine.insert(cache, kv, s, len(p))
+        return cache
+
+    args = (np.full(2, -1, np.int32), np.full(2, 8, np.int32),
+            np.zeros(2, np.float32), np.zeros(2, np.int32),
+            np.ones(2, np.float32))
+    key = jax.random.PRNGKey(0)
+    _, want, _ = engine.decode_step(
+        params, park(), np.asarray([4, 7], np.int32), key, *args[2:])
+    want = np.asarray(want)  # greedy: the sampled token IS the argmax
+    tokens = np.asarray([[4, 111, 112, 113], [7, 114, 115, 116]], np.int32)
+    _, emitted, counts, _ = engine.verify(
+        params, park(), tokens, key, *args,
+        draft_len=np.zeros(2, np.int32))
+    counts = np.asarray(counts)
+    np.testing.assert_array_equal(counts, [1, 1])
+    np.testing.assert_array_equal(np.asarray(emitted)[:, 0], want)
+
+
+# --------------------------------------------------------------------------- #
+# the learned drafter (EAGLE-style head over the target's hidden state)
+# --------------------------------------------------------------------------- #
+
+
+def _np_head(params_np, h, eps):
+    """The target's logits path over a hidden state, in numpy: final
+    RMSNorm then the shared lm_head — the oracle for the return_hidden
+    hook's contract."""
+    w = params_np["final_norm"].astype(np.float64)
+    x = h.astype(np.float64)
+    x = x / np.sqrt(np.mean(x * x, axis=-1, keepdims=True) + eps) * w
+    return x @ params_np["lm_head"].astype(np.float64)
+
+
+def test_return_hidden_is_the_logits_producing_state(tiny_model_kwargs):
+    """The hook's contract, pinned against the model's own head: the
+    hidden state every dispatch returns is the one whose (final-norm +
+    lm_head) logits produced that slot's last emitted token — prefill,
+    decode_block, and verify (ragged rows included)."""
+    cfg, engine = _engine(tiny_model_kwargs, slots=2, spec_len=3,
+                          drafter="learned", decode_block_len=4)
+    assert engine.return_hidden
+    params = _params(cfg, engine)
+    params_np = jax.tree.map(np.asarray, jax.device_get(params))
+    eps = cfg.model.rms_norm_eps
+
+    # prefill: returned logits == head(returned hidden)
+    prompt = [1, 2, 3, 4, 5]
+    kv, logits, hid = engine.prefill(params, prompt)
+    np.testing.assert_allclose(
+        _np_head(params_np, np.asarray(hid), eps)[0],
+        np.asarray(logits)[0], rtol=1e-4, atol=1e-4)
+
+    cache = engine.insert(engine.init_cache(), kv, 0, len(prompt))
+    kv2, logits2, _ = engine.prefill(params, [9, 8])
+    cache = engine.insert(cache, kv2, 1, 2)
+    first = np.asarray([int(np.argmax(np.asarray(logits)[0])),
+                        int(np.argmax(np.asarray(logits2)[0]))], np.int32)
+
+    # decode_block: argmax(head(hidden)) == the slot's last emitted token
+    keys = np.stack([np.asarray(jax.random.PRNGKey(i)) for i in range(4)])
+    args = (np.full(2, -1, np.int32), np.asarray([4, 2], np.int32),
+            np.zeros(2, np.float32), np.zeros(2, np.int32),
+            np.ones(2, np.float32))
+    cache, toks, counts, hid = engine.decode_block(
+        params, cache, first, keys, *args)
+    toks, counts = np.asarray(toks), np.asarray(counts)
+    for s in range(2):
+        last = toks[s, counts[s] - 1]
+        assert np.argmax(_np_head(params_np,
+                                  np.asarray(hid)[s][None], eps)[0]) == last
+
+    # verify (ragged): same invariant, draft lengths [2, 0]
+    last_toks = np.asarray([toks[s, counts[s] - 1] for s in range(2)],
+                           np.int32)
+    tokens = np.zeros((2, 4), np.int32)
+    tokens[:, 0] = last_toks
+    tokens[0, 1:3] = [7, 7]
+    cache, emitted, vcounts, _, vhid = engine.verify(
+        params, cache, tokens, jax.random.PRNGKey(9),
+        np.full(2, -1, np.int32), np.full(2, 8, np.int32),
+        np.zeros(2, np.float32), np.zeros(2, np.int32),
+        np.ones(2, np.float32), draft_len=np.asarray([2, 0], np.int32))
+    emitted, vcounts = np.asarray(emitted), np.asarray(vcounts)
+    for s in range(2):
+        last = emitted[s, vcounts[s] - 1]
+        assert np.argmax(_np_head(params_np,
+                                  np.asarray(vhid)[s][None], eps)[0]) == last
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_learned_drafter_greedy_bit_identical(tiny_model_kwargs, tp):
+    """Greedy batcher streams with the learned drafter (whatever it
+    proposes) must equal the spec-off streams token for token — the
+    acceptance rule's guarantee holds for the new drafter + hidden
+    plumbing, on tp=1 and a tp=2 mesh."""
+    cfg, eng_off = _engine(tiny_model_kwargs, tp=tp)
+    _, eng_on = _engine(tiny_model_kwargs, tp=tp, spec_len=3,
+                        drafter="learned")
+    params = _params(cfg, eng_off)
+    reqs = [Request("a", [1, 2, 3, 1, 2, 3, 1, 2], max_new_tokens=17),
+            Request("b", [9, 8, 7], max_new_tokens=6)]
+    want = ContinuousBatcher(eng_off, params).run(reqs)
+    b = ContinuousBatcher(eng_on, params)
+    assert b.drafter.kind == "learned"
+    got = b.run(reqs)
+    for r in reqs:
+        assert got[r.uid].tokens == want[r.uid].tokens, (r.uid, tp)
+        assert got[r.uid].drafter == "learned"
+    assert b.draft_proposed > 0  # it really drafted
+
+
+def test_learned_drafter_deterministic_and_head_variant(tiny_model_kwargs):
+    """propose_batch is a deterministic function of (hidden, token) —
+    the point-mass contract the accept rule assumes — and the optional
+    tiny-head params change the proposal function without breaking it."""
+    cfg, engine = _engine(tiny_model_kwargs, slots=2, spec_len=4,
+                          drafter="learned")
+    params = _params(cfg, engine)
+    d = LearnedDrafter(engine, params)
+    hidden = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 64)).astype(np.float32))
+    toks = np.asarray([5, 9], np.int32)
+    a = d.propose_batch(toks, hidden, 4)
+    b = d.propose_batch(toks, hidden, 4)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 4) and a.dtype == np.int32
+    assert np.all((a >= 0) & (a < cfg.model.vocab_size))
+    with pytest.raises(ValueError, match="spec_len"):
+        d.propose_batch(toks, hidden, 2)
+    with pytest.raises(TypeError, match="propose_batch"):
+        d.propose(np.asarray([1, 2]), 4)
+    # tiny-head variant (the shape checkpoint.load_params would restore)
+    head = init_draft_head(jax.random.PRNGKey(1), cfg.model.hidden_size)
+    dh = LearnedDrafter(engine, params, head=head)
+    c = dh.propose_batch(toks, hidden, 4)
+    assert c.shape == (2, 4)
+    np.testing.assert_array_equal(c, dh.propose_batch(toks, hidden, 4))
+    # a spec-off / hidden-less engine is rejected with the fix named
+    _, plain = _engine(tiny_model_kwargs)
+    with pytest.raises(ValueError, match="spec"):
+        LearnedDrafter(plain, params)
+    _, no_hidden = _engine(tiny_model_kwargs, spec_len=3)
+    with pytest.raises(ValueError, match="return_hidden"):
+        LearnedDrafter(no_hidden, params)
+
+
+# --------------------------------------------------------------------------- #
+# the spec controller: hysteresis, convergence, switching, cost model
+# --------------------------------------------------------------------------- #
+
+
+def _controller(reg=None, *, kinds=("ngram",), gmax=4, block_len=8, **kw):
+    cfg = SpecControllerConfig(enabled=True, **kw)
+    reg = reg if reg is not None else MetricsRegistry()
+    c = SpecController(cfg, reg, slots=1, max_spec_len=gmax,
+                       block_len=block_len, kinds=kinds)
+    c.reset(0)
+    return c, reg
+
+
+def _feed(c, reg, proposed, accepted):
+    """One round's worth of counters into the registry (what the batcher
+    writes), then the controller's policy tick."""
+    reg.counter("picotron_slot_draft_proposed_total",
+                slot="0").inc(proposed)
+    reg.counter("picotron_slot_draft_accepted_total",
+                slot="0").inc(accepted)
+    c.record(0, proposed, accepted)
+    c.after_round(0)
+
+
+def test_controller_hysteresis_no_oscillation():
+    """Adversarial accept-rate flip-flop traffic: full-accept windows
+    alternating with zero-accept windows. The direction alternates every
+    evaluation, the hysteresis streak never completes, and spec_len must
+    NOT move — not once."""
+    c, reg = _controller(window=4, hysteresis=2, cooloff=1000)
+    g0 = int(c.lens()[0])
+    for i in range(40):
+        _feed(c, reg, 4, 4 if i % 2 == 0 else 0)
+        assert int(c.lens()[0]) == g0, f"oscillated at round {i}"
+    assert not c.decisions  # no ramp was ever applied
+
+
+def test_controller_ramps_down_to_off_and_probes():
+    """Persistently hard traffic: halve per hysteresis streak down to 1,
+    then (single drafter) OFF; after cooloff idle rounds the controller
+    re-probes with a 1-token draft."""
+    c, reg = _controller(window=4, hysteresis=2, low=0.25, cooloff=3)
+    seen = [int(c.lens()[0])]
+    for _ in range(30):
+        if int(c.lens()[0]) == 0:
+            break
+        _feed(c, reg, max(int(c.lens()[0]), 1), 0)
+        seen.append(int(c.lens()[0]))
+    assert seen[0] == 4 and 2 in seen and 1 in seen
+    assert int(c.lens()[0]) == 0
+    assert c.decisions.get("spec_off") == 1
+    # monotone on persistent signal: never back up mid-descent
+    assert all(a >= b for a, b in zip(seen, seen[1:]))
+    for _ in range(3):  # cooloff rounds at 0
+        c.after_round(0)
+    assert int(c.lens()[0]) == 1  # the probe
+    assert c.decisions.get("probe") == 1
+
+
+def test_controller_ramps_up_on_easy_traffic():
+    c, reg = _controller(window=2, hysteresis=2, target=0.5, cooloff=1000)
+    # drive down to 1 first
+    while int(c.lens()[0]) > 1:
+        _feed(c, reg, max(int(c.lens()[0]), 1), 0)
+    # then full acceptance doubles back to the ceiling
+    for _ in range(20):
+        g = int(c.lens()[0])
+        _feed(c, reg, max(g, 1), max(g, 1))
+    assert int(c.lens()[0]) == 4
+    assert c.decisions.get("ramp_up", 0) >= 2
+
+
+def test_controller_switches_drafter_before_giving_up():
+    """With a learned primary and the n-gram fallback registered, a slot
+    losing at spec_len 1 tries the OTHER drafter before turning
+    speculation off."""
+    c, reg = _controller(window=2, hysteresis=1, kinds=("learned", "ngram"),
+                         cooloff=1000)
+    assert c.drafter_kinds()[0] == "learned"
+    switched = False
+    for _ in range(30):
+        if int(c.lens()[0]) == 0:
+            break
+        _feed(c, reg, max(int(c.lens()[0]), 1), 0)
+        if c.drafter_kinds()[0] == "ngram":
+            switched = True
+    assert switched and c.decisions.get("switch_drafter") == 1
+    assert int(c.lens()[0]) == 0  # both tried and bad -> off
+
+
+def test_controller_latency_term_vetoes_losing_speculation():
+    """Once the dispatch-latency histograms hold enough samples, a
+    measured verify cost that can't beat blocked decode forces the ramp
+    DOWN even at full acceptance — speculation must PAY, not just
+    accept."""
+    c, reg = _controller(window=2, hysteresis=1, latency_min_samples=4,
+                         block_len=8)
+    hv = reg.histogram("picotron_dispatch_seconds",
+                       "dispatch wall time incl. host sync, by kind",
+                       kind="verify")
+    hd = reg.histogram("picotron_dispatch_seconds",
+                       "dispatch wall time incl. host sync, by kind",
+                       kind="decode")
+    for _ in range(8):
+        hv.observe(0.2)   # a verify costs 0.2s for <= 5 tokens
+        hd.observe(0.08)  # a block of 8 tokens costs 0.08s
+    for _ in range(10):
+        if int(c.lens()[0]) == 0:
+            break
+        _feed(c, reg, max(int(c.lens()[0]), 1), max(int(c.lens()[0]), 1))
+    assert int(c.lens()[0]) == 0  # full acceptance, measured loss -> off
+
+
+class RegimeDrafter(Drafter):
+    """Per-request regimes for the acceptance test: requests with a
+    script (the 'repetitive' regime) get ORACLE proposals — the known
+    greedy future — while scriptless ('random') requests get junk, so
+    the two regimes' accept rates are deterministic extremes."""
+
+    kind = "ngram"
+    stateful = True
+
+    def __init__(self, scripts):
+        self.scripts = scripts  # uid -> prompt + expected tokens
+
+    def propose(self, history, n, ctx=None):
+        h = np.asarray(history, np.int32).reshape(-1)
+        script = self.scripts.get(ctx)
+        out = np.zeros(n, np.int32)
+        if script is None:  # junk: varies so it can't accidentally loop
+            return (h[-1] + 1 + np.arange(n, dtype=np.int32)) % 251
+        tail = script[h.size: h.size + n]
+        out[: len(tail)] = tail
+        return out
+
+
+def test_controller_mixed_workload_convergence(tiny_model_kwargs):
+    """THE acceptance run (through the real batcher): on a mixed
+    workload, repetitive-regime slots converge to spec_len > 0 with
+    per-request dispatches/token strictly below the spec-off per-token
+    baseline of 1, random-regime slots converge to spec_len == 0 within
+    the run, and every greedy stream stays BIT-IDENTICAL to spec-off."""
+    raw = make_config(tiny_model_kwargs, seq=MAX_LEN).to_dict()
+    raw["inference"].update(dict(
+        spec_len=4,
+        spec_controller=dict(enabled=True, window=4, hysteresis=2,
+                             target=0.6, low=0.3, cooloff=10_000)))
+    cfg = Config.from_dict(raw)
+    eng_off = InferenceEngine(cfg, slots=4, max_seq_len=MAX_LEN,
+                              spec_len=0)
+    params = _params(cfg, eng_off)
+
+    def reqs():
+        return [Request("rep0", [1, 2, 3, 1, 2, 3], max_new_tokens=48),
+                Request("rep1", [5, 6, 5, 6, 5], max_new_tokens=48),
+                Request("rand0", [11, 23, 7], max_new_tokens=30),
+                Request("rand1", [42, 9, 31, 8], max_new_tokens=30)]
+
+    want = ContinuousBatcher(eng_off, params).run(reqs())
+    scripts = {u: list(r.prompt) + want[u].tokens
+               for u, r in ((q.uid, q) for q in reqs())
+               if u.startswith("rep")}
+    eng_on = InferenceEngine(cfg, slots=4, max_seq_len=MAX_LEN)
+    b = ContinuousBatcher(eng_on, params, drafter=RegimeDrafter(scripts))
+    assert b.controller is not None
+    got = b.run(reqs())
+    for u, r in want.items():
+        assert got[u].tokens == r.tokens, u  # greedy unchanged, always
+    for u in ("rep0", "rep1"):
+        assert got[u].spec_len_final > 0, (u, got[u])
+        dpt = got[u].dispatches / len(got[u].tokens)
+        assert dpt < 1.0, (u, dpt)  # strictly beats spec-off per-token
+    for u in ("rand0", "rand1"):
+        assert got[u].spec_len_final == 0, (u, got[u])
+    # decisions + effective length landed in stats and on the scrape
+    st = b.stats()
+    assert st["spec_controller"].get("spec_off", 0) >= 2
+    assert "spec_len_effective" in st
+    b.refresh_gauges()
+    prom = b.obs.registry.prometheus()
+    assert "picotron_spec_accept_rate" in prom
+    assert "picotron_spec_len" in prom
+
+
+def test_controller_loop_closes_with_obs_disabled(tiny_model_kwargs):
+    """``obs.enabled: false`` swaps the registry for null instruments —
+    the controller must still close its loop off the internal shadow
+    tallies (and greedy output stays identical, as everywhere)."""
+    raw = make_config(tiny_model_kwargs, seq=MAX_LEN).to_dict()
+    raw["inference"].update(dict(
+        spec_len=4,
+        spec_controller=dict(enabled=True, window=4, hysteresis=2)))
+    raw["obs"] = {"enabled": False}
+    cfg = Config.from_dict(raw)
+    eng_off = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN,
+                              spec_len=0)
+    eng_on = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN)
+    params = _params(cfg, eng_off)
+    reqs = [Request("a", [1, 2, 3, 1, 2, 3, 1, 2], max_new_tokens=20),
+            Request("b", [11, 23, 7], max_new_tokens=16)]
+    want = ContinuousBatcher(eng_off, params).run(reqs)
+    b = ContinuousBatcher(eng_on, params)
+    got = b.run(reqs)
+    for r in reqs:
+        assert got[r.uid].tokens == want[r.uid].tokens, r.uid
+    assert b.controller.decisions  # it DECIDED, blind registry and all
+
+
+def test_controller_on_greedy_identical_with_real_ngram(tiny_model_kwargs):
+    """Controller enabled with the REAL n-gram drafter (accepts and
+    rejections both occur, lengths ramp): greedy streams still equal
+    spec-off bit for bit — the ragged verify preserves the greedy
+    chain no matter what the policy loop decides."""
+    raw = make_config(tiny_model_kwargs, seq=MAX_LEN).to_dict()
+    raw["inference"].update(dict(
+        spec_len=4,
+        spec_controller=dict(enabled=True, window=4, hysteresis=2)))
+    cfg = Config.from_dict(raw)
+    eng_off = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN,
+                              spec_len=0)
+    eng_on = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN)
+    params = _params(cfg, eng_off)
+    reqs = [Request("a", [1, 2, 3, 1, 2, 3, 1, 2], max_new_tokens=20),
+            Request("b", [9, 8, 7], max_new_tokens=9)]
+    want = ContinuousBatcher(eng_off, params).run(reqs)
+    got = ContinuousBatcher(eng_on, params).run(reqs)
+    for r in reqs:
+        assert got[r.uid].tokens == want[r.uid].tokens, r.uid
