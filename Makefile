@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke obs-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke obs-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -22,6 +22,7 @@ test-all: native lint
 	$(MAKE) obs-smoke
 	$(MAKE) quant-smoke
 	$(MAKE) router-chaos-smoke
+	$(MAKE) disagg-smoke
 
 # picolint static analysis (picotron_tpu/analysis/, docs/ANALYSIS.md):
 # JAX hot-path rules (host syncs on traced values, trace-time
@@ -181,6 +182,22 @@ obs-smoke:
 # in /tracez. The same drill runs in tier-1 (tests/test_router.py).
 router-chaos-smoke:
 	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.router --smoke
+
+# Prefill/decode disaggregation interference bench (ISSUE 15,
+# docs/SERVING.md "Disaggregated prefill/decode"): decode-stream TPOT
+# with long shared-prefix prompts arriving mid-stream, measured three
+# ways — no interference (baseline), colocated (the long prefills run
+# inside the decode batcher's own loop and stall every stream), and a
+# disaggregated prefill+decode two-role fleet behind the router (the
+# prefills land on the prefill worker, finished KV pages stream to the
+# decode worker, its batcher never spends a dispatch on them). Greedy
+# streams asserted bit-identical across all three phases; the JSON
+# records tpot_p95_{baseline,colocated,disagg}, handoff bytes/latency,
+# and the cluster-wide prefix hit rate. Exit nonzero unless the
+# colocated configuration measurably degrades past the disaggregated
+# one. CPU proxy (subprocess replicas = one interpreter per role).
+disagg-smoke:
+	JAX_PLATFORMS=cpu python bench_decode.py --disagg
 
 # Serving chaos suite (tests/test_serving.py): dispatch-exception,
 # latency-spike, and poisoned-logits faults through the engine hooks —

@@ -556,6 +556,313 @@ def run_spec_auto(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
             weight_bytes, engine, auto)
 
 
+# --------------------------------------------------------------------------- #
+# --disagg: prefill/decode interference bench (ISSUE 15)
+# --------------------------------------------------------------------------- #
+
+# the interference workload: short-prompt decode streams whose inter-token
+# gaps we time, plus long shared-prefix prompts arriving mid-stream whose
+# chunked prefills are the interference source
+_DISAGG_MODEL = dict(
+    name="tiny-disagg", num_hidden_layers=4, num_attention_heads=8,
+    num_key_value_heads=8, hidden_size=256, intermediate_size=1024,
+    vocab_size=4096, max_position_embeddings=256, dtype="float32",
+    attention_impl="sdpa")
+_DISAGG_SIZES = dict(slots=3, stream_prompt=8, stream_tokens=48,
+                     long_prompt=96, long_shared=64, n_streams=2,
+                     n_long=3, prefill_chunk=16, page_len=16)
+
+
+def _launch_replica(cfg_path: str, role: str, slots: int):
+    """One serve.py replica as a SUBPROCESS (its own interpreter + GIL —
+    the honest CPU proxy for a disaggregated host). Returns
+    (Popen, port) once the CLI's "serving" event line reports the
+    ephemeral port; a reader thread keeps draining stdout after that."""
+    import subprocess
+    import threading
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "picotron_tpu.tools.serve",
+         "--config", cfg_path, "--random-init", "--port", "0",
+         "--slots", str(slots), "--role", role,
+         "--stall-timeout", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    # readline() blocks with no timeout of its own: a replica that wedges
+    # before printing the serving event would hang the smoke forever. The
+    # timer kill turns that into EOF -> a loud launch failure at 180s.
+    watchdog = threading.Timer(180.0, proc.kill)
+    watchdog.start()
+    port = None
+    try:
+        while True:
+            line = proc.stdout.readline()
+            if not line:  # EOF: the child exited (or the watchdog fired)
+                raise RuntimeError(
+                    f"replica (role={role}) died (or hung past the launch "
+                    f"deadline) before reporting a port")
+            try:
+                evt = json.loads(line)
+            except ValueError:
+                continue
+            if evt.get("evt") == "serving":
+                port = evt["port"]
+                break
+    except BaseException:
+        proc.kill()
+        raise
+    finally:
+        watchdog.cancel()
+    threading.Thread(target=lambda: [None for _ in proc.stdout],
+                     daemon=True).start()
+    return proc, port
+
+
+def _stream_tpot(port: int, prompt, max_new: int, times: list) -> list:
+    """Stream one request, appending a perf_counter stamp per token row;
+    returns the tokens (the bit-identity cross-check)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+    toks = []
+    try:
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt": list(prompt),
+                                 "max_new_tokens": max_new,
+                                 "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        while True:
+            line = resp.readline()
+            if not line:
+                return toks
+            row = json.loads(line)
+            if row.get("event") == "token":
+                times.append(time.perf_counter())
+                toks.append(int(row["token"]))
+            elif row.get("event") == "done":
+                return toks
+    finally:
+        conn.close()
+
+
+def _interference_phase(port: int, sizes: dict, rng, long_prompts) -> tuple:
+    """One timed phase against ONE endpoint (a replica or the router):
+    ``n_streams`` token-timed decode streams, with ``long_prompts``
+    injected once the streams are flowing. Returns (tpot samples,
+    stream token lists)."""
+    import threading
+
+    stamps = [[] for _ in range(sizes["n_streams"])]
+    streams = [[] for _ in range(sizes["n_streams"])]
+    threads = []
+    for i in range(sizes["n_streams"]):
+        prompt = [int(t) for t in
+                  rng.integers(1, _DISAGG_MODEL["vocab_size"],
+                               sizes["stream_prompt"])]
+
+        def go(i=i, prompt=prompt):
+            streams[i].extend(_stream_tpot(
+                port, prompt, sizes["stream_tokens"], stamps[i]))
+
+        t = threading.Thread(target=go)
+        t.start()
+        threads.append(t)
+    # inject the long prefills once every stream is past its own prefill
+    deadline = time.monotonic() + 60
+    while (any(len(s) < 3 for s in stamps)
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    longs = []
+    for prompt in long_prompts:
+        def go_long(prompt=prompt):
+            import http.client
+
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=600)
+            try:
+                conn.request("POST", "/generate",
+                             json.dumps({"prompt": list(prompt),
+                                         "max_new_tokens": 4}),
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+            finally:
+                conn.close()
+
+        t = threading.Thread(target=go_long)
+        t.start()
+        longs.append(t)
+    for t in threads + longs:
+        t.join(timeout=600)
+    samples = []
+    for row in stamps:
+        samples.extend(b - a for a, b in zip(row[1:], row[2:]))
+    return samples, streams
+
+
+def _p(samples, q):
+    import numpy as np
+
+    return float(np.percentile(np.asarray(samples), q)) if samples else None
+
+
+def run_disagg() -> dict:
+    """The mixed-interference A/B/C (CPU proxy; subprocess replicas so
+    each role owns an interpreter, the one-host stand-in for separate
+    machines):
+
+    - ``baseline``:  decode streams on one colocated (role=both) replica,
+      NO long prefills — the no-interference TPOT floor;
+    - ``colocated``: same replica shape, long shared-prefix prompts
+      arriving mid-stream — their chunked prefills run inside the same
+      batcher loop, so every decode slot stalls behind them;
+    - ``disagg``:    a prefill + decode two-role fleet behind the
+      router — the long prompts' prefills land on the prefill worker and
+      stream to the decode worker as KV pages, so the decode batcher
+      never spends a dispatch on them.
+
+    Greedy streams are asserted bit-identical across the three phases
+    (same seed everywhere); the record carries the TPOT percentiles,
+    handoff bytes/latency, and the cluster-wide prefix hit rate."""
+    import tempfile
+
+    import numpy as np
+
+    from picotron_tpu.config import RouterConfig
+    from picotron_tpu.tools import serve
+    from picotron_tpu.tools.router import RouterServer
+
+    sizes = dict(_DISAGG_SIZES)
+    rng0 = np.random.default_rng(7)
+    shared = [int(t) for t in rng0.integers(
+        1, _DISAGG_MODEL["vocab_size"], sizes["long_shared"])]
+    long_prompts = []
+    for _ in range(sizes["n_long"]):
+        tail = [int(t) for t in rng0.integers(
+            1, _DISAGG_MODEL["vocab_size"],
+            sizes["long_prompt"] - sizes["long_shared"])]
+        long_prompts.append(shared + tail)
+
+    raw = {
+        "distributed": {"tp_size": 1, "use_cpu": True},
+        "model": dict(_DISAGG_MODEL),
+        "training": {"seq_length": 64},
+        "dataset": {"name": "synthetic"},
+        "inference": {"kv_layout": "paged",
+                      "kv_page_len": sizes["page_len"],
+                      "prefill_chunk": sizes["prefill_chunk"],
+                      "decode_block_len": 1},
+    }
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(raw, f)
+        cfg_path = f.name
+
+    procs = []
+    rs = None
+    out: dict = {}
+    try:
+        both_proc, both_port = _launch_replica(cfg_path, "both",
+                                               sizes["slots"])
+        procs.append(both_proc)
+
+        def warm(port):
+            # absorb compiles outside every timed window: the stream
+            # shape, the chunked-prefill program, and a page import
+            serve._post(port, {"prompt": [1] * sizes["stream_prompt"],
+                               "max_new_tokens": 4})
+            serve._post(port, {"prompt": list(range(
+                1, sizes["long_prompt"] + 1)), "max_new_tokens": 2})
+
+        warm(both_port)
+        rng = np.random.default_rng(0)
+        base_samples, base_streams = _interference_phase(
+            both_port, sizes, rng, [])
+        rng = np.random.default_rng(0)
+        colo_samples, colo_streams = _interference_phase(
+            both_port, sizes, rng, long_prompts)
+
+        pre_proc, pre_port = _launch_replica(cfg_path, "prefill",
+                                             sizes["slots"])
+        dec_proc, dec_port = _launch_replica(cfg_path, "decode",
+                                             sizes["slots"])
+        procs += [pre_proc, dec_proc]
+        rs = RouterServer(
+            [f"127.0.0.1:{pre_port}", f"127.0.0.1:{dec_port}"],
+            RouterConfig(probe_interval_s=0.1, scrape_stale_s=5.0),
+            log=lambda *a, **k: None)
+        rs.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (len(rs.router._candidates(kind="prefill")) == 1
+                    and len(rs.router._eligible()) == 1):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("disagg fleet never became eligible")
+        warm(rs.port)
+        rng = np.random.default_rng(0)
+        dis_samples, dis_streams = _interference_phase(
+            rs.port, sizes, rng, long_prompts)
+
+        # greedy bit-identity across phases: interference must cost
+        # latency, never tokens
+        assert colo_streams == base_streams == dis_streams, \
+            "streams diverged across phases (greedy must be identical)"
+
+        router_stats = rs.router.stats()
+        stz = {"prefill": serve._get(pre_port, "/statz")[1],
+               "decode": serve._get(dec_port, "/statz")[1]}
+        # cluster-wide prefix effectiveness: cached (local radix hits on
+        # the prefill worker + remote imports seated on the decode
+        # worker) over all prompt tokens the fleet admitted
+        cached = sum(s.get("prefix_cached_tokens", 0) for s in stz.values())
+        queried = sum(s.get("prefix_queries", 0) for s in stz.values())
+        prompt_total = 0
+        for s in stz.values():
+            # prompt_tokens isn't exported; reconstruct from hit rate
+            hr = s.get("prefix_hit_rate")
+            ct = s.get("prefix_cached_tokens", 0)
+            if hr:
+                prompt_total += int(round(ct / hr))
+        handoffs = max(1, router_stats["handoffs"].get("served", 0))
+        out = {
+            "tpot_p50_baseline": _p(base_samples, 50),
+            "tpot_p95_baseline": _p(base_samples, 95),
+            "tpot_p50_colocated": _p(colo_samples, 50),
+            "tpot_p95_colocated": _p(colo_samples, 95),
+            "tpot_p50_disagg": _p(dis_samples, 50),
+            "tpot_p95_disagg": _p(dis_samples, 95),
+            "handoffs_served": router_stats["handoffs"].get("served", 0),
+            "handoffs_fallback": router_stats["handoffs"].get(
+                "fallback", 0),
+            "handoff_bytes_per_request":
+                router_stats["handoff_bytes"] // handoffs,
+            "handoff_latency_s": router_stats["handoff_s"],
+            "cluster_prefix_hit_rate": (
+                round(cached / prompt_total, 4) if prompt_total else None),
+            "cluster_prefix_queries": queried,
+            "decode_worker_handoff_seated":
+                stz["decode"].get("handoff_seated", 0),
+            "decode_worker_prefill_dispatches":
+                stz["decode"].get("prefill_dispatches", 0),
+            "sizes": sizes,
+        }
+        return out
+    finally:
+        if rs is not None:
+            rs.stop()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:  # noqa: BLE001 - teardown best effort
+                p.kill()
+        os.unlink(cfg_path)
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="decode throughput bench")
     ap.add_argument("--block-len", type=int, default=1,
@@ -574,6 +881,15 @@ def main(argv=None) -> None:
                          "last hidden state (shares the target's "
                          "embedding + lm_head; one small jitted draft "
                          "dispatch per round)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="prefill/decode interference bench (CPU proxy): "
+                         "decode-stream TPOT with long shared-prefix "
+                         "prefills arriving mid-stream, measured "
+                         "baseline (no interference) vs colocated vs a "
+                         "disaggregated prefill+decode fleet behind the "
+                         "router — the JSON gains tpot_p95_colocated / "
+                         "tpot_p95_disagg, handoff_bytes_per_request, "
+                         "handoff_latency_s, cluster_prefix_hit_rate")
     ap.add_argument("--spec-auto", action="store_true",
                     help="closed-loop controller run: a mixed "
                          "repetitive/random-prompt workload through the "
@@ -616,6 +932,55 @@ def main(argv=None) -> None:
                          "the fused dequant matmul — weight_bytes_total "
                          "in the JSON drops to ~half the bf16 bytes")
     args = ap.parse_args(argv)
+    if args.disagg:
+        # the disagg bench is its own protocol (subprocess fleet + the
+        # router; TPOT percentiles, not tokens/s) — CPU proxy by design
+        # until the TPU tunnel returns
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            res = run_disagg()
+        except Exception as e:  # noqa: BLE001 - the record IS the channel
+            print(json.dumps({
+                "metric": "disagg_interference_cpu_smoke", "value": None,
+                "unit": "tpot_p95_s", "vs_baseline": None,
+                "code_failure": True,
+                "error": f"{type(e).__name__}: {e}"[:800]}))
+            raise
+        base, colo, dis = (res["tpot_p95_baseline"],
+                           res["tpot_p95_colocated"],
+                           res["tpot_p95_disagg"])
+        if None in (base, colo, dis):
+            # a phase delivered too few tokens to sample TPOT at all:
+            # that is a failed measurement, and the record must say so
+            # in the structured channel, not via a raw format TypeError
+            print(json.dumps({
+                "metric": "disagg_interference_cpu_smoke", "value": None,
+                "unit": "tpot_p95_s", "vs_baseline": None,
+                "code_failure": True,
+                "error": "a phase produced no TPOT samples "
+                         f"(p95s: baseline={base} colocated={colo} "
+                         f"disagg={dis})", **res}))
+            raise SystemExit("disagg bench: empty TPOT sample set")
+        print(f"# disagg bench: tpot_p95 baseline={base:.4f}s "
+              f"colocated={colo:.4f}s disagg={dis:.4f}s "
+              f"handoffs={res['handoffs_served']} "
+              f"handoff_bytes/req={res['handoff_bytes_per_request']} "
+              f"cluster_prefix_hit_rate={res['cluster_prefix_hit_rate']}",
+              file=sys.stderr)
+        record = {"metric": "disagg_interference_cpu_smoke",
+                  "value": round(dis, 5), "unit": "tpot_p95_s",
+                  "vs_baseline": None, "validated": False, **res}
+        print(json.dumps(record))
+        # the smoke gate (make disagg-smoke): interference must
+        # measurably degrade the COLOCATED configuration while the
+        # disaggregated decode worker stays near its no-prefill floor.
+        # The ordering is the hard gate; the 10%-of-baseline acceptance
+        # is recorded (p95s on a shared CPU box carry scheduler noise).
+        if not (colo > dis):
+            raise SystemExit(
+                f"disagg gate failed: colocated p95 {colo:.4f}s is not "
+                f"worse than disaggregated {dis:.4f}s")
+        return
     if args.spec_len > 0 and args.block_len != 1:
         ap.error("--spec-len replaces blocked decode; drop --block-len")
     if args.spec_auto and args.spec_len < 1:

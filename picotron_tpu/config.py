@@ -442,6 +442,18 @@ class InferenceConfig:
     # its own quantized representation). Handled by both the dense gather
     # and the flash DMA read paths (inference/paged_kv.py).
     kv_page_policy: str = "uniform"
+    # Disaggregated serving role (tools/serve.py, docs/SERVING.md
+    # "Disaggregated prefill/decode"): "both" (default — one replica runs
+    # admission, prefill, and decode exactly as before; every existing
+    # smoke is unchanged); "prefill" — the replica runs admission +
+    # chunked/paged prefill only and hands finished KV pages off through
+    # POST /kv/export (its /generate sheds with 503); "decode" — the
+    # replica seats imported pages (POST /kv/import, /generate's "kv"
+    # field) and runs the decode/spec loop, so a long prompt's prefill
+    # never steals one of its dispatch rounds (it still self-prefills
+    # plain requests as the failover fallback). Any role but "both"
+    # requires kv_layout: "paged" — the page pool IS the handoff unit.
+    role: str = "both"
     # Prompts longer than this prefill as a sequence of fixed-width chunk
     # dispatches writing K/V straight into the target slot
     # (engine.prefill_chunked): O(1) compiled shapes in prompt length and
@@ -588,6 +600,23 @@ class RouterConfig:
     # is within affinity_load_slack of the least-loaded candidate.
     affinity_page_len: int = 16
     affinity_load_slack: float = 4.0
+    # -- prefill/decode disaggregation (docs/SERVING.md) --
+    # When the fleet holds role=prefill replicas, route each prompt's
+    # prefill to its affinity prefill worker (POST /kv/export), stream
+    # the finished KV pages to the decode placement, and splice the token
+    # stream — a failed/severed export falls back to self-prefill at the
+    # decode placement (the replay bookkeeping's path). False = ignore
+    # prefill workers for orchestration (they still probe/scrape).
+    disagg: bool = True
+    # On a placement that escaped its affinity owner, ask the owner for
+    # the longest cached page-aligned prefix (GET /kv/pages) and import
+    # it at the chosen replica (POST /kv/import) before generating —
+    # shared system prompts prefill once per CLUSTER. Soft: any failure
+    # just skips the fetch.
+    prefix_fetch: bool = True
+    # Deadline for one /kv/export round trip (the prefill itself runs
+    # inside it, so this is a prefill budget, not a probe timeout).
+    handoff_timeout_s: float = 120.0
     # -- per-request bounds --
     place_attempts: int = 3  # placements that never streamed (shed/refused)
     replay_budget: int = 2  # mid-stream failovers (replays) per request
@@ -601,7 +630,7 @@ class RouterConfig:
         for name in ("probe_interval_s", "probe_timeout_s",
                      "breaker_backoff_s", "breaker_backoff_max_s",
                      "scrape_stale_s", "connect_timeout_s",
-                     "stream_idle_timeout_s"):
+                     "stream_idle_timeout_s", "handoff_timeout_s"):
             if getattr(self, name) <= 0:
                 raise ValueError(f"router.{name} must be > 0")
         for name in ("breaker_failures", "breaker_probe_attempts",
@@ -946,6 +975,17 @@ class Config:
                     "quantized cache has no full-precision pages to keep "
                     "hot); set kv_cache_dtype: 'auto', or keep "
                     "kv_page_policy: 'uniform' for a fully int8 cache")
+        if inf.role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"unknown inference.role {inf.role!r} "
+                "(prefill|decode|both) — 'both' is the colocated default; "
+                "'prefill'/'decode' split a disaggregated fleet")
+        if inf.role != "both" and inf.kv_layout != "paged":
+            raise ValueError(
+                f"inference.role {inf.role!r} requires the paged KV "
+                "layout (finished prefills hand off as pool pages — "
+                "inference/page_transport.py); set inference.kv_layout: "
+                "'paged', or keep role: 'both'")
         if not isinstance(inf.sample_on_device, bool):
             raise ValueError(
                 f"inference.sample_on_device must be a JSON boolean "

@@ -22,6 +22,14 @@ into a batched generation engine:
 - ``batcher``: continuous batching — admit/retire variable-length requests
   into the engine's fixed slots, consuming whole decode blocks (or
   draft-verify dispatches on a speculative engine);
+- ``page_transport``: the prefill/decode disaggregation handoff — a
+  prefilled request's KV leaves one replica as pool page bytes (+ radix
+  chunk keys + the first sampled token) and lands in another's pool
+  byte-exact, CRC-guarded and refcount-correct, so dedicated prefill
+  workers feed decode workers whose batcher never spends a dispatch on
+  a long prefill, and a replica can import a peer's cached prefix
+  instead of recomputing it (docs/SERVING.md "Disaggregated
+  prefill/decode");
 - ``speculative``: the draft side of speculative decoding plus its
   policy loop — the ``Drafter`` interface, the model-free prompt-lookup
   ``NgramDrafter`` (incremental append-only suffix index, windowed match

@@ -121,6 +121,13 @@ class Request:
     top_p: float = 1.0
     eos_id: Optional[int] = None
     timeout_s: Optional[float] = None
+    # disaggregated handoff (inference/page_transport.py, paged engines
+    # only): a transport payload whose pages are imported at admission.
+    # With a ``first_token`` covering the FULL prompt, the slot seats
+    # ready to decode — zero prefill dispatches; otherwise the payload is
+    # a prefix HINT (imported into the radix cache, the normal admission
+    # radix-hits it and prefills only the uncovered suffix).
+    kv_import: Optional[dict] = None
 
 
 @dataclass
@@ -277,6 +284,13 @@ class ContinuousBatcher:
         self._draft_accepted_total = reg.counter(
             "picotron_draft_accepted_total",
             "draft tokens accepted into emitted streams")
+        # disaggregation: payload imports that carried a usable remote
+        # prefix, and admissions seated directly from a handoff (zero
+        # prefill dispatches) — the cross-replica acceptance counters
+        self._remote_hits_total = reg.counter(
+            "picotron_prefix_remote_hits_total",
+            "transport imports that landed a remote-prefilled prefix")
+        self.handoff_seated = 0
         self._req_spans: dict = {}  # uid -> live request root span
         self._last_prefill: dict = {}  # scratch: dispatch/radix-hit counts
         self._host_sync_s = 0.0  # scratch: last dispatch's host-sync time
@@ -500,6 +514,10 @@ class ContinuousBatcher:
             # pool occupancy + prefix-cache effectiveness (kv_pages_*,
             # prefix_hit_rate, cow_copies, ...) ride into /statz
             d.update(self.paged.stats())
+            # disaggregation: admissions seated straight from an imported
+            # handoff (zero prefill dispatches) + remote prefix imports
+            d["handoff_seated"] = self.handoff_seated
+            d["prefix_remote_hits"] = int(self._remote_hits_total.value)
         return d
 
     # ---- one scheduler round ----------------------------------------------
@@ -589,6 +607,12 @@ class ContinuousBatcher:
         hidden = None
         if self.engine.sample_on_device:
             sample = (key, req.temperature, req.top_k, req.top_p)
+        if self.paged is not None and req.kv_import is not None:
+            seated = self._try_import(req, i)
+            if seated is not None:
+                return seated  # ("handoff", first_token)
+            # payload landed in the radix as a prefix hint; the normal
+            # paged admission below radix-hits it
         if self.paged is not None:
             self.paged.priced[i] = self.page_commitment(req)
             out = self.engine.prefill_paged(
@@ -620,6 +644,79 @@ class ContinuousBatcher:
             # the prompt's last hidden state seeds the slot's drafting row
             self._hidden = self._hidden.at[i].set(jnp.asarray(hidden)[0])
         return logits
+
+    def _try_import(self, req: Request, i: int):
+        """Land ``req.kv_import``'s pages and, when the payload covers the
+        FULL prompt with its first token, seat slot ``i`` ready to decode
+        — the disaggregated handoff's zero-dispatch admission. Returns
+        ``("handoff", first_token)`` on a seat, None when the payload is
+        only a prefix hint (or pool pressure evicted part of the import
+        before the slot could share it) — the caller then runs the normal
+        paged admission, which radix-hits whatever survived. Idempotent
+        under the dispatch retry: import skips chunks already cached and
+        ``match_prefix`` releases any prior holdings first."""
+        from picotron_tpu.inference.page_transport import TransportError
+        from picotron_tpu.inference.paged_kv import PagePoolExhausted
+
+        payload = req.kv_import
+        self.paged.priced[i] = self.page_commitment(req)
+        try:
+            self._cache, info = self.engine.import_prefix(self._cache,
+                                                          payload)
+        except (TransportError, PagePoolExhausted) as e:
+            # a payload this replica cannot land (corrupt/truncated bytes,
+            # no pool room for the extra pages) must not cost the request:
+            # it is perfectly servable by self-prefilling — the documented
+            # degrade-to-colocated contract. The import released every
+            # page it allocated, so the fallback starts clean.
+            self.obs.registry.counter(
+                "picotron_handoff_dropped_total",
+                "kv payloads dropped as locally unusable").inc()
+            log0(f"serving: kv import for {req.uid!r} dropped "
+                 f"({type(e).__name__}: {e}); self-prefilling", flush=True)
+            return None
+        if info["pages_imported"] > 0:
+            # counted on pages actually landing — a retried admission's
+            # second import (everything already cached) must not inflate
+            # the acceptance counter
+            self._remote_hits_total.inc()
+        ids = [int(t) for t in payload.get("token_ids") or []]
+        first = payload.get("first_token")
+        if first is None or ids != [int(t) for t in req.prompt]:
+            return None
+        cached = self.paged.match_prefix(i, ids, cap_last=False)
+        if cached != len(ids):
+            return None
+        self._cache = self.engine.seat_slot(self._cache, i, cached)
+        if self._hidden is not None:
+            # no prefill dispatch ran, so there is no hidden state for
+            # the learned drafter's first round: zero the row rather
+            # than draft from the PREVIOUS occupant's state (the first
+            # verify re-seeds it; a garbage first draft is rejected by
+            # verify either way — correctness never depends on this)
+            self._hidden = self._hidden.at[i].set(0)
+        self._last_prefill = {"dispatches": 0, "cached_tokens": cached,
+                              "imported_pages": info["pages_imported"]}
+        self.handoff_seated += 1
+        return ("handoff", int(first))
+
+    def export_prefix(self, ids, first_token=None) -> dict:
+        """Serialize the longest radix-cached prefix of ``ids`` from this
+        batcher's cache (the serve front end's /kv/export + /kv/pages
+        surface — the caller serializes batcher access)."""
+        return self.engine.export_prefix(self._cache, ids,
+                                         first_token=first_token)
+
+    def import_prefix(self, payload) -> dict:
+        """Land a transport payload in this batcher's cache/radix (the
+        /kv/import surface). Returns the import info dict."""
+        self._cache, info = self.engine.import_prefix(self._cache, payload)
+        if info["pages_imported"] > 0:
+            # counted on pages actually landing — a retried admission's
+            # second import (everything already cached) must not inflate
+            # the acceptance counter
+            self._remote_hits_total.inc()
+        return info
 
     def _pages_admit(self) -> bool:
         """Page-priced admission gate (paged layout): shed head requests
@@ -716,7 +813,11 @@ class ContinuousBatcher:
             self._top_k[i] = req.top_k
             self._top_p[i] = req.top_p
             self._eos[i] = req.eos_id if req.eos_id is not None else -1
-            if self.engine.sample_on_device:
+            if isinstance(logits, tuple) and logits[:1] == ("handoff",):
+                # seated from an imported handoff: the prefill worker
+                # already sampled the first token — nothing to draw here
+                first = int(logits[1])
+            elif self.engine.sample_on_device:
                 # the dispatch already drew the first token (epilogue);
                 # the one int crossing here is the whole logits payload
                 first = int(np.asarray(logits).reshape(-1)[0])

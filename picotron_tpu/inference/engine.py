@@ -313,6 +313,17 @@ class InferenceEngine:
                                        donate_argnums=(0,))
             self._copy_page_jit = jax.jit(paged_kv.copy_page,
                                           donate_argnums=(0,))
+            # page-transport device ops (inference/page_transport.py):
+            # built ONCE here — a per-page jit build would recompile every
+            # import (picolint PICO-J004's exact hazard). Export reads and
+            # import writes are each ONE batched (pow-2-bucketed)
+            # dispatch: an export pays one host sync however long the
+            # prefix, and an import fault can only land BEFORE the
+            # cache-donating dispatch, never mid-batch.
+            self._slice_page_jit = jax.jit(paged_kv.slice_page)
+            self._gather_pages_jit = jax.jit(paged_kv.gather_pages)
+            self._write_pages_jit = jax.jit(paged_kv.write_pages,
+                                            donate_argnums=(0,))
             self._set_length_jit = jax.jit(paged_kv.set_length,
                                            donate_argnums=(0,))
             self._init_cache_jit = jax.jit(
@@ -1147,6 +1158,45 @@ class InferenceEngine:
         self.paged.register_prompt(slot, ids)
         base = (cache, logits, n, cached)
         return base + (hidden,) if rh else base
+
+    # ---- page transport (prefill/decode disaggregation) -------------------
+
+    def transport_spec(self) -> dict:
+        """The engine's page-layout fingerprint for the KV-page transport
+        (inference/page_transport.py) — what a peer must match to
+        exchange page bytes with this replica."""
+        from picotron_tpu.inference import page_transport
+
+        return page_transport.transport_spec(self)
+
+    def export_prefix(self, cache, ids, first_token=None) -> dict:
+        """Serialize the longest radix-cached prefix of ``ids`` as a
+        transport payload (paged engines only): pinned pages, byte-exact
+        leaves, CRC. ``first_token`` rides along when the match covers
+        the whole prompt — the disaggregated handoff's seat state."""
+        from picotron_tpu.inference import page_transport
+
+        return page_transport.export_prefix(self, cache, ids,
+                                            first_token=first_token)
+
+    def import_prefix(self, cache, payload) -> tuple:
+        """Land a transport payload's pages in the local pool + radix
+        cache (consumes ``cache``; returns (cache, info)). Only locally
+        missing chunks allocate; failures release every allocated page
+        before propagating (refcount-correct under the dispatch retry)."""
+        from picotron_tpu.inference import page_transport
+
+        return page_transport.import_prefix(self, cache, payload)
+
+    def seat_slot(self, cache, slot: int, length: int) -> dict:
+        """Park an imported, fully cached prefix as ``slot``'s
+        ready-to-decode state (consumes ``cache``): device length pointer
+        + synced tables, NO dispatch. The caller already shared the pages
+        into the slot (``paged.match_prefix(..., cap_last=False)``)."""
+        if self.paged is None:
+            raise ValueError("seat_slot needs kv_layout='paged'")
+        self.paged.set_len(slot, length)
+        return self._set_length_jit(self._sync_tables(cache), slot, length)
 
     def insert(self, cache, kv, slot: int, length: int) -> dict:
         """Park a prefill's blocks into ``slot`` (consumes ``cache``).

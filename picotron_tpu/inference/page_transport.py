@@ -1,0 +1,290 @@
+"""KV-page transport: the prefill/decode disaggregation handoff unit.
+
+The PR 7 page pool made "page bytes + block-table rows" the natural unit
+of KV movement; this module makes that unit CROSS REPLICAS (DistServe /
+Mooncake's disaggregated-serving shape — see docs/SERVING.md
+"Disaggregated prefill/decode"). A prefilled request's K/V leaves the
+prefill worker as a self-describing payload and lands in a decode
+worker's pool byte-exact:
+
+- ``export_prefix(engine, cache, ids)``: radix-match ``ids`` on the
+  exporting engine, PIN the matched pages (transient pool references —
+  eviction cannot race the serialize), slice each page out of the device
+  pool (``paged_kv.slice_page``, one compiled executable for every page)
+  and base64 its raw bytes per storage leaf — K/V in the cache's own
+  storage dtype, int8 scales, and BOTH representations of the
+  ``hot_bf16`` dual pool, so the importer reconstructs the exact bytes,
+  never a recompute. The payload carries the covered token ids (the
+  radix chunk keys), the page/leaf spec (dtype + shape per leaf), a
+  CRC-32 over the raw bytes (a torn transfer fails loudly at import,
+  before any page is allocated), and optionally the first sampled token
+  (the handoff's seat state).
+- ``import_prefix(engine, cache, payload)``: validate the spec against
+  the local engine (page_len / dtypes / layout / policy must agree —
+  tp-sharding does NOT have to: payloads hold the gathered global bytes,
+  and the importing pool re-shards them on write, so tp=1 and tp=2
+  replicas interoperate), plan which chunks the local radix is missing,
+  allocate exactly those pages (all-or-nothing), write their bytes into
+  the pool (``paged_kv.write_page``) and graft them into the radix trie
+  (``RadixCache.adopt``) with the cache as sole holder — the same end
+  state as a locally prefilled + registered prompt, so a subsequent
+  admission radix-hits it with ZERO prefill dispatches for the covered
+  prefix.
+
+Refcount discipline (the part chaos drills): an import holds its fresh
+pages at refcount 1 until adoption; any failure — exhausted pool, a
+device write raising, a CRC mismatch — releases every page of the batch
+before propagating, so a failed or retried import can never leak pool
+capacity or double-reference a cached page (tests/test_disagg.py pins
+this with a write that faults mid-batch).
+
+Transport format: JSON-safe dict (the serving fabric is stdlib HTTP +
+JSON end to end). Page bytes ride as base64; for the tiny models the
+CPU-proxy fabric serves, payloads are a few KB — on hardware the same
+layout maps onto an RDMA/ICI plane without changing the bookkeeping.
+"""
+
+from __future__ import annotations
+
+import base64
+import zlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from picotron_tpu.inference import paged_kv
+
+TRANSPORT_VERSION = 1
+
+
+class TransportError(ValueError):
+    """A payload the local engine cannot accept: wrong version, spec
+    mismatch (page_len / dtype / layout / policy), or corrupt bytes
+    (CRC). Raised BEFORE any pool page is allocated."""
+
+
+def _require_paged(engine):
+    if engine.paged is None:
+        raise TransportError(
+            "page transport requires kv_layout='paged' (the contiguous "
+            "layout has no pages to ship); set inference.kv_layout: "
+            "'paged' on every disaggregated replica")
+
+
+def transport_spec(engine) -> dict:
+    """The engine's page-layout fingerprint: storage-leaf dtypes and
+    per-page GLOBAL shapes (tp-sharded pools export/import gathered
+    bytes, so the spec is tp-invariant by construction). Exporter and
+    importer must agree exactly — byte transport cannot convert."""
+    _require_paged(engine)
+    m = engine.cfg.model
+    kv_shape = [m.num_hidden_layers, engine.page_len,
+                m.num_key_value_heads, m.head_dim]
+    sc_shape = kv_shape[:-1]
+    leaves = {}
+
+    def leaf(name, shape, dtype):
+        leaves[name] = {"dtype": str(np.dtype(dtype)),
+                        "shape": list(shape)}
+
+    if engine.quantized:
+        leaf("k", kv_shape, np.int8)
+        leaf("v", kv_shape, np.int8)
+        leaf("k_scale", sc_shape, np.float32)
+        leaf("v_scale", sc_shape, np.float32)
+    else:
+        dt = np.dtype(engine.cache_dtype)
+        leaf("k", kv_shape, dt)
+        leaf("v", kv_shape, dt)
+        if engine.page_policy:
+            leaf("k_q", kv_shape, np.int8)
+            leaf("v_q", kv_shape, np.int8)
+            leaf("k_scale", sc_shape, np.float32)
+            leaf("v_scale", sc_shape, np.float32)
+    return {
+        "version": TRANSPORT_VERSION,
+        "page_len": engine.page_len,
+        "quantized": bool(engine.quantized),
+        "policy": bool(engine.page_policy),
+        "leaves": leaves,
+    }
+
+
+def check_spec(engine, payload: dict) -> dict:
+    """Validate a payload's spec against the local engine; returns the
+    local spec. Raises TransportError naming the first disagreement —
+    the importer's 400, never a silent byte reinterpretation."""
+    local = transport_spec(engine)
+    if payload.get("version") != local["version"]:
+        raise TransportError(
+            f"transport version {payload.get('version')!r} != "
+            f"{local['version']} (mixed-build fleet?)")
+    for key in ("page_len", "quantized", "policy"):
+        if payload.get(key) != local[key]:
+            raise TransportError(
+                f"transport {key} mismatch: payload {payload.get(key)!r} "
+                f"vs local {local[key]!r} — disaggregated replicas must "
+                f"share inference.kv_page_len / kv_cache_dtype / "
+                f"kv_page_policy")
+    if payload.get("leaves") != local["leaves"]:
+        raise TransportError(
+            f"transport leaf spec mismatch: payload "
+            f"{payload.get('leaves')!r} vs local {local['leaves']!r}")
+    return local
+
+
+def export_prefix(engine, cache, ids, first_token=None) -> dict:
+    """Serialize the longest radix-cached prefix of ``ids`` out of
+    ``cache``'s pool. The matched pages are pinned (transient pool refs)
+    for the duration; the payload's ``token_ids`` are the covered prefix
+    (possibly ending mid-page — the importer adopts the partial tail as
+    a partial leaf, exactly what the local radix holds). ``first_token``
+    (the handoff seat state) is attached only when the match covers ALL
+    of ``ids`` — a partial export cannot vouch for logits it does not
+    cover. Returns the payload dict; its ``bytes_total`` is the raw
+    (pre-base64) page byte count the handoff metrics account."""
+    _require_paged(engine)
+    p = engine.paged
+    ids = [int(t) for t in ids]
+    spec = transport_spec(engine)
+    pids, matched = p.acquire_prefix(ids)
+    try:
+        pages = []
+        crc = 0
+        total = 0
+        if pids:
+            # ONE batched gather (pow-2 bucket, NULL-page pads) + ONE
+            # host sync however long the prefix: the export runs under
+            # the serving front end's dispatch mutex, so per-page
+            # round-trips here would stall live decode streams — the
+            # exact interference this subsystem exists to remove
+            bucket = 1
+            while bucket < len(pids):
+                bucket *= 2
+            pid_arr = np.full(bucket, paged_kv.NULL_PAGE, np.int32)
+            pid_arr[:len(pids)] = pids
+            batch = engine._gather_pages_jit(cache, pid_arr)
+            host = {name: np.asarray(batch[name])
+                    for name in spec["leaves"]}
+        for i in range(len(pids)):
+            enc = {}
+            for name in spec["leaves"]:
+                raw = np.ascontiguousarray(host[name][i]).tobytes()
+                crc = zlib.crc32(raw, crc)
+                total += len(raw)
+                enc[name] = base64.b64encode(raw).decode("ascii")
+            pages.append(enc)
+    finally:
+        p.release_pages(pids)
+    payload = dict(spec)
+    payload.update(
+        token_ids=ids[:matched],
+        pages=pages,
+        crc32=crc,
+        bytes_total=total,
+    )
+    if first_token is not None and matched == len(ids):
+        payload["first_token"] = int(first_token)
+    engine.obs.registry.counter(
+        "picotron_handoff_bytes_total",
+        "raw KV page bytes moved by the transport, by direction",
+        dir="export").inc(total)
+    return payload
+
+
+def _decode_pages(spec: dict, payload: dict) -> list:
+    """base64 -> host arrays, CRC-verified. A torn or corrupt transfer
+    dies here, before any pool page exists to leak."""
+    ids = payload.get("token_ids") or []
+    pages_b64 = payload.get("pages") or []
+    page_len = spec["page_len"]
+    need = -(-len(ids) // page_len) if ids else 0
+    if len(pages_b64) != need:
+        raise TransportError(
+            f"payload covers {len(ids)} tokens but carries "
+            f"{len(pages_b64)} pages (need {need})")
+    crc = 0
+    out = []
+    for enc in pages_b64:
+        page = {}
+        for name, leaf in spec["leaves"].items():
+            if name not in enc:
+                raise TransportError(f"payload page missing leaf {name!r}")
+            try:
+                raw = base64.b64decode(enc[name], validate=True)
+            except (ValueError, TypeError) as e:
+                raise TransportError(f"leaf {name!r}: bad base64: {e}")
+            crc = zlib.crc32(raw, crc)
+            dt = np.dtype(leaf["dtype"])
+            shape = tuple(leaf["shape"])
+            expect = dt.itemsize * int(np.prod(shape))
+            if len(raw) != expect:
+                raise TransportError(
+                    f"leaf {name!r}: {len(raw)} bytes, expected {expect} "
+                    f"for shape {shape} {dt}")
+            page[name] = np.frombuffer(raw, dtype=dt).reshape(shape)
+        out.append(page)
+    if out and crc != payload.get("crc32"):
+        raise TransportError(
+            f"payload CRC mismatch ({crc} != {payload.get('crc32')}): "
+            f"torn or corrupt page stream")
+    return out
+
+
+def import_prefix(engine, cache, payload) -> tuple:
+    """Land a payload's pages in the local pool + radix cache. Only the
+    chunks the local trie is MISSING are allocated and written (an
+    already-cached prefix costs nothing — remote and local hits
+    converge); grafted pages end held by the cache alone, evictable like
+    any registered prompt. All-or-nothing on failure: exhaustion, write
+    faults, and CRC/spec errors release every allocated page before
+    propagating. Returns (cache, info) with info =
+    {"tokens", "pages_imported", "created", "bytes_total"}."""
+    spec = check_spec(engine, payload)
+    p = engine.paged
+    ids = [int(t) for t in payload.get("token_ids") or []]
+    pages = _decode_pages(spec, payload)
+    if not ids:
+        return cache, {"tokens": 0, "pages_imported": 0, "created": 0,
+                       "bytes_total": 0}
+    need = p.radix.plan_adopt(ids)
+    if not need:
+        # the local radix already covers the whole payload: a remote hit
+        # that cost zero pages (the convergent case under affinity churn)
+        return cache, {"tokens": len(ids), "pages_imported": 0,
+                       "created": 0, "bytes_total": 0}
+    pids = p.alloc_import(len(need))
+    chunk_pids = dict(zip(need, pids))
+    total = 0
+    try:
+        # pow-2 bucket, padded with NULL-page targets (page 0 is the
+        # designated scribble target nothing ever reads): a handful of
+        # compiled shapes serve every import size, and the write is ONE
+        # cache-donating dispatch — any host-side fault above leaves the
+        # cache intact for a clean release-and-retry
+        n = len(need)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        pid_arr = np.full(bucket, paged_kv.NULL_PAGE, np.int32)
+        pid_arr[:n] = pids
+        stacked = {}
+        for name, leaf in spec["leaves"].items():
+            rows = [pages[i][name] for i in need]
+            total += sum(arr.nbytes for arr in rows)
+            pad = [np.zeros_like(rows[0])] * (bucket - n)
+            stacked[name] = jnp.asarray(np.stack(rows + pad))
+        cache = engine._write_pages_jit(cache, stacked, pid_arr)
+    except Exception:
+        # the fault struck before the donating dispatch consumed the
+        # cache: the importer's references are the only holders — release
+        # them and the pool is exactly as before the import
+        p.release_pages(pids)
+        raise
+    created = p.finish_import(ids, chunk_pids)
+    engine.obs.registry.counter(
+        "picotron_handoff_bytes_total",
+        "raw KV page bytes moved by the transport, by direction",
+        dir="import").inc(total)
+    return cache, {"tokens": len(ids), "pages_imported": len(need),
+                   "created": created, "bytes_total": total}

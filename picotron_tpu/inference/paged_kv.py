@@ -330,6 +330,46 @@ def insert_prefill(cache: dict, kv: dict, slot, length) -> dict:
     return out
 
 
+def slice_page(cache: dict, pid) -> dict:
+    """One pool page's storage leaves as ``[L, page_len, ...]`` arrays —
+    the single-page read (tests/debug). ``pid`` may be a traced scalar:
+    one compiled executable serves every page, exactly like
+    ``copy_page``."""
+    pid = jnp.asarray(pid, jnp.int32)
+    return {name: lax.dynamic_slice_in_dim(a, pid, 1, axis=1)[:, 0]
+            for name, a in cache.items() if name not in META_LEAVES}
+
+
+def gather_pages(cache: dict, pids: jnp.ndarray) -> dict:
+    """A batch of pool pages' storage leaves as ``[n, L, page_len, ...]``
+    arrays (page-major, matching ``write_pages``' input) — the export
+    half of the page transport in ONE dispatch + ONE host sync, however
+    long the prefix. The caller pads ``pids`` to a pow-2 bucket with
+    NULL-page entries (free reads of bytes nothing cares about), so a
+    handful of compiled shapes serve every export size."""
+    pids = jnp.asarray(pids, jnp.int32)
+    return {name: jnp.moveaxis(jnp.take(a, pids, axis=1), 1, 0)
+            for name, a in cache.items() if name not in META_LEAVES}
+
+
+def write_pages(cache: dict, pages: dict, pids: jnp.ndarray) -> dict:
+    """Write a batch of imported pages' storage leaves into pool pages
+    ``pids`` — the import half of the page transport, ONE dispatch per
+    import. ``pages[name]`` is ``[n, L, page_len, ...]`` (page-major so
+    the host stacks payload pages directly); ``pids`` is ``[n]`` int32.
+    The caller pads ``n`` to a pow-2 bucket with NULL-page targets —
+    page 0 is the designated scribble target nothing ever reads — so a
+    handful of compiled shapes serve every import size. Byte-exact: the
+    transport validated dtypes before this runs, so the astype is an
+    identity guard, never a conversion."""
+    pids = jnp.asarray(pids, jnp.int32)
+    out = dict(cache)
+    for name, a in pages.items():
+        out[name] = cache[name].at[:, pids].set(
+            jnp.moveaxis(a, 0, 1).astype(cache[name].dtype))
+    return out
+
+
 def copy_page(cache: dict, src, dst) -> dict:
     """Byte-exact pool-page copy across every layer and every storage
     leaf (K, V, scales) — the device half of copy-on-write. ``src``/
@@ -551,6 +591,75 @@ class RadixCache:
                 created += 1
         return created
 
+    def plan_adopt(self, ids) -> list:
+        """Chunk indices of ``ids`` with no existing trie node — the pages
+        a cross-replica import must supply (non-destructive dry run of
+        ``adopt``). Once one chunk is missing, every deeper chunk needs a
+        node too (its parent path would be new), so the plan is always a
+        suffix of the chunk list."""
+        node = self.root
+        n = len(ids)
+        full = n // self.page_len
+        tail = n % self.page_len
+        total = full + (1 if tail else 0)
+        for i in range(full):
+            chunk = tuple(ids[i * self.page_len:(i + 1) * self.page_len])
+            child = node.children.get(chunk)
+            if child is None:
+                return list(range(i, total))
+            node = child
+        if tail:
+            t = tuple(ids[full * self.page_len:])
+            if not any(self._overlap(c.tokens, t) == len(t)
+                       for c in node.children.values()):
+                return [full]
+        return []
+
+    def adopt(self, ids, page_for: dict) -> tuple:
+        """Graft imported pages into the trie: ``page_for[i]`` backs
+        chunk ``i`` of ``ids`` (the last may be partial). New nodes take a
+        cache reference on their page (the importer's own alloc reference
+        is dropped by the caller afterwards, leaving exactly the cache as
+        holder — the same end state as a slot's ``register_prompt``).
+        Chunks that already have a node are touched and their imported
+        page (if any was supplied) is returned in ``dups`` for the caller
+        to free — idempotent under the dispatch-retry discipline. Returns
+        (created, duplicate_page_ids)."""
+        node, created, dups = self.root, 0, []
+        n = len(ids)
+        full = n // self.page_len
+        for i in range(full):
+            chunk = tuple(ids[i * self.page_len:(i + 1) * self.page_len])
+            child = node.children.get(chunk)
+            if child is not None:
+                if i in page_for:
+                    dups.append(page_for[i])
+                self._touch(child)
+                node = child
+                continue
+            if i not in page_for:
+                # a gap the import cannot fill (the plan predates a
+                # concurrent eviction): stop grafting, free nothing here
+                return created, dups
+            child = _Node(chunk, page_for[i], node)
+            node.children[chunk] = child
+            self.pool.ref(page_for[i])
+            self._touch(child)
+            node = child
+            created += 1
+        tail = tuple(ids[full * self.page_len:])
+        if tail and full in page_for:
+            if any(self._overlap(c.tokens, tail) == len(tail)
+                   for c in node.children.values()):
+                dups.append(page_for[full])
+            else:
+                leaf = _Node(tail, page_for[full], node)
+                node.children[tail] = leaf
+                self.pool.ref(page_for[full])
+                self._touch(leaf)
+                created += 1
+        return created, dups
+
     def _leaves(self):
         stack = [self.root]
         while stack:
@@ -689,12 +798,15 @@ class PagedKV:
             pid = self.pool.alloc()
         return pid
 
-    def match_prefix(self, slot: int, ids) -> int:
+    def match_prefix(self, slot: int, ids, cap_last: bool = True) -> int:
         """Admission half of prefix sharing: find the longest cached
         prefix of ``ids``, take references on its pages into ``slot``'s
         table, and return the cached length (capped at ``len(ids) - 1``
         so the last prompt token always runs through the model — its
-        logits seed the first sampled token).
+        logits seed the first sampled token). ``cap_last=False`` lifts
+        that cap for the disaggregated handoff seat: the prefill worker
+        already sampled the first token, so the decode worker may share
+        the FULL prompt and never dispatch a prefill at all.
 
         Idempotent under the batcher's dispatch retry: any holdings a
         FAILED earlier admission attempt left in this slot (shared refs,
@@ -712,7 +824,7 @@ class PagedKV:
         if not self.prefix_cache:
             return 0
         pages, matched = self.radix.match(ids)
-        cached = min(matched, len(ids) - 1)
+        cached = min(matched, len(ids) - (1 if cap_last else 0))
         npages = self.pages_for(cached)
         for i in range(npages):
             self.pool.ref(pages[i])
@@ -748,6 +860,54 @@ class PagedKV:
                 self.pool.unref(pid)
                 self.cow_copies += 1
         return cows
+
+    # ---- page transport (prefill/decode disaggregation) -------------------
+
+    def acquire_prefix(self, ids) -> tuple:
+        """Export pin: radix-match ``ids`` and take a TRANSIENT reference
+        on every matched page so eviction (and any COW planning) cannot
+        touch them while the transport serializes their bytes. Returns
+        (page_ids, matched_tokens); the caller MUST ``release_pages`` the
+        returned pages when done — the pin is a holder like any other."""
+        if not self.prefix_cache:
+            return [], 0
+        pages, matched = self.radix.match(ids)
+        npages = self.pages_for(matched)
+        held = []
+        for i in range(npages):
+            self.pool.ref(pages[i])
+            held.append(int(pages[i]))
+        return held, matched
+
+    def release_pages(self, pids) -> None:
+        """Drop the transient references ``acquire_prefix`` (or a failed
+        import) holds. Double drops raise — the pool's own discipline."""
+        for pid in pids:
+            self.pool.unref(int(pid))
+
+    def alloc_import(self, n: int) -> list:
+        """Allocate ``n`` pages for a transport import (refcount 1 held
+        by the importer). All-or-nothing: on exhaustion every page of
+        this batch is released before the raise, so a failed import can
+        never leak pool capacity."""
+        pids = []
+        try:
+            for _ in range(n):
+                pids.append(self._alloc())
+        except PagePoolExhausted:
+            self.release_pages(pids)
+            raise
+        return pids
+
+    def finish_import(self, ids, chunk_pids: dict) -> int:
+        """Graft written import pages into the radix cache and drop the
+        importer's references: created nodes end held by the cache alone
+        (refcount 1, evictable — exactly a registered prompt's state);
+        duplicate chunks' pages free immediately. Returns nodes
+        created."""
+        created, _ = self.radix.adopt(ids, chunk_pids)
+        self.release_pages(chunk_pids.values())
+        return created
 
     def register_prompt(self, slot: int, ids) -> None:
         """Insert a freshly prefilled prompt's pages into the radix

@@ -308,7 +308,16 @@ class RouterChaos:
     - ``sever_stream(name, n)`` — the router's ``/generate`` stream from
       that replica raises ``ConnectionResetError`` after the n-th token
       row (once): the raw connection-drop flavor of a mid-stream death,
-      as opposed to ``kill``'s dispatch-death flavor.
+      as opposed to ``kill``'s dispatch-death flavor;
+    - ``kill_on_export(name, server)`` — the disaggregation drill's
+      prefill-worker death MID-HANDOFF: the moment the router opens a
+      ``/kv/export`` toward ``name``, the backing server is killed (the
+      POST lands on a closed listener) — the router must fall back to
+      re-prefill at a survivor with the client none the wiser (once);
+    - ``sever_export(name)``    — the page stream severs MID-TRANSFER:
+      the export response's body read raises ``ConnectionResetError``
+      after the head arrived, the torn-payload flavor (a partial body
+      would also die at the transport's CRC) (once).
 
     Thread-safety: the injection sets are mutated by the drill thread and
     read by prober/handler threads; one leaf lock guards them (the same
@@ -321,6 +330,8 @@ class RouterChaos:
         self._sever: dict = {}  # replica name -> sever after N token rows
         self._stalled: dict = {}  # id(front) -> original healthy()
         self._flapped: dict = {}  # id(front) -> (healthy, ready)
+        self._kill_on_export: dict = {}  # replica name -> serve.Server
+        self._sever_export: set = set()  # replica names (fire once)
 
     # ---- replica-side ------------------------------------------------------
 
@@ -384,6 +395,38 @@ class RouterChaos:
         """Router prober hook: should this replica's /metrics read fail?"""
         with self._mu:
             return name in self._scrape_fail
+
+    def kill_on_export(self, name: str, server) -> None:
+        """Arm: the next /kv/export the router opens toward ``name``
+        kills ``server`` first (prefill-worker death mid-handoff)."""
+        with self._mu:
+            self._kill_on_export[name] = server
+
+    def sever_export(self, name: str) -> None:
+        """Arm: the next /kv/export response from ``name`` severs while
+        the router reads the page payload (torn transfer)."""
+        with self._mu:
+            self._sever_export.add(name)
+
+    def on_export(self, name: str) -> None:
+        """Router handoff hook: fires as an export toward ``name`` opens.
+        Consumes a kill_on_export event — the POST then lands on a dead
+        listener, the realistic mid-handoff death."""
+        with self._mu:
+            server = self._kill_on_export.pop(name, None)
+        if server is not None:
+            self.kill(server)
+
+    def on_export_read(self, name: str) -> None:
+        """Router handoff hook: fires between the export response head
+        and its body read. Consumes a sever_export event."""
+        with self._mu:
+            fire = name in self._sever_export
+            self._sever_export.discard(name)
+        if fire:
+            raise ConnectionResetError(
+                f"router chaos: export page stream from {name} severed "
+                f"mid-transfer")
 
     def sever_stream(self, name: str, after_tokens: int) -> None:
         with self._mu:

@@ -37,6 +37,22 @@ health state still updates, but the scrape goes stale and the replica
 drops out of placement without tripping the breaker. When no replica is
 eligible the router answers 503 with ``Retry-After``.
 
+**Prefill/decode disaggregation** (``RouterConfig.disagg``,
+docs/SERVING.md "Disaggregated prefill/decode") — when the fleet holds
+``inference.role: prefill`` replicas, each prompt's prefill routes to
+its affinity prefill worker (``POST /kv/export``), the finished KV pool
+pages ride to the least-loaded DECODE placement inside the ``/generate``
+body (the replica seats them + the first token with zero prefill
+dispatches), and the token stream splices to the client as usual. A
+prefill worker dying mid-export or a page stream severed mid-transfer
+falls back to self-prefill at the decode placement — nothing was
+streamed, so the client cannot tell. Prefill-only replicas are never
+decode candidates (they would otherwise score as idle decode targets).
+On a plain placement that escaped its affinity owner,
+``RouterConfig.prefix_fetch`` pulls the owner's longest cached prefix
+(``/kv/pages`` -> ``/kv/import``) so shared prefixes still prefill once
+per cluster.
+
 **Mid-stream failover replay** — the router always streams from the
 replica and records every token it delivers to the client. When a
 replica dies mid-stream (connection drop, torn NDJSON row, 5xx, a
@@ -192,6 +208,27 @@ def _get_text(host: str, port: int, path: str, timeout: float) -> tuple:
             f"GET {path}: {type(e).__name__}: {e}") from e
 
 
+def _post_json(host: str, port: int, path: str, payload: dict,
+               timeout: float, on_read=None) -> tuple:
+    """POST a JSON body, read a JSON response. ``on_read`` fires between
+    the response head and the body read — the chaos hook that severs a
+    page stream mid-transfer."""
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("POST", path, json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if on_read is not None:
+                on_read()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+    except _TRANSPORT_ERRORS as e:
+        raise ReplicaFailure(
+            f"POST {path}: {type(e).__name__}: {e}") from e
+
+
 # --------------------------------------------------------------------------- #
 # replica record
 # --------------------------------------------------------------------------- #
@@ -213,6 +250,7 @@ class Replica:
         self.trial = False  # half-open: one live trial request at a time
         self.ready = False
         self.draining = False
+        self.role = "both"  # from the readyz body: prefill|decode|both
         self.scrape: dict = {}  # parsed load terms from /metrics
         self.scrape_t = float("-inf")  # monotonic time of last good scrape
         self.inflight = 0  # router-placed requests currently streaming
@@ -224,6 +262,7 @@ class Replica:
                 "addr": f"{self.host}:{self.port}",
                 "breaker": self.breaker,
                 "ready": self.ready,
+                "role": self.role,
                 "draining": self.draining,
                 "consecutive_failures": self.fails,
                 "inflight": self.inflight,
@@ -280,6 +319,23 @@ class Router:
             "placements refused (shed/unreachable) and retried elsewhere")
         self._route_hist = self.registry.histogram(
             "picotron_router_route_seconds", "accept -> terminal response")
+        # disaggregation plane: handoff round trips (prefill worker ->
+        # router -> decode worker) and cross-replica prefix fetches
+        self._handoff_hist = self.registry.histogram(
+            "picotron_router_handoff_seconds",
+            "/kv/export round trip incl. the remote prefill")
+        self._handoff_bytes = self.registry.counter(
+            "picotron_router_handoff_bytes_total",
+            "raw KV page bytes relayed through handoffs")
+        self._handoffs = self.registry.counter_dict(
+            "picotron_router_handoffs_total",
+            ("served", "fallback"),
+            help="prefill/decode handoffs by outcome", label="outcome")
+        self._prefix_fetches = self.registry.counter_dict(
+            "picotron_router_prefix_fetches_total",
+            ("hit", "miss", "error"),
+            help="cross-replica prefix-cache fetches by outcome",
+            label="outcome")
         self._rid_mu = threading.Lock()
         self._rid_seq = 0
         self._stop = threading.Event()
@@ -353,6 +409,7 @@ class Router:
         st, body = _get_json(rep.host, rep.port, "/readyz", t)
         draining = (body.get("state") == "draining"
                     or bool(body.get("draining")))
+        role = body.get("role") or "both"
         if st != 200 and not draining:
             raise ReplicaFailure(
                 f"{rep.name}: readyz {st} (state="
@@ -375,7 +432,7 @@ class Router:
         except ReplicaFailure:
             scrape = None
         self._probe_ok(rep, ready=st == 200, draining=draining,
-                       scrape=scrape)
+                       scrape=scrape, role=role)
 
     def _transition(self, rep: Replica, to: str) -> None:
         """Count + log one breaker transition. Called WITH ``rep._mu``
@@ -386,12 +443,13 @@ class Router:
             "circuit-breaker state changes", replica=rep.name, to=to).inc()
 
     def _probe_ok(self, rep: Replica, ready: bool, draining: bool,
-                  scrape: Optional[dict]) -> None:
+                  scrape: Optional[dict], role: str = "both") -> None:
         now = self._clock()
         opened_to = None
         with rep._mu:
             rep.ready = ready
             rep.draining = draining
+            rep.role = role
             if scrape is not None:
                 rep.scrape = scrape
                 rep.scrape_t = now
@@ -508,14 +566,23 @@ class Router:
                 + c.load_pool_weight * s.get("pool_utilization", 0.0)
                 + c.load_ttft_weight * s.get("ttft_p95", 0.0))
 
-    def _candidates(self, excluded=()) -> list:
-        """[(replica, load)] of currently placeable replicas."""
+    def _candidates(self, excluded=(), kind: str = "decode") -> list:
+        """[(replica, load)] of currently placeable replicas for ``kind``
+        of work: "decode" (the /generate path — prefill-only replicas are
+        NOT candidates, they would otherwise score as idle decode
+        targets) or "prefill" (the /kv/export handoff — dedicated
+        prefill workers only; a fleet without any simply serves
+        colocated)."""
         now = self._clock()
         out = []
         for rep in self.replicas.values():
             if rep.name in excluded:
                 continue
             with rep._mu:
+                if kind == "decode" and rep.role == "prefill":
+                    continue
+                if kind == "prefill" and rep.role != "prefill":
+                    continue
                 if rep.breaker == "open":
                     continue
                 if rep.breaker == "half_open" and rep.trial:
@@ -530,13 +597,28 @@ class Router:
     def _eligible(self) -> list:
         return [rep for rep, _ in self._candidates()]
 
-    def place(self, prompt, excluded=()) -> Optional[Replica]:
+    def _affinity_owner(self, prompt) -> Optional[Replica]:
+        """The rendezvous-top decode candidate for ``prompt``'s prefix
+        key (load ignored): the replica whose radix cache accumulates
+        this prefix under affinity placement — the cross-replica lookup's
+        source of truth. None for page-less prompts or an empty set."""
+        key = prefix_key(prompt, self.cfg.affinity_page_len)
+        if key is None:
+            return None
+        cands = self._candidates()
+        if not cands:
+            return None
+        return max((rep for rep, _ in cands),
+                   key=lambda rep: _rendezvous(key, rep.name))
+
+    def place(self, prompt, excluded=(),
+              kind: str = "decode") -> Optional[Replica]:
         """Pick a replica for ``prompt`` (None when nothing is eligible):
         the rendezvous affinity pick while it is within
         ``affinity_load_slack`` of the least-loaded candidate, else
         least-loaded. Reserves an inflight slot (and the half-open trial
         token) on the pick."""
-        cands = self._candidates(excluded)
+        cands = self._candidates(excluded, kind=kind)
         key = prefix_key(prompt, self.cfg.affinity_page_len)
         while cands:
             best = min(load for _, load in cands)
@@ -570,6 +652,124 @@ class Router:
             return pick
         return None
 
+    # ---- disaggregation: handoff export + cross-replica prefix fetch ------
+
+    def _export_handoff(self, spec: dict, rid: str, prompt: list,
+                        tracer, root) -> Optional[dict]:
+        """Run the prompt's prefill at its affinity PREFILL worker and
+        return the KV transport payload (POST /kv/export), or None — no
+        prefill workers, all refused, or every attempt failed — in which
+        case the caller falls back to self-prefill at the decode
+        placement (nothing was streamed to the client, so this is the
+        replay bookkeeping's zero-delivered path). Export failures feed
+        the breaker exactly like request failures; sheds are graceful."""
+        tried: set = set()
+        for _ in range(self.cfg.place_attempts):
+            rep = self.place(prompt, excluded=tried, kind="prefill")
+            if rep is None:
+                break
+            sub = {"prompt": prompt, "request_id": rid,
+                   "uid": f"{rid}.pf{len(tried) + 1}"}
+            for k in ("temperature", "top_k", "top_p", "eos_id",
+                      "timeout_s"):
+                if k in spec:
+                    sub[k] = spec[k]
+            span = tracer.begin("handoff", parent=root, request_id=rid,
+                                replica=rep.name)
+            t0 = self._clock()
+            try:
+                if self.chaos is not None:
+                    self.chaos.on_export(rep.name)
+                st, body = _post_json(
+                    rep.host, rep.port, "/kv/export", sub,
+                    self.cfg.handoff_timeout_s,
+                    on_read=(None if self.chaos is None else
+                             lambda: self.chaos.on_export_read(rep.name)))
+                if st in (429, 503):
+                    self._request_refused(rep)
+                    tried.add(rep.name)
+                    tracer.end(span, outcome="refused")
+                    continue
+                if st == 400:
+                    # the CLIENT's bad request, not the replica's fault
+                    # (the same discipline as _attempt's client_error):
+                    # no breaker feedback — fall back so the decode
+                    # placement's /generate returns the client-visible
+                    # 400 through the normal path
+                    self._request_refused(rep)
+                    tracer.end(span, outcome="client_error")
+                    return None
+                if st != 200 or not isinstance(body.get("kv"), dict):
+                    raise ReplicaFailure(
+                        f"{rep.name}: POST /kv/export {st}")
+                payload = body["kv"]
+                self._request_success(rep)
+                dt = self._clock() - t0
+                self._handoff_hist.observe(dt)
+                self._handoff_bytes.inc(int(payload.get("bytes_total", 0)))
+                with self._ctr_mu:
+                    self._handoffs["served"] += 1
+                tracer.end(span, outcome="served",
+                           tokens=len(payload.get("token_ids", ())),
+                           bytes=int(payload.get("bytes_total", 0)))
+                return payload
+            except ReplicaFailure as e:
+                # prefill-worker death (or a severed page stream)
+                # mid-handoff: breaker feedback, then the next prefill
+                # worker — or the caller's re-prefill fallback
+                self._request_failure(rep, str(e))
+                tried.add(rep.name)
+                tracer.end(span, outcome="failed", error=str(e)[:200])
+                self._event("handoff_failed", request_id=rid,
+                            replica=rep.name, why=str(e))
+                continue
+        # prefill workers exist in the fleet but none produced a payload
+        # (refused, failed, breaker-open, draining): the decode placement
+        # self-prefills — the degradation signal an operator watches.
+        # A fleet with NO prefill-role replicas is colocated by design,
+        # not degraded, and counts nothing.
+        has_prefill = False
+        for rep in self.replicas.values():
+            with rep._mu:
+                if rep.role == "prefill":
+                    has_prefill = True
+                    break
+        if tried or has_prefill:
+            with self._ctr_mu:
+                self._handoffs["fallback"] += 1
+        return None
+
+    def _prefix_fetch(self, owner: Replica, rep: Replica,
+                      prompt: list) -> None:
+        """Cross-replica prefix-cache lookup: pull ``owner``'s longest
+        cached page-aligned prefix of ``prompt`` and import it at
+        ``rep`` — a placement that escaped its affinity owner still
+        reuses the cluster's one prefill of the shared prefix. SOFT end
+        to end: every failure is counted and skipped, never a breaker
+        verdict or a client error (the worst case is the prefill the
+        escape would have paid anyway)."""
+        outcome = "error"
+        try:
+            st, body = _post_json(owner.host, owner.port, "/kv/pages",
+                                  {"ids": prompt},
+                                  self.cfg.probe_timeout_s)
+            if st != 200 or body.get("matched", 0) \
+                    < self.cfg.affinity_page_len:
+                outcome = "miss"
+                return
+            st, _ = _post_json(rep.host, rep.port, "/kv/import",
+                               {"kv": body["kv"]},
+                               self.cfg.handoff_timeout_s)
+            if st == 200:
+                outcome = "hit"
+                self._handoff_bytes.inc(
+                    int(body["kv"].get("bytes_total", 0)))
+        except ReplicaFailure:
+            pass
+        finally:
+            with self._ctr_mu:
+                self._prefix_fetches[outcome] += 1
+
     # ---- request path -----------------------------------------------------
 
     def route(self, spec: dict, rid: str, on_token=None) -> dict:
@@ -601,7 +801,17 @@ class Router:
         finish = None
         last_replica = None
         state = "failed"
+        prefix_fetched = False
         try:
+            # disaggregated prefill: hand the prompt to its affinity
+            # prefill worker FIRST — the decode placement then seats the
+            # returned pages instead of burning dispatch rounds on the
+            # prefill (None = no prefill workers / export failed: the
+            # decode placement self-prefills, nothing client-visible)
+            kv_payload = None
+            if self.cfg.disagg:
+                kv_payload = self._export_handoff(spec, rid, prompt,
+                                                  tracer, root)
             while True:
                 if delivered:
                     # failover landed exactly on a finished generation:
@@ -623,10 +833,20 @@ class Router:
                         self.cfg.retry_after_s)
                 attempt += 1
                 last_replica = rep.name
+                if (kv_payload is None and not delivered and not
+                        prefix_fetched and self.cfg.prefix_fetch):
+                    # no handoff payload to seat: if the placement escaped
+                    # its affinity owner, pull the owner's cached prefix
+                    # so the shared prefix still prefills once per cluster
+                    prefix_fetched = True
+                    owner = self._affinity_owner(prompt)
+                    if owner is not None and owner.name != rep.name:
+                        self._prefix_fetch(owner, rep, prompt)
                 try:
                     outcome, detail = self._attempt(
                         rep, spec, rid, attempt, prompt, delivered,
-                        max_new, on_token, root, tracer)
+                        max_new, on_token, root, tracer,
+                        kv_payload=kv_payload)
                 except BaseException:
                     # a non-replica abort (the CLIENT dropped its
                     # connection mid-splice): release the placement slot
@@ -715,18 +935,32 @@ class Router:
 
     def _attempt(self, rep: Replica, spec: dict, rid: str, n: int,
                  prompt: list, delivered: list, max_new: int,
-                 on_token, root, tracer) -> tuple:
+                 on_token, root, tracer, kv_payload=None) -> tuple:
         """One placement attempt: stream ``/generate`` from ``rep``,
         appending tokens to ``delivered`` as they arrive. Returns
         ``(outcome, detail)`` with outcome one of ``served`` (detail =
         finish_reason), ``refused`` (shed — nothing streamed), ``failed``
-        (hard failure; ``delivered`` may have grown), ``client_error``."""
+        (hard failure; ``delivered`` may have grown), ``client_error``.
+
+        ``kv_payload`` is the disaggregated handoff: on the first
+        attempt (nothing delivered) the replica seats it — first token
+        included — with zero prefill dispatches; on a replay the payload
+        rides along WITHOUT its first token as a prefix hint, so the
+        survivor radix-hits the prompt and prefills only the delivered
+        continuation (bit-identical greedy either way)."""
         sub = {"prompt": prompt + delivered,
                "max_new_tokens": max_new - len(delivered),
                "stream": True, "uid": f"{rid}.a{n}", "request_id": rid}
         for k in ("temperature", "top_k", "top_p", "eos_id", "timeout_s"):
             if k in spec:
                 sub[k] = spec[k]
+        if kv_payload is not None:
+            kv = dict(kv_payload)
+            if delivered:
+                # the first token was already delivered: the payload now
+                # vouches for pages only, never a token
+                kv.pop("first_token", None)
+            sub["kv"] = kv
         span = tracer.begin("attempt", parent=root, request_id=rid,
                             replica=rep.name, n=n)
         got = 0
@@ -818,6 +1052,8 @@ class Router:
             "replicas currently placeable").set(len(eligible))
         with self._ctr_mu:
             requests = dict(self.requests)
+            handoffs = dict(self._handoffs)
+            prefix_fetches = dict(self._prefix_fetches)
         return {
             "replicas": reps,
             "eligible": eligible,
@@ -825,6 +1061,10 @@ class Router:
             "replays": int(self._replays.value),
             "placement_retries": int(self._placement_retries.value),
             "route_s": self._route_hist.percentiles(),
+            "handoffs": handoffs,
+            "handoff_bytes": int(self._handoff_bytes.value),
+            "handoff_s": self._handoff_hist.percentiles(),
+            "prefix_fetches": prefix_fetches,
             "uptime_s": round(now - self._start_t, 3),
         }
 
@@ -1053,10 +1293,12 @@ def _breaker(router: Router, name: str) -> str:
         return rep.breaker
 
 
-def _smoke_fleet(n: int):
+def _smoke_fleet(n: int, roles=None):
     """n in-process serve.py replicas over IDENTICAL tiny random-init
     models (same seed -> same params -> greedy outputs are a shared
-    bit-exact oracle), streaming per token (decode_block_len 1)."""
+    bit-exact oracle), streaming per token (decode_block_len 1).
+    ``roles`` (e.g. ``("prefill", "decode")``) builds a disaggregated
+    fleet on the paged layout — the KV-page transport's requirement."""
     import jax
 
     from picotron_tpu.config import Config
@@ -1069,9 +1311,13 @@ def _smoke_fleet(n: int):
     servers = []
     cfg0 = Config.from_dict(SMOKE_CONFIG)
     jit_init = jax.jit(lambda k: llama.init_params(k, cfg0.model))
-    for _ in range(n):
+    for i in range(n):
         cfg = Config.from_dict(SMOKE_CONFIG)
         cfg.inference.decode_block_len = 1
+        if roles is not None:
+            cfg.inference.role = roles[i]
+            cfg.inference.kv_layout = "paged"
+            cfg.inference.kv_page_len = 8
         _ensure_devices(cfg)
         engine = InferenceEngine(cfg, slots=2, max_seq_len=64)
         params = engine.shard_params(jit_init(jax.random.PRNGKey(0)))
@@ -1080,6 +1326,98 @@ def _smoke_fleet(n: int):
         srv.start()
         servers.append(srv)
     return servers
+
+
+def _smoke_disagg(check) -> None:
+    """The disaggregation rungs of `make router-chaos-smoke` (ISSUE 15):
+    a prefill + decode two-role fleet behind a fresh router — the happy
+    handoff (decode worker seats pages, zero prefill dispatches), then
+    the chaos pair: sever the page stream mid-transfer and kill the
+    prefill worker mid-export. In every case the client gets every token
+    exactly once, greedy bit-identical to the decode worker's own
+    self-prefilled run."""
+    from picotron_tpu.resilience.chaos import RouterChaos
+    from picotron_tpu.tools import serve
+
+    servers = _smoke_fleet(2, roles=("prefill", "decode"))
+    pre, dec = servers
+    names = [f"127.0.0.1:{s.port}" for s in servers]
+    chaos = RouterChaos()
+    cfg = RouterConfig(
+        probe_interval_s=0.05, probe_timeout_s=2.0, breaker_failures=3,
+        breaker_backoff_s=0.05, breaker_backoff_max_s=0.4,
+        scrape_stale_s=2.0, connect_timeout_s=5.0)
+    rs = RouterServer(names, cfg, chaos=chaos, log=lambda *a, **k: None)
+    rs.start()
+    router = rs.router
+    try:
+        check("disagg_fleet_eligible", _wait_for(
+            lambda: len(router._candidates(kind="prefill")) == 1
+            and len(router._eligible()) == 1, timeout=30))
+        check("disagg_roles_probed",
+              router.replicas[names[0]].snapshot(0)["role"] == "prefill"
+              and router.replicas[names[1]].snapshot(0)["role"] == "decode")
+
+        def run(prompt, rid):
+            st, rows = _stream_post(
+                rs.port, {"prompt": prompt, "max_new_tokens": 12,
+                          "request_id": rid})
+            toks = [r["token"] for r in rows if r.get("event") == "token"]
+            done = [r for r in rows if r.get("event") == "done"]
+            ok = (st == 200 and len(done) == 1
+                  and done[0]["finish_reason"] == "length"
+                  and done[0]["tokens"] == toks and len(toks) == 12)
+            return ok, toks
+
+        def oracle(prompt):
+            # the decode worker self-prefills a direct request: the
+            # greedy oracle for the same prompt (prefix sharing is
+            # output-invariant — pinned in tests/test_paged_kv.py)
+            st, body = serve._post(dec.port, {"prompt": prompt,
+                                              "max_new_tokens": 12})
+            return st == 200 and body["finish_reason"] == "length", \
+                body.get("tokens")
+
+        # happy handoff: prefill worker exports, decode worker seats
+        p1 = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3, 2, 3]
+        ok, toks = run(p1, "dg-1")
+        stz = serve._get(dec.port, "/statz")[1]
+        check("disagg_handoff_served",
+              ok and stz["handoff_seated"] == 1
+              and stz["prefill_dispatches"] == 0
+              and router.stats()["handoffs"]["served"] == 1
+              and router.stats()["handoff_bytes"] > 0)
+        ook, otoks = oracle(p1)
+        check("disagg_bit_identical", ook and otoks == toks)
+        pstz = serve._get(pre.port, "/statz")[1]
+        check("disagg_prefill_worker_prefilled",
+              pstz["admitted"] == 1 and pstz["completed"] == 1)
+
+        # sever the page stream mid-transfer: fallback self-prefill,
+        # exactly-once tokens, bit-identical
+        chaos.sever_export(names[0])
+        p2 = [11, 12, 13, 14, 15, 16, 17, 18, 11, 12, 13, 14, 15, 16,
+              17, 18, 19, 20]
+        ok, toks = run(p2, "dg-sever")
+        ook, otoks = oracle(p2)
+        check("disagg_sever_exactly_once",
+              ok and ook and otoks == toks
+              and router.stats()["handoffs"]["fallback"] >= 1)
+
+        # kill the prefill worker mid-export: same client contract
+        chaos.kill_on_export(names[0], pre)
+        p3 = [21, 22, 23, 24, 25, 26, 27, 28, 21, 22, 23, 24, 25, 26,
+              27, 28, 29, 30]
+        ok, toks = run(p3, "dg-kill")
+        ook, otoks = oracle(p3)
+        check("disagg_kill_mid_export_exactly_once",
+              ok and ook and otoks == toks)
+    finally:
+        rs.stop()
+        try:
+            dec.drain_and_join(timeout=60)
+        except OSError:
+            pass
 
 
 def _smoke() -> int:
@@ -1268,6 +1606,10 @@ def _smoke() -> int:
               and kill_roots and kill_roots[0] in replay_ids
               and sum(1 for a in attempts
                       if a["args"].get("parent") == kill_roots[0]) == 2)
+
+        # ---- disaggregation rungs (ISSUE 15): two-role fleet, happy ----
+        # handoff, severed page stream, prefill-worker death mid-export
+        _smoke_disagg(check)
     finally:
         rs.stop()
         for nm, srv in by_name.items():

@@ -52,6 +52,15 @@ API (all bodies JSON):
   (``{"seconds", "dir"}`` optional; defaults from ``obs.profile_dir`` /
   ``obs.profile_seconds``); 409 while one is running. The CLI wires
   SIGUSR2 to the same capture.
+- ``POST /kv/export`` / ``GET|POST /kv/pages`` / ``POST /kv/import`` —
+  the prefill/decode disaggregation plane (``inference.role``,
+  inference/page_transport.py, docs/SERVING.md "Disaggregated
+  prefill/decode"): a prefill worker runs admission + prefill and hands
+  the finished KV pool pages off as a byte-exact payload (+ the first
+  sampled token); ``/kv/pages`` looks up the longest radix-cached
+  prefix; ``/kv/import`` lands a payload in the local radix cache; and
+  ``/generate``'s ``"kv"`` field seats a full-prompt payload with zero
+  prefill dispatches. Paged layout only.
 
 Admission control (checked atomically at POST time):
 
@@ -171,6 +180,17 @@ class FrontEnd:
             "picotron_weight_bytes",
             "model weight bytes resident on this replica").set(
                 float(self.weight_bytes))
+        # disaggregated serving role (inference.role, docs/SERVING.md
+        # "Disaggregated prefill/decode"): "both" serves exactly as
+        # before; "prefill" runs admission + prefill only and hands KV
+        # pages off via POST /kv/export (its /generate sheds); "decode"
+        # seats imported pages and runs the decode/spec loop. The role
+        # gauge lets a router scrape tell a prefill worker from an idle
+        # decode target (it would otherwise score as one).
+        self.role = engine.cfg.inference.role
+        self.obs.registry.gauge(
+            "picotron_serve_role",
+            "serving role of this replica", role=self.role).set(1.0)
         self.draining = False
         self.stopped = threading.Event()  # dispatch loop has exited
         self.dead = False  # loop died on an exception (vs clean drain)
@@ -182,7 +202,7 @@ class FrontEnd:
         self.rejections = self.obs.registry.counter_dict(
             "picotron_rejections_total",
             ("queue_full", "token_budget", "page_budget", "draining",
-             "stalled", "dead"),
+             "stalled", "dead", "role"),
             help="admission sheds by reason", label="reason")
         # leaf lock for the rejection counters: the "stalled" increment
         # happens precisely when _mu could NOT be acquired, so the
@@ -219,16 +239,56 @@ class FrontEnd:
 
     # ---- admission --------------------------------------------------------
 
-    def submit(self, spec: dict) -> tuple:
+    def submit(self, spec: dict, _internal: bool = False) -> tuple:
         """Admission-check + submit one request. Returns (uid, waiter) or
-        raises AdmissionError (the caller turns it into 429/503)."""
+        raises AdmissionError (the caller turns it into 429/503).
+        ``_internal`` marks the /kv/export path's own 1-token submission,
+        which a role=prefill replica must accept even though its public
+        /generate sheds."""
         from picotron_tpu.inference import Request
 
+        if self.role == "prefill" and not _internal:
+            # a prefill worker's dispatch rounds belong to prefills; a
+            # decode stream here would be the interference the role split
+            # exists to remove. 503 (not 400): the client did nothing
+            # wrong, the router just mis-placed.
+            self._reject("role")
+            raise AdmissionError(
+                503, "replica serves prefill only (inference.role: "
+                     "prefill); use POST /kv/export", retry_after=5)
         prompt = spec.get("prompt")
         if not isinstance(prompt, list) or not prompt \
                 or not all(isinstance(t, int) for t in prompt):
             raise AdmissionError(400, "prompt must be a non-empty list of "
                                       "token ids", retry_after=0)
+        kv = spec.get("kv")
+        if kv is not None:
+            # the disaggregated handoff payload: validate its spec BEFORE
+            # taking a slot. A payload this replica can never consume —
+            # contiguous layout, mismatched page geometry/dtype — is
+            # DROPPED, not rejected: the request is still perfectly
+            # servable by self-prefilling, and a mixed or mid-upgrade
+            # fleet must degrade to colocated behavior, never to client
+            # 400s (the capability gap is logged + counted).
+            from picotron_tpu.inference import page_transport
+
+            if not isinstance(kv, dict):
+                raise AdmissionError(400, "kv must be a transport payload "
+                                          "object", retry_after=0)
+            why = None
+            if self.engine.paged is None:
+                why = "contiguous kv_layout (no page pool)"
+            else:
+                try:
+                    page_transport.check_spec(self.engine, kv)
+                except page_transport.TransportError as e:
+                    why = str(e)
+            if why is not None:
+                self.obs.registry.counter(
+                    "picotron_handoff_dropped_total",
+                    "kv payloads dropped as locally unusable").inc()
+                self._event("kv_dropped", why=why[:200])
+                kv = None
         timeout_s = spec.get("timeout_s", self.default_timeout_s)
         try:
             req = Request(
@@ -239,7 +299,8 @@ class FrontEnd:
                 top_k=int(spec.get("top_k", 0)),
                 top_p=float(spec.get("top_p", 1.0)),
                 eos_id=spec.get("eos_id"),
-                timeout_s=None if timeout_s is None else float(timeout_s))
+                timeout_s=None if timeout_s is None else float(timeout_s),
+                kv_import=kv)
         except (TypeError, ValueError) as e:
             raise AdmissionError(400, f"bad request field: {e}",
                                  retry_after=0)
@@ -332,6 +393,111 @@ class FrontEnd:
         with self._uid_mu:
             self._uid_seq += 1
             return f"r{self._uid_seq}"
+
+    # ---- KV-page transport (prefill/decode disaggregation) ----------------
+
+    def _require_paged(self) -> None:
+        if self.engine.paged is None:
+            raise AdmissionError(
+                503, "kv transport requires inference.kv_layout: 'paged' "
+                     "on this replica", retry_after=0)
+
+    def kv_export(self, spec: dict) -> dict:
+        """POST /kv/export: run ``spec``'s prompt through the normal
+        admission + prefill path with a 1-token budget (the one sampled
+        token IS the handoff's seat state), then serialize the prompt's
+        radix-cached pages as a transport payload. A repeat of a cached
+        prompt prefills only its final token — the radix cache makes the
+        prefill worker the cluster's prefix bank. Raises AdmissionError
+        on shed/failure (the router's fallback trigger)."""
+        if self.role == "decode":
+            raise AdmissionError(
+                503, "replica serves decode only (inference.role: "
+                     "decode); export from a prefill/both replica",
+                retry_after=5)
+        self._require_paged()
+        prompt = spec.get("prompt")
+        sub = dict(spec)
+        sub["max_new_tokens"] = 1
+        sub.pop("stream", None)
+        sub.pop("kv", None)
+        uid, waiter = self.submit(sub, _internal=True)
+        while True:
+            kind, val = waiter.events.get()
+            if kind == "done":
+                res = val
+                break
+        if res.finish_reason == "shed":
+            raise AdmissionError(503, "prefill shed (draining)",
+                                 retry_after=5)
+        if res.finish_reason not in ("length", "eos") or not res.tokens:
+            raise AdmissionError(
+                500, f"prefill finished {res.finish_reason!r}",
+                retry_after=1)
+        first = int(res.tokens[0])
+        if not self._mu.acquire(timeout=30.0):
+            raise AdmissionError(503, "dispatch stalled (export "
+                                      "unavailable)", retry_after=10)
+        try:
+            payload = self._batcher.export_prefix(prompt,
+                                                  first_token=first)
+        finally:
+            self._mu.release()
+        self._event("kv_export", uid=uid, tokens=len(payload["token_ids"]),
+                    pages=len(payload["pages"]),
+                    bytes=payload["bytes_total"],
+                    ttft_s=_r(res.ttft_s))
+        return {"uid": uid, "kv": payload,
+                "queue_wait_s": _r(res.queue_wait_s),
+                "ttft_s": _r(res.ttft_s)}
+
+    def kv_import(self, payload: dict) -> dict:
+        """POST /kv/import: land a transport payload in the local pool +
+        radix cache (no slot — the cross-replica prefix-cache transfer).
+        A subsequent /generate for a prompt extending it radix-hits
+        locally, zero prefill dispatches for the covered prefix."""
+        from picotron_tpu.inference import page_transport
+        from picotron_tpu.inference.paged_kv import PagePoolExhausted
+
+        self._require_paged()
+        if not self._mu.acquire(timeout=10.0):
+            raise AdmissionError(503, "dispatch stalled (import "
+                                      "unavailable)", retry_after=10)
+        try:
+            if self.stopped.is_set() or self.draining:
+                raise AdmissionError(503, "draining (restart in progress)",
+                                     retry_after=5)
+            try:
+                info = self._batcher.import_prefix(payload)
+            except page_transport.TransportError as e:
+                raise AdmissionError(400, f"bad kv payload: {e}",
+                                     retry_after=0)
+            except PagePoolExhausted:
+                raise AdmissionError(429, "kv page pool exhausted",
+                                     retry_after=5)
+        finally:
+            self._mu.release()
+        self._event("kv_import", **info)
+        return info
+
+    def kv_pages(self, ids) -> dict:
+        """GET/POST /kv/pages: the cross-replica prefix LOOKUP — the
+        longest radix-cached prefix of ``ids`` as a transport payload
+        (no first token: a lookup vouches for pages, not logits).
+        ``matched`` 0 = miss (an empty payload, nothing to import)."""
+        self._require_paged()
+        if (not isinstance(ids, list) or not ids
+                or not all(isinstance(t, int) for t in ids)):
+            raise AdmissionError(400, "ids must be a non-empty list of "
+                                      "token ids", retry_after=0)
+        if not self._mu.acquire(timeout=10.0):
+            raise AdmissionError(503, "dispatch stalled (lookup "
+                                      "unavailable)", retry_after=10)
+        try:
+            payload = self._batcher.export_prefix(ids)
+        finally:
+            self._mu.release()
+        return {"matched": len(payload["token_ids"]), "kv": payload}
 
     # ---- dispatch loop ----------------------------------------------------
 
@@ -453,6 +619,12 @@ class FrontEnd:
         # depth/occupancy gauges are point-in-time reads: refresh them so
         # a scraper that never touches /statz still sees current values
         self._batcher.refresh_gauges()
+        # the prefill-queue depth a disaggregated router watches: on a
+        # prefill worker every queued request IS a waiting prefill
+        self.obs.registry.gauge(
+            "picotron_prefill_queue_depth",
+            "requests waiting for a prefill slot").set(
+                self._batcher.queue_depth)
         return self.obs.registry.prometheus() + GLOBAL_REGISTRY.prometheus()
 
     def trace_json(self) -> dict:
@@ -480,6 +652,7 @@ class FrontEnd:
             d["rejected"] = dict(self.rejections)
         d["weight_bytes"] = self.weight_bytes
         d["weight_dtype"] = self.engine.weight_dtype
+        d["role"] = self.role
         d["draining"] = self.draining
         d["dead"] = self.dead
         d["stalled"] = self.stalled
@@ -531,8 +704,11 @@ class _Handler(BaseHTTPRequestHandler):
             ok = f.ready()
             state = ("dead" if f.dead else "stalled" if f.stalled
                      else "draining" if f.draining else "ready")
+            # "role" rides the poller's contract: a router must know a
+            # prefill worker from a decode target off the same probe
             self._json(200 if ok else 503,
-                       {"ok": ok, "state": state, "draining": f.draining,
+                       {"ok": ok, "state": state, "role": f.role,
+                        "draining": f.draining,
                         "stalled": f.stalled, "dead": f.dead})
         elif self.path == "/statz":
             self._json(200, f.stats())
@@ -546,6 +722,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif self.path == "/tracez":
             self._json(200, f.trace_json())
+        elif self.path.startswith("/kv/pages"):
+            # GET /kv/pages?ids=1,2,3 — the lookup surface for short
+            # prompts and manual inspection (POST takes a JSON body for
+            # long ones)
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                ids = [int(t) for t in
+                       (q.get("ids", [""])[0]).split(",") if t]
+            except ValueError as e:
+                self._json(400, {"error": f"bad ids: {e}"})
+                return
+            try:
+                self._json(200, f.kv_pages(ids))
+            except AdmissionError as e:
+                self._json(e.status, {"error": e.reason})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -568,7 +761,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(200 if out["ok"] else 409, out)
 
     def do_POST(self) -> None:
-        if self.path not in ("/generate", "/profilez"):
+        if self.path not in ("/generate", "/profilez", "/kv/export",
+                             "/kv/import", "/kv/pages"):
             self._json(404, {"error": f"unknown path {self.path}"})
             return
         try:
@@ -597,6 +791,21 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/profilez":
             self._profilez(spec)
+            return
+        if self.path in ("/kv/export", "/kv/import", "/kv/pages"):
+            try:
+                if self.path == "/kv/export":
+                    out = self.front.kv_export(spec)
+                elif self.path == "/kv/import":
+                    out = self.front.kv_import(spec.get("kv") or spec)
+                else:
+                    out = self.front.kv_pages(spec.get("ids"))
+            except AdmissionError as e:
+                headers = ([("Retry-After", str(e.retry_after))]
+                           if e.retry_after else [])
+                self._json(e.status, {"error": e.reason}, headers)
+                return
+            self._json(200, out)
             return
         try:
             uid, waiter = self.front.submit(spec)
@@ -716,6 +925,14 @@ def _build_engine_and_params(args):
     if not (args.load_path or args.hf_path or args.random_init):
         raise SystemExit("pass one of --load-path / --hf-path / "
                          "--random-init")
+    if getattr(args, "kv_layout", None):
+        cfg.inference.kv_layout = args.kv_layout
+    if getattr(args, "role", None):
+        cfg.inference.role = args.role
+    if getattr(args, "kv_layout", None) or getattr(args, "role", None):
+        # either override can break the role/layout invariant (e.g.
+        # --kv-layout contiguous on a config whose role is prefill)
+        cfg.validate()
     _ensure_devices(cfg)
     from picotron_tpu.resilience.chaos import ServingChaos
 
@@ -901,6 +1118,15 @@ def main(argv=None) -> int:
                     help="0 = ephemeral (printed at startup)")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--role", choices=("prefill", "decode", "both"),
+                    default=None,
+                    help="disaggregated serving role (overrides "
+                         "inference.role; prefill/decode require "
+                         "inference.kv_layout: paged)")
+    ap.add_argument("--kv-layout", choices=("contiguous", "paged"),
+                    default=None,
+                    help="KV cache layout override (paged is required "
+                         "for any role but 'both')")
     ap.add_argument("--max-queue", type=int, default=64,
                     help="bounded wait queue: excess submissions get 503")
     ap.add_argument("--token-budget", type=int, default=None,
@@ -943,7 +1169,7 @@ def main(argv=None) -> int:
         "serving", port=server.port, slots=engine.slots,
         max_seq_len=engine.max_seq_len, max_queue=args.max_queue,
         token_budget=server.front.token_budget,
-        attend_impl=engine.attend_impl,
+        attend_impl=engine.attend_impl, role=server.front.role,
         kv=str(engine.cache_dtype), kv_layout=engine.kv_layout,
         tp=engine.topo.tp_size)
 

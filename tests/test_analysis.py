@@ -490,6 +490,45 @@ def test_j004_jit_built_per_iteration(tmp_path):
     assert "recompile" in found[0].message
 
 
+def test_j004_page_transport_shaped_export_loop(tmp_path):
+    """The ISSUE-15 page-transport shape: the export walks pinned pages
+    through a jitted dynamic-slice gather. Building the jit INSIDE the
+    per-page loop is the J004 hazard (a recompile per exported page);
+    the shipped form — slice/write jits built once at engine
+    construction, the loop calling the hoisted executables — must stay
+    silent. Precision both ways, so the baseline stays empty."""
+    found = _scan(tmp_path, """
+        import jax
+        from jax import lax
+
+        def slice_page(cache, pid):
+            return {n: lax.dynamic_slice_in_dim(a, pid, 1, axis=1)
+                    for n, a in cache.items()}
+
+        def export(cache, pids):
+            out = []
+            for pid in pids:
+                out.append(jax.jit(slice_page)(cache, pid))  # per page!
+            return out
+        """)
+    assert _rules(found) == ["PICO-J004"]
+
+    clean = _scan(tmp_path, """
+        import jax
+        from jax import lax
+
+        def slice_page(cache, pid):
+            return {n: lax.dynamic_slice_in_dim(a, pid, 1, axis=1)
+                    for n, a in cache.items()}
+
+        SLICE = jax.jit(slice_page)
+
+        def export(cache, pids):
+            return [SLICE(cache, pid) for pid in pids]
+        """, name="fix_clean.py")
+    assert clean == []
+
+
 def test_j004_negative_jit_in_for_iterator_expression(tmp_path):
     # regression: the iterator expression runs ONCE at loop setup —
     # `for batch in loader_of(jax.jit(step)):` must not fire; a jit in

@@ -1,0 +1,584 @@
+"""Prefill/decode disaggregation suite (ISSUE 15;
+inference/page_transport.py, serve.py roles, router orchestration).
+
+Four layers of pinning:
+
+- **transport**: export -> import roundtrips are BYTE-exact across every
+  storage variant (fp32 / bf16 / int8 / the hot_bf16 dual representation)
+  and across tp shardings (a tp=1 export lands on a tp=2 pool and vice
+  versa — payloads carry gathered global bytes); contiguous engines are
+  rejected; spec mismatches and torn payloads (CRC) fail loudly BEFORE
+  any pool page exists;
+- **refcounts**: a failed import (exhausted pool, device write fault)
+  releases every allocated page — the pool is exactly as before — and a
+  retry then succeeds; re-importing an already-cached payload allocates
+  nothing (idempotent under the dispatch-retry discipline);
+- **seating**: a request admitted with a handoff payload seats with ZERO
+  prefill dispatches and generates bit-identically to a colocated
+  (role=both) run, across decode_block / speculative verify / chunked
+  prefill x dense/flash x int8 KV/weights x tp=1/2;
+- **fabric**: the same bit-identity through the REAL router over a
+  two-role fleet (prefill worker exports, decode worker seats), plus the
+  cross-replica prefix lookup: a second replica serving a shared prefix
+  imports the affinity owner's pages and performs zero prefill
+  dispatches for the covered prefix, asserted via the registry counters.
+
+The chaos rungs (prefill-worker death mid-export, severed page stream)
+run in `make router-chaos-smoke`, whose full drill is tier-1 via
+tests/test_router.py::test_router_chaos_smoke_acceptance.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from conftest import make_config
+from picotron_tpu.config import Config, RouterConfig
+from picotron_tpu.inference import (
+    ContinuousBatcher,
+    InferenceEngine,
+    Request,
+)
+from picotron_tpu.inference import page_transport
+from picotron_tpu.inference.page_transport import TransportError
+from picotron_tpu.inference.paged_kv import PagePoolExhausted, RadixCache, \
+    PagePool
+from picotron_tpu.models import llama
+
+MAX_LEN = 64
+PAGE = 8
+
+_TINY = dict(
+    num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+    hidden_size=64, intermediate_size=128, vocab_size=256,
+    max_position_embeddings=MAX_LEN, rope_theta=10000.0, dtype="float32",
+    attention_impl="sdpa")
+
+# 18 tokens = 2 full pages + a partial tail at PAGE=8 — exercises the
+# partial-leaf adoption path in every roundtrip
+PROMPT = list(range(1, 19))
+
+
+def _build(tp=1, **kw):
+    cfg = make_config(dict(_TINY), tp=tp, seq=32)
+    kw.setdefault("kv_page_len", PAGE)
+    engine = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN,
+                             kv_layout="paged", **kw)
+    params = jax.jit(lambda k: llama.init_params(k, cfg.model))(
+        jax.random.PRNGKey(0))
+    if engine.quant_weights:
+        params = llama.quantize_params(params)
+    return engine, engine.shard_params(params)
+
+
+def _payload_for(prompt, max_new=1, **kw):
+    """Prefill ``prompt`` on a fresh engine and export its pages + first
+    token — the prefill worker's half of the handoff."""
+    engine, params = _build(**kw)
+    b = ContinuousBatcher(engine, params)
+    res = b.run([Request("pf", list(prompt), max_new_tokens=max_new)])
+    payload = b.export_prefix(list(prompt),
+                              first_token=res["pf"].tokens[0])
+    return engine, b, payload, res["pf"].tokens
+
+
+# --------------------------------------------------------------------------- #
+# transport: byte-exact roundtrips + loud rejections
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("kw_exp,kw_imp", [
+    (dict(), dict()),
+    (dict(cache_dtype="bfloat16"), dict(cache_dtype="bfloat16")),
+    (dict(cache_dtype="int8"), dict(cache_dtype="int8")),
+    (dict(kv_page_policy="hot_bf16"), dict(kv_page_policy="hot_bf16")),
+    (dict(tp=2), dict(tp=2)),
+    # tp-shard interop: payloads hold gathered global bytes, so a tp=1
+    # export lands on a tp=2 pool byte-identically (the reverse
+    # direction exercises no further code: tp=2 gathering is the "tp2"
+    # case, tp=1 import the identity placement)
+    (dict(), dict(tp=2)),
+], ids=["fp32", "bf16", "int8", "hot_bf16", "tp2", "tp1_to_tp2"])
+def test_transport_roundtrip_byte_exact(kw_exp, kw_imp):
+    eng_a, b_a, payload, _ = _payload_for(PROMPT, **kw_exp)
+    assert payload["token_ids"] == PROMPT
+    assert len(payload["pages"]) == 3 and payload["bytes_total"] > 0
+    eng_b, params_b = _build(**kw_imp)
+    b_b = ContinuousBatcher(eng_b, params_b)
+    info = b_b.import_prefix(payload)
+    assert info["tokens"] == 18 and info["pages_imported"] == 3
+    # pin both sides' pages and compare every storage leaf byte-for-byte
+    pids_a, m_a = eng_a.paged.acquire_prefix(PROMPT)
+    pids_b, m_b = eng_b.paged.acquire_prefix(PROMPT)
+    assert m_a == m_b == 18
+    try:
+        for pa, pb in zip(pids_a, pids_b):
+            page_a = eng_a._slice_page_jit(b_a._cache, pa)
+            page_b = eng_b._slice_page_jit(b_b._cache, pb)
+            assert set(page_a) == set(page_b)
+            for name in page_a:
+                assert (np.asarray(page_a[name]).tobytes()
+                        == np.asarray(page_b[name]).tobytes()), name
+    finally:
+        eng_a.paged.release_pages(pids_a)
+        eng_b.paged.release_pages(pids_b)
+
+
+def test_transport_rejects_contiguous_and_mismatch_and_crc():
+    cfg = make_config(dict(_TINY), tp=1, seq=32)
+    contiguous = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN)
+    with pytest.raises(TransportError, match="paged"):
+        page_transport.transport_spec(contiguous)
+
+    _, _, payload, _ = _payload_for(PROMPT)  # fp32 payload
+    eng_i8, params_i8 = _build(cache_dtype="int8")
+    b_i8 = ContinuousBatcher(eng_i8, params_i8)
+    with pytest.raises(TransportError, match="mismatch"):
+        b_i8.import_prefix(payload)
+
+    eng_pl, params_pl = _build(kv_page_len=16)
+    b_pl = ContinuousBatcher(eng_pl, params_pl)
+    with pytest.raises(TransportError, match="page_len"):
+        b_pl.import_prefix(payload)
+
+    # torn page stream: CRC dies before any page is allocated
+    eng, params = _build()
+    b = ContinuousBatcher(eng, params)
+    free0 = eng.paged.pool.free_count
+    bad = dict(payload, crc32=payload["crc32"] ^ 1)
+    with pytest.raises(TransportError, match="CRC"):
+        b.import_prefix(bad)
+    assert eng.paged.pool.free_count == free0
+    # truncated page list is a count mismatch, not a silent partial
+    bad = dict(payload, pages=payload["pages"][:2])
+    with pytest.raises(TransportError, match="pages"):
+        b.import_prefix(bad)
+    assert eng.paged.pool.free_count == free0
+
+
+# --------------------------------------------------------------------------- #
+# refcounts: failed imports leak nothing, retries converge
+# --------------------------------------------------------------------------- #
+
+
+def test_failed_import_releases_every_page_and_retry_succeeds():
+    _, _, payload, _ = _payload_for(PROMPT)
+    eng, params = _build()
+    b = ContinuousBatcher(eng, params)
+    free0 = eng.paged.pool.free_count
+    orig = eng._write_pages_jit
+
+    def bomb(cache, pages, pids):
+        raise RuntimeError("chaos: device write fault")
+
+    eng._write_pages_jit = bomb
+    with pytest.raises(RuntimeError, match="write fault"):
+        b.import_prefix(payload)
+    # all-or-nothing: the pool is exactly as before the import, and the
+    # radix grafted nothing (a later match must not see garbage pages)
+    assert eng.paged.pool.free_count == free0
+    assert eng.paged.radix.match(PROMPT) == ([], 0)
+    eng._write_pages_jit = orig
+    info = b.import_prefix(payload)
+    assert info["pages_imported"] == 3
+    assert eng.paged.pool.free_count == free0 - 3
+    # idempotent: a re-import (the dispatch-retry shape) allocates nothing
+    info = b.import_prefix(payload)
+    assert info["pages_imported"] == 0 and info["created"] == 0
+    assert eng.paged.pool.free_count == free0 - 3
+
+
+def test_exhausted_pool_releases_partial_alloc():
+    _, _, payload, _ = _payload_for(PROMPT)
+    # a pool with room for 2 of the 3 payload pages (num_pages counts the
+    # reserved NULL page)
+    eng, params = _build(kv_num_pages=3)
+    b = ContinuousBatcher(eng, params)
+    with pytest.raises(PagePoolExhausted):
+        b.import_prefix(payload)
+    assert eng.paged.pool.free_count == 2
+    assert np.all(eng.paged.pool.refs[1:] == 0)
+
+
+def test_radix_adopt_plan_and_duplicates():
+    pool = PagePool(16)
+    radix = RadixCache(4, pool)
+    ids = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]  # 2 full chunks + tail of 2
+    assert radix.plan_adopt(ids) == [0, 1, 2]
+    pids = [pool.alloc() for _ in range(3)]
+    created, dups = radix.adopt(ids, dict(zip([0, 1, 2], pids)))
+    assert created == 3 and dups == []
+    for pid in pids:
+        pool.unref(pid)  # drop the importer refs; the cache holds all 3
+    assert all(pool.refs[p] == 1 for p in pids)
+    # the whole prefix now matches, partial tail included
+    assert radix.match(ids)[1] == 10
+    # a second adopt of the same ids: every chunk is a duplicate
+    assert radix.plan_adopt(ids) == []
+    pids2 = [pool.alloc() for _ in range(3)]
+    created, dups = radix.adopt(ids, dict(zip([0, 1, 2], pids2)))
+    assert created == 0 and sorted(dups) == sorted(pids2)
+    for pid in pids2:
+        pool.unref(pid)
+    assert pool.refs[pids2[0]] == 0  # duplicates freed outright
+    # a longer prefix sharing chunk 0 plans only its own suffix
+    ids2 = [1, 2, 3, 4, 99, 98, 97, 96]
+    assert radix.plan_adopt(ids2) == [1]
+
+
+# --------------------------------------------------------------------------- #
+# seating: handoff == colocated, across the dispatch-family matrix
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("kw", [
+    dict(decode_block_len=4),
+    # one cell covers flash attend + int8 KV + the speculative verify
+    # dispatch family (their interactions, not just each alone)
+    dict(attend_impl="flash", cache_dtype="int8", spec_len=2),
+    # ...and one covers int8 weights + chunked prefill ON THE PREFILL
+    # SIDE (prompt wider than the chunk)
+    dict(weight_dtype="int8", prefill_chunk=8, decode_block_len=2),
+    dict(kv_page_policy="hot_bf16", tp=2, decode_block_len=2),
+], ids=["block_dense", "flash_int8kv_verify", "int8w_chunked",
+        "hot_bf16_tp2"])
+def test_handoff_seat_bit_identical_and_dispatch_free(kw):
+    kw = dict(kw)
+    max_new = 8
+    eng_p, b_p, payload, _ = _payload_for(PROMPT, **dict(kw))
+    # decode worker: seats the payload, generates with ZERO prefill work
+    eng_d, params_d = _build(**dict(kw))
+    b_d = ContinuousBatcher(eng_d, params_d)
+    res_d = b_d.run([Request("d", PROMPT, max_new_tokens=max_new,
+                             kv_import=payload)])
+    assert b_d.handoff_seated == 1
+    assert b_d.prefill_dispatches == 0
+    # colocated oracle: a plain admission of the same prompt on the
+    # EXPORTER engine (its radix hit is output-invariant — pinned in
+    # test_paged_kv — so this is the colocated generation)
+    res_c = b_p.run([Request("c", PROMPT, max_new_tokens=max_new)])
+    assert res_d["d"].tokens == res_c["c"].tokens
+    assert res_d["d"].finish_reason == res_c["c"].finish_reason
+
+
+def test_remote_prefix_hit_equals_local_prefix_hit():
+    """A second request sharing the prompt's prefix generates the same
+    tokens whether the prefix came from the LOCAL radix cache (same
+    replica) or from a REMOTE import — and the remote replica's prefill
+    work covers only the uncovered suffix."""
+    shared = PROMPT
+    extended = shared + [41, 42, 43]
+    # local: one engine serves both requests (radix hit on the second)
+    eng_l, params_l = _build()
+    b_l = ContinuousBatcher(eng_l, params_l)
+    b_l.run([Request("seed", shared, max_new_tokens=1)])
+    pf0 = b_l.prefill_dispatches
+    res_l = b_l.run([Request("ext", extended, max_new_tokens=8)])
+    local_prefills = b_l.prefill_dispatches - pf0
+    # remote: a fresh engine imports the exported prefix, then serves
+    payload = b_l.export_prefix(shared)
+    assert "first_token" not in payload  # a lookup vouches for pages only
+    eng_r, params_r = _build()
+    b_r = ContinuousBatcher(eng_r, params_r)
+    b_r.import_prefix(payload)
+    res_r = b_r.run([Request("ext", extended, max_new_tokens=8)])
+    assert res_r["ext"].tokens == res_l["ext"].tokens
+    # the import covered the shared prefix: the remote replica prefilled
+    # exactly what the local radix hit left over (the 3-token suffix +
+    # the last-token rule), never the shared pages
+    assert b_r.prefill_dispatches == local_prefills
+    assert int(b_r._remote_hits_total.value) == 1
+    stats = b_r.stats()
+    assert stats["prefix_remote_hits"] == 1
+    assert stats["prefix_cached_tokens"] >= 16  # page-aligned share
+
+
+def test_partial_payload_falls_back_to_prefix_hint():
+    """A payload that covers only part of the prompt (no first_token for
+    the full prompt) cannot seat — the admission imports it as a radix
+    hint and prefills the remainder, still bit-identical."""
+    eng_p, b_p, payload, _ = _payload_for(PROMPT)
+    extended = PROMPT + [51, 52, 53, 54]
+    eng_d, params_d = _build()
+    b_d = ContinuousBatcher(eng_d, params_d)
+    res_d = b_d.run([Request("d", extended, max_new_tokens=6,
+                             kv_import=payload)])
+    assert b_d.handoff_seated == 0  # hint, not a seat
+    assert b_d.prefill_dispatches >= 1
+    eng_c, params_c = _build()
+    b_c = ContinuousBatcher(eng_c, params_c)
+    res_c = b_c.run([Request("c", extended, max_new_tokens=6)])
+    assert res_d["d"].tokens == res_c["c"].tokens
+    # a CORRUPT payload on the seating path degrades to self-prefill —
+    # the request is servable, so it must never finish "error"
+    bad = dict(payload, crc32=payload["crc32"] ^ 1)
+    res_bad = b_d.run([Request("bad", extended, max_new_tokens=6,
+                               kv_import=bad)])
+    assert res_bad["bad"].finish_reason == "length"
+    assert res_bad["bad"].tokens == res_c["c"].tokens
+    assert b_d.handoff_seated == 0
+
+
+def test_config_role_validation():
+    raw = Config.from_dict({"dataset": {"name": "synthetic"}}).to_dict()
+    raw["inference"].update(role="prefill", kv_layout="paged")
+    Config.from_dict(raw).validate()
+    raw["inference"].update(kv_layout="contiguous")
+    with pytest.raises(ValueError, match="paged"):
+        Config.from_dict(raw).validate()
+    raw["inference"].update(role="router")
+    with pytest.raises(ValueError, match="unknown inference.role"):
+        Config.from_dict(raw).validate()
+    cfg = RouterConfig(handoff_timeout_s=0.0)
+    with pytest.raises(ValueError, match="handoff_timeout_s"):
+        cfg.validate()
+
+
+# --------------------------------------------------------------------------- #
+# fabric: the real router over a two-role fleet
+# --------------------------------------------------------------------------- #
+
+
+def _serve_fleet(roles, **inf_kw):
+    """In-process serve.py servers over identical params; paged layout,
+    per-token streaming."""
+    from picotron_tpu.tools import serve
+
+    servers = []
+    for role in roles:
+        cfg = make_config(dict(_TINY), tp=inf_kw.get("tp", 1), seq=32)
+        cfg.inference.kv_layout = "paged"
+        cfg.inference.kv_page_len = PAGE
+        cfg.inference.role = role
+        cfg.inference.decode_block_len = 1
+        for k, v in inf_kw.items():
+            if k != "tp":
+                setattr(cfg.inference, k, v)
+        engine = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN)
+        params = engine.shard_params(jax.jit(
+            lambda k, m=cfg.model: llama.init_params(k, m))(
+                jax.random.PRNGKey(0)))
+        srv = serve.Server(engine, params, port=0,
+                           log=lambda *a, **k: None)
+        srv.start()
+        servers.append(srv)
+    return servers
+
+
+def _wait(cond, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.mark.parametrize("inf_kw", [
+    # chunked prefill on the prefill worker + speculative verify on the
+    # decode worker — the plain dense/block fabric case runs in the
+    # router-chaos-smoke disagg rungs (tier-1 via test_router's
+    # acceptance test), so this parameterization covers what it doesn't
+    dict(prefill_chunk=8, spec_len=2),
+], ids=["chunked_spec"])
+def test_disagg_fleet_through_router_bit_identical(inf_kw):
+    """The acceptance fabric: prefill worker + decode worker behind the
+    REAL router. The routed generation must be bit-identical to a
+    colocated (role=both) replica's, the decode worker must seat the
+    handoff with zero prefill dispatches, and the handoff must be
+    accounted on both the router and the replicas."""
+    from picotron_tpu.tools import serve
+    from picotron_tpu.tools.router import RouterServer, _stream_post
+
+    servers = _serve_fleet(("prefill", "decode", "both"), **inf_kw)
+    pre, dec, both = servers
+    names = [f"127.0.0.1:{s.port}" for s in (pre, dec)]
+    rs = RouterServer(names, RouterConfig(probe_interval_s=0.05,
+                                          scrape_stale_s=5.0),
+                      log=lambda *a, **k: None)
+    rs.start()
+    try:
+        assert _wait(lambda: len(rs.router._candidates(
+            kind="prefill")) == 1 and len(rs.router._eligible()) == 1)
+        spec = {"prompt": PROMPT, "max_new_tokens": 10}
+        st, body = serve._post(both.port, spec)  # colocated oracle
+        assert st == 200
+        oracle = body["tokens"]
+        st, rows = _stream_post(rs.port, {**spec, "request_id": "dg"})
+        toks = [r["token"] for r in rows if r.get("event") == "token"]
+        done = [r for r in rows if r.get("event") == "done"][0]
+        assert st == 200 and done["tokens"] == toks == oracle
+        assert done["finish_reason"] == "length" and done["replays"] == 0
+        dstz = serve._get(dec.port, "/statz")[1]
+        assert dstz["handoff_seated"] == 1
+        assert dstz["prefill_dispatches"] == 0
+        assert dstz["role"] == "decode"
+        pstz = serve._get(pre.port, "/statz")[1]
+        assert pstz["admitted"] == 1 and pstz["role"] == "prefill"
+        stats = rs.router.stats()
+        assert stats["handoffs"]["served"] == 1
+        assert stats["handoff_bytes"] > 0
+        assert stats["handoff_s"] is not None
+        # replica-side byte accounting reached /metrics
+        mtext = serve._get_text(dec.port, "/metrics")[1]
+        assert 'picotron_handoff_bytes_total{dir="import"}' in mtext
+        assert "picotron_prefix_remote_hits_total" in mtext
+    finally:
+        rs.stop()
+        for s in servers:
+            try:
+                s.drain_and_join(timeout=60)
+            except OSError:
+                pass
+
+
+def test_prefill_role_sheds_generate_and_router_skips_it():
+    from picotron_tpu.tools import serve
+
+    servers = _serve_fleet(("prefill",))
+    try:
+        st, body = serve._post(servers[0].port,
+                               {"prompt": [1, 2, 3], "max_new_tokens": 4})
+        assert st == 503 and "prefill" in body["error"]
+        stz = serve._get(servers[0].port, "/statz")[1]
+        assert stz["rejected"]["role"] == 1
+        # a router over ONLY a prefill worker has no decode candidates
+        from picotron_tpu.tools.router import Router
+
+        r = Router([f"127.0.0.1:{servers[0].port}"],
+                   RouterConfig(probe_interval_s=0.05),
+                   log=lambda *a, **k: None)
+        r.start()
+        try:
+            assert _wait(lambda: len(r._candidates(kind="prefill")) == 1)
+            assert r._eligible() == []
+        finally:
+            r.stop()
+    finally:
+        for s in servers:
+            try:
+                s.drain_and_join(timeout=60)
+            except OSError:
+                pass
+
+
+def test_cross_replica_prefix_lookup_through_router():
+    """The acceptance counterpart of the ISSUE's last criterion: replica
+    B serving a prompt whose prefix the affinity owner A already holds
+    performs ZERO prefill dispatches for the shared prefix — the router
+    fetches A's pages (GET-shaped /kv/pages lookup + /kv/import) before
+    B's generate, and the registry counters prove the import."""
+    from picotron_tpu.tools import serve
+    from picotron_tpu.tools.router import RouterServer, _stream_post
+
+    servers = _serve_fleet(("both", "both"))
+    names = [f"127.0.0.1:{s.port}" for s in servers]
+    by_name = dict(zip(names, servers))
+    rs = RouterServer(names, RouterConfig(probe_interval_s=0.05,
+                                          scrape_stale_s=10.0,
+                                          affinity_load_slack=0.0),
+                      log=lambda *a, **k: None)
+    rs.start()
+    try:
+        assert rs.router.wait_eligible(2, timeout=30)
+        owner = rs.router._affinity_owner(PROMPT)
+        other = [n for n in names if n != owner.name][0]
+        spec = {"prompt": PROMPT, "max_new_tokens": 8}
+        st, rows = _stream_post(rs.port, {**spec, "request_id": "seed"})
+        toks = [r["token"] for r in rows if r.get("event") == "token"]
+        assert st == 200
+        assert serve._get(by_name[owner.name].port,
+                          "/statz")[1]["admitted"] == 1
+        # force the next placement off the affinity owner
+        rep = rs.router.replicas[owner.name]
+        with rep._mu:
+            rep.inflight += 50
+        pre = serve._get(by_name[other].port, "/statz")[1]
+        st, rows = _stream_post(rs.port, {**spec, "request_id": "esc"})
+        toks2 = [r["token"] for r in rows if r.get("event") == "token"]
+        done = [r for r in rows if r.get("event") == "done"][0]
+        assert st == 200 and done["replica"] == other and toks2 == toks
+        post = serve._get(by_name[other].port, "/statz")[1]
+        # the escape imported the owner's pages: one remote hit, the
+        # whole page-aligned shared prefix cached, and the only prefill
+        # dispatch is the capped last token — zero for the shared prefix
+        assert post["prefix_remote_hits"] - pre.get(
+            "prefix_remote_hits", 0) == 1
+        assert post["prefix_cached_tokens"] - pre.get(
+            "prefix_cached_tokens", 0) == len(PROMPT) - 1
+        assert post["prefill_dispatches"] - pre.get(
+            "prefill_dispatches", 0) == 1
+        assert rs.router.stats()["prefix_fetches"]["hit"] == 1
+    finally:
+        rs.stop()
+        for s in servers:
+            try:
+                s.drain_and_join(timeout=60)
+            except OSError:
+                pass
+
+
+def test_unusable_kv_payload_is_dropped_not_400():
+    """A mixed/mid-upgrade fleet must degrade to colocated behavior:
+    a /generate carrying a payload this replica cannot consume (here a
+    mismatched page_len) self-prefills and serves — never a client
+    400 — with the drop counted."""
+    from picotron_tpu.tools import serve
+
+    _, _, payload, _ = _payload_for(PROMPT)  # PAGE=8 payload
+    servers = _serve_fleet(("both",), kv_page_len=16)
+    try:
+        st, body = serve._post(
+            servers[0].port,
+            {"prompt": PROMPT, "max_new_tokens": 6, "kv": payload})
+        assert st == 200 and len(body["tokens"]) == 6
+        mtext = serve._get_text(servers[0].port, "/metrics")[1]
+        assert "picotron_handoff_dropped_total 1" in mtext
+        stz = serve._get(servers[0].port, "/statz")[1]
+        assert stz["handoff_seated"] == 0
+    finally:
+        for s in servers:
+            try:
+                s.drain_and_join(timeout=60)
+            except OSError:
+                pass
+
+
+def test_kv_pages_get_endpoint_and_import_endpoint():
+    """The raw lookup surface: GET /kv/pages?ids=... on the owner, POST
+    /kv/import on the peer — the manual (router-less) flavor of the
+    cross-replica transfer."""
+    import http.client
+    import json as _json
+
+    from picotron_tpu.tools import serve
+
+    a, b = _serve_fleet(("both", "both"))
+    try:
+        st, _ = serve._post(a.port, {"prompt": PROMPT,
+                                     "max_new_tokens": 1})
+        assert st == 200
+        ids = ",".join(str(t) for t in PROMPT)
+        st, out = serve._get(a.port, f"/kv/pages?ids={ids}")
+        assert st == 200 and out["matched"] == len(PROMPT)
+        conn = http.client.HTTPConnection("127.0.0.1", b.port, timeout=60)
+        conn.request("POST", "/kv/import", _json.dumps({"kv": out["kv"]}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        info = _json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200 and info["tokens"] == len(PROMPT)
+        assert info["pages_imported"] == 3
+        # miss: unknown ids match nothing
+        st, out = serve._get(a.port, "/kv/pages?ids=250,251,252")
+        assert st == 200 and out["matched"] == 0
+    finally:
+        for s in (a, b):
+            try:
+                s.drain_and_join(timeout=60)
+            except OSError:
+                pass

@@ -270,7 +270,7 @@ def _scripted(r: Router, script):
     it = iter(script)
 
     def fake(rep, spec, rid, n, prompt, delivered, max_new, on_token,
-             root, tracer):
+             root, tracer, kv_payload=None):
         outcome, detail, toks = next(it)
         calls.append((rep.name, prompt + delivered,
                       max_new - len(delivered)))
