@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke obs-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke tenant-smoke obs-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -23,6 +23,7 @@ test-all: native lint
 	$(MAKE) quant-smoke
 	$(MAKE) router-chaos-smoke
 	$(MAKE) disagg-smoke
+	$(MAKE) tenant-smoke
 
 # picolint static analysis (picotron_tpu/analysis/, docs/ANALYSIS.md):
 # JAX hot-path rules (host syncs on traced values, trace-time
@@ -198,6 +199,25 @@ router-chaos-smoke:
 # one. CPU proxy (subprocess replicas = one interpreter per role).
 disagg-smoke:
 	JAX_PLATFORMS=cpu python bench_decode.py --disagg
+
+# Multi-tenant serving smoke (ISSUE 16, inference/tenancy.py,
+# docs/SERVING.md "Multi-tenant serving"): the adapter-parity gate —
+# greedy generations through the segmented multi-LoRA matmul must be
+# IDENTICAL to an adapter-less engine fed the merged-weight (W + BA)
+# reference — on the int8 base (the fake-quant error is in both; any
+# difference is the segmented adapter path itself), then the
+# mixed-tenant bench: 3 adapters + base-only rows in ONE continuous
+# batch, per-tenant tokens/dpt/TTFT and adapter_bytes_per_token in the
+# JSON trajectory. The serving default stays adapter-less, so every
+# other smoke's output is unchanged.
+tenant-smoke:
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
+	  --weight-dtype int8 --adapter 4 --check-adapter-parity
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.generate --smoke \
+	  --adapter 4:7:0.5 --check-adapter-parity --kv-layout paged \
+	  --spec-len 3
+	JAX_PLATFORMS=cpu python bench_decode.py --tenants 3 --adapter-rank 4 \
+	  --weight-dtype int8
 
 # Serving chaos suite (tests/test_serving.py): dispatch-exception,
 # latency-spike, and poisoned-logits faults through the engine hooks —

@@ -556,6 +556,113 @@ def run_spec_auto(cfg, *, slots: int, max_seq_len: int, prompt_len: int,
             weight_bytes, engine, auto)
 
 
+def run_tenants(cfg, *, tenants: int, adapter_rank: int, slots: int,
+                max_seq_len: int, prompt_len: int, steps: int,
+                spec_len: int = 0, drafter: str = "ngram",
+                attend_impl: str = "dense", kv_layout: str = "contiguous",
+                kv_page_policy: str = "uniform",
+                sample_on_device: bool = False,
+                weight_dtype: str = "bf16"):
+    """The MULTI-TENANT run (ISSUE 16): ``tenants`` rank-``adapter_rank``
+    adapters over one shared base, plus base-only (null-adapter) rows, all
+    mixed in the SAME continuous batch — every decode/verify dispatch
+    serves several tenants at once through the segmented adapter matmul.
+    Requests round-robin across tenants (repetitive prompts, so the
+    speculative variant has an attractor to ride) with one anonymous
+    base request per batch wave riding along as the isolation control.
+
+    Returns (tokens/s, dispatches_per_token, accept_rate_or_None,
+    kv_bytes/token, weight_bytes_total, engine, tenancy) where
+    ``tenancy`` carries the per-tenant story: tokens, dispatches/token,
+    TTFT, accept (spec runs), and the pack's adapter_bytes_per_token —
+    the HBM cost every decode step pays to stream all live adapters."""
+    import numpy as np
+
+    from picotron_tpu.inference import ContinuousBatcher, InferenceEngine, \
+        Request
+    from picotron_tpu.inference import tenancy as _tenancy
+
+    pack = _tenancy.AdapterPack(cfg.model, slots=tenants + 1,
+                                rank=adapter_rank)
+    for i in range(1, tenants + 1):
+        # a visible per-tenant voice: large enough to steer greedy argmax
+        # on the tiny smoke model, distinct seed per tenant
+        pack.set_slot(i, pack.random_leaves(adapter_rank, seed=i,
+                                            scale=0.5))
+    engine = InferenceEngine(cfg, slots=slots, max_seq_len=max_seq_len,
+                             spec_len=spec_len, attend_impl=attend_impl,
+                             kv_layout=kv_layout,
+                             kv_page_policy=kv_page_policy,
+                             sample_on_device=sample_on_device,
+                             weight_dtype=weight_dtype, drafter=drafter,
+                             adapters=pack)
+    params, weight_bytes = bench_params(engine, cfg)
+    rng = np.random.default_rng(0)
+    rep_prompt = [int(t) for t in np.resize(
+        rng.integers(1, cfg.model.vocab_size, 4), prompt_len)]
+
+    def reqs_for(tag):
+        out = []
+        for s in range(slots):
+            tid = s % (tenants + 1)  # slot 0 of each wave = base-only
+            out.append(Request(
+                f"{tag}t{tid}_{s}", list(rep_prompt),
+                max_new_tokens=steps,
+                tenant=f"tenant{tid}" if tid else "",
+                adapter_slot=tid,
+                priority=2 if tid == 1 else 1,  # one premium class
+                ttft_slo_ms=500.0 if tid == 1 else None))
+        return out
+
+    # warmup wave absorbs compilation (prefill bucket + decode/verify
+    # programs) outside the timed window, run/run_spec's protocol
+    warm = ContinuousBatcher(engine, params)
+    warm.run([Request(f"w{i}", list(rep_prompt), max_new_tokens=2,
+                      adapter_slot=i % (tenants + 1))
+              for i in range(min(slots, tenants + 1))])
+    batcher = ContinuousBatcher(engine, params)
+    t0 = time.perf_counter()
+    results = batcher.run(reqs_for("m_"))
+    dt = time.perf_counter() - t0
+    total_toks = sum(len(r.tokens) for r in results.values())
+    dpt = batcher.decode_dispatches / max(total_toks, 1)
+
+    per_tenant = {}
+    for tid in range(tenants + 1):
+        rs = [r for u, r in results.items()
+              if u.startswith(f"m_t{tid}_")]
+        toks = sum(len(r.tokens) for r in rs)
+        disp = sum(r.dispatches for r in rs)
+        ttfts = [r.ttft_s for r in rs if r.ttft_s is not None]
+        row = {
+            "tokens": toks,
+            "dispatches_per_token": round(disp / max(toks, 1), 4),
+            "ttft_s": round(float(np.mean(ttfts)), 5) if ttfts else None,
+        }
+        if spec_len > 0:
+            # each verify dispatch emits 1 + accepted and proposes
+            # spec_len, so the per-tenant accept rate falls out of the
+            # per-request (dispatches, tokens) pair
+            row["accept_rate"] = round(
+                max(0, toks - disp) / max(disp * spec_len, 1), 4)
+        per_tenant["base" if tid == 0 else f"tenant{tid}"] = row
+    tenancy = {
+        "tenants": tenants,
+        "adapter_rank": adapter_rank,
+        "adapter_bytes_per_token": pack.bytes_per_token(),
+        "per_tenant": per_tenant,
+    }
+    final_lengths = np.asarray(
+        [len(r.prompt) + len(r.tokens) for r in results.values()],
+        np.int64)
+    kv_bytes = kv_bytes_per_token(engine, final_lengths)
+    if spec_len > 0:  # run_spec's per-token walk normalization
+        kv_bytes = int(round(kv_bytes * dpt))
+    accept = (batcher.accept_rate or 0.0) if spec_len > 0 else None
+    return (total_toks / dt, dpt, accept, kv_bytes, weight_bytes, engine,
+            tenancy)
+
+
 # --------------------------------------------------------------------------- #
 # --disagg: prefill/decode interference bench (ISSUE 15)
 # --------------------------------------------------------------------------- #
@@ -931,6 +1038,17 @@ def main(argv=None) -> None:
                          "default) or per-channel int8 served through "
                          "the fused dequant matmul — weight_bytes_total "
                          "in the JSON drops to ~half the bf16 bytes")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant run: N rank-R LoRA adapters over "
+                         "one shared base, mixed with base-only rows in "
+                         "the SAME continuous batch (every dispatch "
+                         "serves several tenants through the segmented "
+                         "adapter matmul) — the JSON gains per-tenant "
+                         "tokens/dpt/TTFT (+ accept with --spec-len) and "
+                         "adapter_bytes_per_token (composes with "
+                         "--weight-dtype int8 and --spec-len)")
+    ap.add_argument("--adapter-rank", type=int, default=8,
+                    help="LoRA rank for --tenants adapters (default 8)")
     args = ap.parse_args(argv)
     if args.disagg:
         # the disagg bench is its own protocol (subprocess fleet + the
@@ -989,6 +1107,14 @@ def main(argv=None) -> None:
     if args.kv_page_policy != "uniform" and args.kv_layout != "paged":
         ap.error("--kv-page-policy hot_bf16 requires --kv-layout paged "
                  "(per-page refcounts decide which pages read as int8)")
+    if args.tenants:
+        if args.tenants < 1 or args.adapter_rank < 1:
+            ap.error("--tenants and --adapter-rank must be >= 1")
+        if args.block_len != 1:
+            ap.error("--tenants drives the continuous batcher; drop "
+                     "--block-len")
+        if args.spec_auto:
+            ap.error("--tenants and --spec-auto are separate protocols")
 
     # Preflight BEFORE any backend touch: a dead TPU tunnel hangs backend
     # init forever, and the probe child is the only safe way to find out.
@@ -1039,8 +1165,20 @@ def main(argv=None) -> None:
     })
     accept = None
     auto = None
+    tenancy = None
     try:
-        if args.spec_auto:
+        if args.tenants:
+            (tok_s, dpt, accept, kv_bytes, weight_bytes, engine,
+             tenancy) = run_tenants(
+                cfg, tenants=args.tenants,
+                adapter_rank=args.adapter_rank,
+                spec_len=args.spec_len, drafter=args.drafter,
+                attend_impl=args.attend_impl,
+                kv_layout=args.kv_layout,
+                kv_page_policy=args.kv_page_policy,
+                sample_on_device=args.sample_on_device,
+                weight_dtype=args.weight_dtype, **sizes)
+        elif args.spec_auto:
             (tok_s, dpt, accept, kv_bytes, weight_bytes, engine,
              auto) = run_spec_auto(
                 cfg, spec_len=args.spec_len, drafter=args.drafter,
@@ -1152,6 +1290,11 @@ def main(argv=None) -> None:
         # what the policy loop actually decided
         record["spec_auto"] = True
         record.update(auto)
+    if tenancy is not None:
+        # the multi-tenant story: per-tenant tokens/dpt/TTFT (+ accept
+        # when speculating) and what streaming all live adapters costs
+        # per decoded token next to the base weight bytes
+        record.update(tenancy)
     # the engine registry's compact snapshot (dispatch count/latency
     # histograms, pool/accept gauges) rides along — one structured blob
     # instead of growing the hand-picked field list forever

@@ -377,6 +377,31 @@ class SpecControllerConfig:
 
 
 @dataclass
+class TenancyConfig:
+    """Multi-tenant serving (inference/tenancy.py, docs/SERVING.md
+    "Multi-tenant serving"): one replica serves many named tenants —
+    each an optional LoRA adapter over the shared (possibly int8) base,
+    a priority class, in-flight quotas, and TTFT/TPOT SLO targets. The
+    default (no tenants, no manifest) builds no adapter pack and leaves
+    every compiled program and every smoke byte-identical to the
+    single-tenant engine."""
+
+    # Inline tenant definitions (list of tenancy.Tenant dicts — see the
+    # manifest schema in inference/tenancy.py). Applied after the
+    # manifest, so a config can extend a shared fleet manifest.
+    tenants: list = field(default_factory=list)
+    # Path to a JSON tenant manifest: {"tenants": [{...}, ...]}. The
+    # serve CLI's --tenant-manifest flag overrides this.
+    manifest: str = ""
+    # Adapter pack capacity: total adapter slots (slot 0 is the reserved
+    # null adapter — base-only rows point at it and bypass exactly) and
+    # the maximum adapter rank. Capacity-static: hot tenant add/remove
+    # via POST /tenants writes pack slots, never recompiles a program.
+    adapter_slots: int = 8
+    adapter_rank: int = 16
+
+
+@dataclass
 class InferenceConfig:
     """Serving knobs (picotron_tpu/inference/, docs/INFERENCE.md). These
     only affect the InferenceEngine / ContinuousBatcher path; training
@@ -513,6 +538,8 @@ class InferenceConfig:
     # Closed-loop per-slot spec_len tuning — see SpecControllerConfig.
     spec_controller: SpecControllerConfig = field(
         default_factory=SpecControllerConfig)
+    # Multi-tenant serving — see TenancyConfig.
+    tenancy: TenancyConfig = field(default_factory=TenancyConfig)
 
     def __post_init__(self):
         # from_dict hands nested blocks through as plain dicts; coerce so
@@ -524,6 +551,10 @@ class InferenceConfig:
             self.spec_controller = SpecControllerConfig(
                 **{k: v for k, v in self.spec_controller.items()
                    if k in known})
+        if isinstance(self.tenancy, dict):
+            known = {f.name for f in dataclasses.fields(TenancyConfig)}
+            self.tenancy = TenancyConfig(
+                **{k: v for k, v in self.tenancy.items() if k in known})
     # Graceful degradation for the flash attend path: when a
     # attend_impl="flash" dispatch fails, log once, rebuild the engine's
     # compiled programs on "dense", and keep serving — for the REST OF THE

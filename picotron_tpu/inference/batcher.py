@@ -128,6 +128,24 @@ class Request:
     # a prefix HINT (imported into the radix cache, the normal admission
     # radix-hits it and prefills only the uncovered suffix).
     kv_import: Optional[dict] = None
+    # ---- multi-tenant serving (inference/tenancy.py) ----------------------
+    # tenant name ("" = anonymous base traffic: the null adapter, the
+    # default radix domain, class-1 priority, no SLOs). The serve front
+    # end resolves names against its TenantRegistry and fills the fields
+    # below; direct batcher users (bench, tests) set them explicitly.
+    tenant: str = ""
+    # admission class: higher classes admit first out of the queue; the
+    # LOWEST queued class sheds first when the front end's budget gate
+    # needs room for a higher-class arrival (shed_lower_priority)
+    priority: int = 1
+    # resolved adapter pack slot (0 = the reserved null adapter)
+    adapter_slot: int = 0
+    # SLO targets in milliseconds (None = best-effort): ttft steers
+    # admission order and chunked-prefill interleaving; tpot feeds the
+    # spec controller's dispatch-width cap and, with ttft, the
+    # per-tenant attainment metrics
+    ttft_slo_ms: Optional[float] = None
+    tpot_slo_ms: Optional[float] = None
 
 
 @dataclass
@@ -252,6 +270,10 @@ class ContinuousBatcher:
         self._top_p = np.ones(n, np.float32)
         self._eos = np.full(n, -1, np.int32)
         self._budget = np.zeros(n, np.int32)
+        # per-slot adapter pack slots (multi-tenant engines): every
+        # decode/verify dispatch ships this [slots] row so one dispatch
+        # mixes tenants; 0 (the null adapter) for free/base slots
+        self._adapter = np.zeros(n, np.int32)
         # lifetime dispatch/throughput counters (bench + tests)
         self.decode_dispatches = 0
         self.prefill_dispatches = 0
@@ -291,6 +313,14 @@ class ContinuousBatcher:
             "picotron_prefix_remote_hits_total",
             "transport imports that landed a remote-prefilled prefix")
         self.handoff_seated = 0
+        # per-tenant accounting (multi-tenant serving): a host-side tally
+        # for /statz next to the labeled picotron_tenant_* registry
+        # families — one instrument set, two renderings, like the global
+        # counters above
+        self._tenant_stats: dict = {}
+        # prefill tokens admitted THIS scheduler round (the SLO-aware
+        # chunked-prefill interleaving budget — see _prefill_gate)
+        self._round_prefill_tokens = 0
         self._req_spans: dict = {}  # uid -> live request root span
         self._last_prefill: dict = {}  # scratch: dispatch/radix-hit counts
         self._host_sync_s = 0.0  # scratch: last dispatch's host-sync time
@@ -403,6 +433,97 @@ class ContinuousBatcher:
                 load += self.page_commitment(s.req)
         return load
 
+    # ---- multi-tenant accounting ------------------------------------------
+
+    @staticmethod
+    def _tname(req: Request) -> str:
+        """The request's tenant label ("" renders as "base" — anonymous
+        traffic is itself a tenant in the metric families)."""
+        return req.tenant or "base"
+
+    def _tstat(self, req: Request) -> dict:
+        name = self._tname(req)
+        st = self._tenant_stats.get(name)
+        if st is None:
+            st = {"admitted": 0, "completed": 0, "expired": 0,
+                  "errored": 0, "shed": 0, "tokens": 0,
+                  "slo_ttft_met": 0, "slo_ttft_missed": 0,
+                  "slo_tpot_met": 0, "slo_tpot_missed": 0,
+                  "prefill_deferred": 0, "prefill_preempts": 0}
+            self._tenant_stats[name] = st
+        return st
+
+    def _tenant_count(self, req: Request, state: str) -> None:
+        self._tstat(req)[state] += 1
+        self.obs.registry.counter(
+            "picotron_tenant_requests_total",
+            "request accounting by tenant and terminal state (+ admitted)",
+            tenant=self._tname(req), state=state).inc()
+
+    def _tenant_slo(self, req: Request, slo: str, met: bool) -> None:
+        outcome = "met" if met else "missed"
+        self._tstat(req)[f"slo_{slo}_{outcome}"] += 1
+        self.obs.registry.counter(
+            "picotron_tenant_slo_total",
+            "per-tenant SLO attainment by target and outcome",
+            tenant=self._tname(req), slo=slo, outcome=outcome).inc()
+
+    def tenant_token_load(self, tenant: str) -> int:
+        """Worst-case token commitment of ONE tenant's queued and
+        in-flight requests — the per-tenant quota gate's price (the same
+        ladder ``token_load`` prices globally)."""
+        load = sum(self.commitment(r) for r in self._pending
+                   if (r.tenant or "") == tenant)
+        for s in self._slots:
+            if s is not None and (s.req.tenant or "") == tenant:
+                load += self.commitment(s.req)
+        return load
+
+    def tenant_page_load(self, tenant: str) -> int:
+        """Worst-case page commitment of one tenant's queued and
+        in-flight requests (paged layout; 0 on contiguous)."""
+        if self.paged is None:
+            return 0
+        load = sum(self.page_commitment(r) for r in self._pending
+                   if (r.tenant or "") == tenant)
+        for s in self._slots:
+            if s is not None and (s.req.tenant or "") == tenant:
+                load += self.page_commitment(s.req)
+        return load
+
+    def shed_lower_priority(self, priority: int, tokens: int = 0,
+                            pages: int = 0) -> tuple:
+        """Shed QUEUED requests of a class strictly below ``priority`` —
+        lowest class first, newest first within a class — until the freed
+        worst-case commitment covers ``tokens`` AND ``pages`` (0 = no
+        demand on that budget) or no lower-class request remains. The
+        serve front end's admission gate calls this before 429ing a
+        higher-class arrival: the lowest class sheds first while higher
+        classes hold their admission. Returns (tokens_freed,
+        pages_freed)."""
+        freed_t = freed_p = 0
+        while freed_t < tokens or freed_p < pages:
+            best = None
+            for j, r in enumerate(self._pending):
+                if r.priority >= priority:
+                    continue
+                # <= keeps the LATEST of the lowest class: the request
+                # that waited least loses first
+                if (best is None
+                        or r.priority <= self._pending[best].priority):
+                    best = j
+            if best is None:
+                break
+            req = self._pending[best]
+            freed_t += self.commitment(req)
+            if self.paged is not None:
+                freed_p += self.page_commitment(req)
+            del self._pending[best]
+            self._submit_t.pop(req.uid, None)
+            self.counters["shed"] += 1
+            self._results[req.uid] = self._shed_result(req)
+        return freed_t, freed_p
+
     def take_results(self) -> dict:
         """Drain finished results accumulated since the last call:
         {uid: GenerationResult}. The serve loop calls this after each
@@ -424,6 +545,7 @@ class ContinuousBatcher:
 
     def _shed_result(self, req: Request) -> GenerationResult:
         """Terminal "shed" result + its ended root span."""
+        self._tenant_count(req, "shed")
         span = self._req_spans.pop(req.uid, None)
         if span is not None:
             self.obs.tracer.end(span, finish_reason="shed")
@@ -474,6 +596,35 @@ class ContinuousBatcher:
             reg.gauge("picotron_spec_len",
                       "mean effective draft length over occupied slots"
                       ).set(self.spec_len_effective())
+        # per-tenant occupancy + page commitment on the scrape — the
+        # router's tenant-aware placement reads these off /metrics
+        queued_by: dict = {}
+        for r in self._pending:
+            name = self._tname(r)
+            queued_by[name] = queued_by.get(name, 0) + 1
+        active_by: dict = {}
+        pages_by: dict = {}
+        for s in self._slots:
+            if s is None:
+                continue
+            name = self._tname(s.req)
+            active_by[name] = active_by.get(name, 0) + 1
+            if self.paged is not None:
+                pages_by[name] = (pages_by.get(name, 0)
+                                  + self.page_commitment(s.req))
+        for name in (set(self._tenant_stats) | set(queued_by)
+                     | set(active_by)):
+            reg.gauge("picotron_tenant_queue_depth",
+                      "queued requests by tenant",
+                      tenant=name).set(queued_by.get(name, 0))
+            reg.gauge("picotron_tenant_active_slots",
+                      "occupied slots by tenant",
+                      tenant=name).set(active_by.get(name, 0))
+            if self.paged is not None:
+                reg.gauge("picotron_tenant_pages_committed",
+                          "worst-case page commitment of live slots, "
+                          "by tenant",
+                          tenant=name).set(pages_by.get(name, 0))
         return queued, active
 
     def spec_len_effective(self) -> float:
@@ -518,6 +669,10 @@ class ContinuousBatcher:
             # handoff (zero prefill dispatches) + remote prefix imports
             d["handoff_seated"] = self.handoff_seated
             d["prefix_remote_hits"] = int(self._remote_hits_total.value)
+        if self._tenant_stats:
+            # the /statz rendering of the picotron_tenant_* families
+            d["tenants"] = {name: dict(st)
+                            for name, st in self._tenant_stats.items()}
         return d
 
     # ---- one scheduler round ----------------------------------------------
@@ -533,6 +688,21 @@ class ContinuousBatcher:
     def _finish(self, i: int, reason: str) -> None:
         s = self._slots[i]
         self.counters[self._REASON_COUNTER[reason]] += 1
+        if reason != "shed":  # shed requests count via _shed_result
+            self._tenant_count(s.req, self._REASON_COUNTER[reason])
+        if (len(s.generated) > 1 and s.ttft_s is not None
+                and s.submit_t is not None):
+            # finish-time mean time-per-output-token: the decode half of
+            # the request's latency, the per-tenant TPOT instrument
+            tpot = ((self._clock() - s.submit_t - s.ttft_s)
+                    / (len(s.generated) - 1))
+            self.obs.registry.histogram(
+                "picotron_tenant_tpot_seconds",
+                "mean per-token decode latency by tenant",
+                tenant=self._tname(s.req)).observe(tpot)
+            if s.req.tpot_slo_ms is not None:
+                self._tenant_slo(s.req, "tpot",
+                                 tpot * 1000.0 <= s.req.tpot_slo_ms)
         span = self._req_spans.pop(s.req.uid, None)
         if span is not None:
             self.obs.tracer.end(span, finish_reason=reason,
@@ -561,6 +731,7 @@ class ContinuousBatcher:
         self._top_p[i] = 1.0
         self._eos[i] = -1
         self._budget[i] = 0
+        self._adapter[i] = 0
 
     def _remaining(self, i: int) -> int:
         """Tokens slot i may still produce: its max_new_tokens budget capped
@@ -578,9 +749,21 @@ class ContinuousBatcher:
         s.generated.append(tok)
         self.generated_tokens += 1
         self._tokens_total.inc()
+        self._tstat(s.req)["tokens"] += 1
+        self.obs.registry.counter(
+            "picotron_tenant_tokens_total",
+            "tokens emitted to streams, by tenant",
+            tenant=self._tname(s.req)).inc()
         if s.ttft_s is None and s.submit_t is not None:
             s.ttft_s = self._clock() - s.submit_t
             self._ttft_hist.observe(s.ttft_s)
+            self.obs.registry.histogram(
+                "picotron_tenant_ttft_seconds",
+                "submit -> first token, by tenant",
+                tenant=self._tname(s.req)).observe(s.ttft_s)
+            if s.req.ttft_slo_ms is not None:
+                self._tenant_slo(s.req, "ttft",
+                                 s.ttft_s * 1000.0 <= s.req.ttft_slo_ms)
         if self.on_token is not None:
             self.on_token(s.req.uid, tok)
         r = s.req
@@ -607,6 +790,10 @@ class ContinuousBatcher:
         hidden = None
         if self.engine.sample_on_device:
             sample = (key, req.temperature, req.top_k, req.top_p)
+        # the tenant's adapter rides the prefill dispatch as a single-row
+        # id; adapter-less engines pass nothing and trace the base program
+        adapter = (int(req.adapter_slot)
+                   if self.engine.adapters is not None else None)
         if self.paged is not None and req.kv_import is not None:
             seated = self._try_import(req, i)
             if seated is not None:
@@ -616,7 +803,8 @@ class ContinuousBatcher:
         if self.paged is not None:
             self.paged.priced[i] = self.page_commitment(req)
             out = self.engine.prefill_paged(
-                self.params, self._cache, req.prompt, i, sample=sample)
+                self.params, self._cache, req.prompt, i, sample=sample,
+                adapter_id=adapter, cache_salt=req.tenant)
             self._cache, logits, n, cached = out[:4]
             hidden = out[4] if rh else None
             self.prefill_dispatches += n
@@ -626,14 +814,15 @@ class ContinuousBatcher:
             # O(1) compiled shapes in prompt length
             n_chunks = -(-len(req.prompt) // self.engine.prefill_chunk)
             out = self.engine.prefill_chunked(
-                self.params, self._cache, req.prompt, i, sample=sample)
+                self.params, self._cache, req.prompt, i, sample=sample,
+                adapter_id=adapter)
             self._cache, logits = out[:2]
             hidden = out[2] if rh else None
             self.prefill_dispatches += n_chunks
             self._last_prefill = {"dispatches": n_chunks}
         else:
             out = self.engine.prefill(self.params, req.prompt,
-                                      sample=sample)
+                                      sample=sample, adapter_id=adapter)
             kv, logits = out[:2]
             hidden = out[2] if rh else None
             self._cache = self.engine.insert(
@@ -684,7 +873,8 @@ class ContinuousBatcher:
         first = payload.get("first_token")
         if first is None or ids != [int(t) for t in req.prompt]:
             return None
-        cached = self.paged.match_prefix(i, ids, cap_last=False)
+        cached = self.paged.match_prefix(i, ids, cap_last=False,
+                                         salt=req.tenant)
         if cached != len(ids):
             return None
         self._cache = self.engine.seat_slot(self._cache, i, cached)
@@ -700,12 +890,16 @@ class ContinuousBatcher:
         self.handoff_seated += 1
         return ("handoff", int(first))
 
-    def export_prefix(self, ids, first_token=None) -> dict:
+    def export_prefix(self, ids, first_token=None,
+                      tenant: str = "") -> dict:
         """Serialize the longest radix-cached prefix of ``ids`` from this
         batcher's cache (the serve front end's /kv/export + /kv/pages
-        surface — the caller serializes batcher access)."""
+        surface — the caller serializes batcher access). ``tenant``
+        scopes the lookup to that tenant's radix domain and rides in the
+        payload."""
         return self.engine.export_prefix(self._cache, ids,
-                                         first_token=first_token)
+                                         first_token=first_token,
+                                         cache_salt=tenant)
 
     def import_prefix(self, payload) -> dict:
         """Land a transport payload in this batcher's cache/radix (the
@@ -718,36 +912,87 @@ class ContinuousBatcher:
             self._remote_hits_total.inc()
         return info
 
-    def _pages_admit(self) -> bool:
-        """Page-priced admission gate (paged layout): shed head requests
-        whose worst-case page commitment can NEVER fit the pool, then
-        report whether the head request fits RIGHT NOW (free + evictable
-        pages minus what live slots are still owed). Admission waits
-        (returns False) under transient pressure — slots finishing return
-        pages — instead of admitting a request the pool could strand
-        mid-decode. Out-of-pages sheds at the door; it never corrupts a
-        live slot."""
-        while self._pending:
-            req = self._pending[0]
-            need = self.page_commitment(req)
-            if need > self.paged.usable_pages:
-                self._pending.popleft()
-                self._submit_t.pop(req.uid, None)
-                self.counters["shed"] += 1
-                self._results[req.uid] = self._shed_result(req)
-                continue
-            return self.paged.can_admit(need)
+    def _pick(self) -> int:
+        """Index of the next admission candidate in the queue: the
+        highest priority class first, FIFO within a class — except that
+        a TTFT-SLO request jumps ahead of best-effort peers of its OWN
+        class (its clock is already running; theirs is not)."""
+        best = 0
+        for j in range(1, len(self._pending)):
+            r, b = self._pending[j], self._pending[best]
+            if r.priority > b.priority:
+                best = j
+            elif (r.priority == b.priority and b.ttft_slo_ms is None
+                  and r.ttft_slo_ms is not None):
+                best = j
+        return best
+
+    def _prefill_gate(self, req: Request) -> bool:
+        """SLO-aware chunked-prefill interleaving: when an ACTIVE slot
+        carries a TPOT SLO, admission stops after one ``prefill_chunk``'s
+        worth of prompt tokens per scheduler round — prefill work
+        head-of-line blocks the decode dispatch behind it, and the round
+        cap spreads that stall out so the decoders' token gaps stay near
+        their target. The first admission of a round always passes
+        (progress guarantee). A waiting request whose TTFT budget is
+        half spent PREEMPTS the cap — its own SLO outranks the decoders'
+        smoothness — with both decisions visible in the
+        ``picotron_tenant_prefill_*`` counters."""
+        if self._round_prefill_tokens == 0:
+            return True
+        if not any(s is not None and s.req.tpot_slo_ms is not None
+                   for s in self._slots):
+            return True
+        if req.ttft_slo_ms is not None:
+            t0 = self._submit_t.get(req.uid)
+            if (t0 is not None and (self._clock() - t0) * 1000.0
+                    >= req.ttft_slo_ms / 2.0):
+                self._tstat(req)["prefill_preempts"] += 1
+                self.obs.registry.counter(
+                    "picotron_tenant_prefill_preempts_total",
+                    "TTFT-pressed admissions that preempted the "
+                    "interleave cap, by tenant",
+                    tenant=self._tname(req)).inc()
+                return True
+        if (self._round_prefill_tokens + len(req.prompt)
+                <= self.engine.prefill_chunk):
+            return True
+        self._tstat(req)["prefill_deferred"] += 1
+        self.obs.registry.counter(
+            "picotron_tenant_prefill_deferred_total",
+            "admissions deferred a round by the TPOT interleave cap, "
+            "by tenant",
+            tenant=self._tname(req)).inc()
         return False
 
     def _admit(self) -> None:
+        self._round_prefill_tokens = 0
         for i in range(len(self._slots)):
-            if not self._pending:
-                return
             if self._slots[i] is not None:
                 continue
-            if self.paged is not None and not self._pages_admit():
-                return
-            req = self._pending.popleft()
+            while True:
+                if not self._pending:
+                    return
+                j = self._pick()
+                req = self._pending[j]
+                if self.paged is not None:
+                    need = self.page_commitment(req)
+                    if need > self.paged.usable_pages:
+                        # can NEVER fit the pool: shed at the door
+                        del self._pending[j]
+                        self._submit_t.pop(req.uid, None)
+                        self.counters["shed"] += 1
+                        self._results[req.uid] = self._shed_result(req)
+                        continue
+                    if not self.paged.can_admit(need):
+                        # transient pressure: wait — slots finishing
+                        # return pages; admitting now could strand a
+                        # live slot mid-decode
+                        return
+                if not self._prefill_gate(req):
+                    return  # deferred to the next round's admission
+                del self._pending[j]
+                break
             submit_t = self._submit_t.pop(req.uid, None)
             root = self._req_spans.get(req.uid)
             t_admit = self._clock()
@@ -778,6 +1023,8 @@ class ContinuousBatcher:
                 self.obs.tracer.end(pf_span, error=type(e).__name__)
                 self.counters["admitted"] += 1
                 self.counters["errored"] += 1
+                self._tenant_count(req, "admitted")
+                self._tenant_count(req, "errored")
                 span = self._req_spans.pop(req.uid, None)
                 if span is not None:
                     self.obs.tracer.end(span, finish_reason="error")
@@ -792,6 +1039,11 @@ class ContinuousBatcher:
                     self._cache_lost()
                 continue
             self.counters["admitted"] += 1
+            self._tenant_count(req, "admitted")
+            if self._last_prefill.get("dispatches", 1) > 0:
+                # prompt tokens that actually prefilled this round (a
+                # handoff seat or full radix hit costs the gate nothing)
+                self._round_prefill_tokens += len(req.prompt)
             now = self._clock()
             deadline = (now + req.timeout_s
                         if req.timeout_s is not None else None)
@@ -803,10 +1055,14 @@ class ContinuousBatcher:
                 slot.queue_wait_s = now - submit_t
                 self._queue_wait_hist.observe(slot.queue_wait_s)
             self._slots[i] = slot
+            self._adapter[i] = (req.adapter_slot
+                                if self.engine.adapters is not None else 0)
             # fresh request: the controller restarts the slot's policy
             # and stateful drafters drop any previous occupant's index
             if self.controller is not None:
-                self.controller.reset(i)
+                self.controller.reset(i, tpot_slo_s=(
+                    req.tpot_slo_ms / 1000.0
+                    if req.tpot_slo_ms is not None else None))
             for d in self._drafters.values():
                 d.begin(req.uid)
             self._temp[i] = req.temperature
@@ -906,7 +1162,9 @@ class ContinuousBatcher:
                 t0 = self._clock()
                 out = self.engine.decode_block(
                     self.params, self._cache, self._last_tok, keys,
-                    self._eos, b, self._temp, self._top_k, self._top_p)
+                    self._eos, b, self._temp, self._top_k, self._top_p,
+                    adapter_ids=(self._adapter if self.engine.adapters
+                                 is not None else None))
                 if self.engine.return_hidden:
                     self._cache, toks, counts, hid = out
                 else:
@@ -1106,7 +1364,9 @@ class ContinuousBatcher:
             t0 = self._clock()
             out = self.engine.verify(
                 self.params, self._cache, tokens, key, self._eos,
-                b, self._temp, self._top_k, self._top_p, draft_len=lens)
+                b, self._temp, self._top_k, self._top_p, draft_len=lens,
+                adapter_ids=(self._adapter if self.engine.adapters
+                             is not None else None))
             if self.engine.return_hidden:
                 self._cache, emitted, counts, accepted, hid = out
             else:

@@ -121,7 +121,7 @@ class InferenceEngine:
                  weight_dtype: Optional[str] = None,
                  drafter: Optional[str] = None,
                  return_hidden: Optional[bool] = None,
-                 hooks=None):
+                 hooks=None, adapters=None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
         inf = self.cfg.inference
@@ -299,6 +299,32 @@ class InferenceEngine:
             self.max_seq_len, m.head_dim, m.rope_theta, self._dt)
 
         self._pspecs = llama.param_pspecs(m, weight_dtype=self.weight_dtype)
+        # Multi-tenant adapter pack (inference/tenancy.py): when present,
+        # every dispatch binds per-row adapter ids into the params tree
+        # (llama.bind_adapters) and the compiled programs grow the
+        # adapter operands — a trace-time leaf-form change on the same
+        # seam weight quantization rides, so adapter-less engines build
+        # byte-identical programs to the pre-tenancy engine.
+        # ``shard_params`` keeps placing the BASE tree (self._pspecs);
+        # only the dispatch in_specs see the wrapped form.
+        self.adapters = adapters
+        self._dispatch_pspecs = self._pspecs
+        if adapters is not None:
+            from picotron_tpu.inference import tenancy
+            if adapters.dims != tenancy.adapter_dims(m):
+                raise ValueError(
+                    f"adapter pack built for dims {adapters.dims} but this "
+                    f"model has {tenancy.adapter_dims(m)} — packs are "
+                    f"model-shape specific")
+            if adapters.rows != m.num_hidden_layers:
+                raise ValueError(
+                    f"adapter pack has {adapters.rows} layer rows; the "
+                    f"serving stack holds {m.num_hidden_layers}")
+            self._dispatch_pspecs = llama.adapter_pspecs(self._pspecs)
+            self._adapter_sh = named_shardings(topo, {
+                name: {"a": self._dispatch_pspecs["layers"][name]["a"],
+                       "b": self._dispatch_pspecs["layers"][name]["b"]}
+                for name in llama.QUANT_WEIGHT_LEAVES})
         if self.paged is not None:
             self._cspecs = paged_kv.cache_pspecs(self.quantized,
                                                  policy=self.page_policy)
@@ -368,16 +394,18 @@ class InferenceEngine:
         hid = (P(),) if self.return_hidden else ()
         self._prefill_jit = jax.jit(shard_map(
             self._prefill_impl, mesh,
-            in_specs=(self._pspecs, P(), P()) + samp,
+            in_specs=(self._dispatch_pspecs, P(), P()) + samp,
             out_specs=(kv_spec, P()) + hid))
         self._prefill_chunk_jit = jax.jit(shard_map(
             chunk_impl, mesh,
-            in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P()) + samp,
+            in_specs=(self._dispatch_pspecs, self._cspecs,
+                      P(), P(), P(), P()) + samp,
             out_specs=(self._cspecs, P()) + hid),
             donate_argnums=(1,))
         self._decode_jit = jax.jit(shard_map(
             self._decode_impl, mesh,
-            in_specs=(self._pspecs, self._cspecs, P(), P(), P(), P(), P()),
+            in_specs=(self._dispatch_pspecs, self._cspecs,
+                      P(), P(), P(), P(), P()),
             out_specs=((self._cspecs, P()) if sod
                        else (self._cspecs, P(), P())) + hid),
             donate_argnums=(1,))
@@ -392,7 +420,7 @@ class InferenceEngine:
         hid = (P(),) if self.return_hidden else ()
         return jax.jit(shard_map(
             partial(self._verify_impl, poison=poison), self.topo.mesh,
-            in_specs=(self._pspecs, self._cspecs,
+            in_specs=(self._dispatch_pspecs, self._cspecs,
                       P(), P(), P(), P(), P(), P(), P(), P()),
             out_specs=(self._cspecs, P(), P(), P()) + hid),
             donate_argnums=(1,))
@@ -410,7 +438,7 @@ class InferenceEngine:
         hid = (P(),) if self.return_hidden else ()
         return jax.jit(shard_map(
             partial(self._decode_block_impl, poison=poison), self.topo.mesh,
-            in_specs=(self._pspecs, self._cspecs,
+            in_specs=(self._dispatch_pspecs, self._cspecs,
                       P(), P(), P(), P(), P(), P(), P()),
             out_specs=(self._cspecs, P(), P()) + hid),
             donate_argnums=(1,))
@@ -870,6 +898,45 @@ class InferenceEngine:
         return jax.tree.map(jax.device_put, params,
                             named_shardings(self.topo, self._pspecs))
 
+    # ---- multi-tenant adapters (inference/tenancy.py) ----------------------
+
+    def _adapter_leaves(self) -> dict:
+        """The pack's device arrays, placed with the engine's adapter
+        shardings (cached inside the pack by version, so hot add/remove
+        re-places at the next dispatch and steady state pays nothing)."""
+        return self.adapters.device_leaves(
+            lambda name, side, arr: jax.device_put(
+                arr, self._adapter_sh[name][side]))
+
+    def bind_adapter_ids(self, params, adapter_ids, n: int):
+        """Wrap ``params`` with the adapter pack + this dispatch's
+        per-row adapter slot ids (``adapter_ids`` — [n] ints, or None
+        for all-null). The segmented matmul gathers each row's pair, so
+        one dispatch mixes tenants; slot 0 rows bypass exactly. On an
+        engine without an adapter pack this is the identity (and passing
+        ids is an error — the caller thinks tenants exist)."""
+        if self.adapters is None:
+            if adapter_ids is not None:
+                raise ValueError(
+                    "engine has no adapter pack (construct with "
+                    "adapters=tenancy.AdapterPack) but adapter ids were "
+                    "passed")
+            return params
+        if adapter_ids is None:
+            ids = np.zeros(n, np.int32)
+        else:
+            ids = np.asarray(adapter_ids, np.int32).reshape(-1)
+            if ids.shape[0] != n:
+                raise ValueError(
+                    f"adapter_ids has {ids.shape[0]} rows; this dispatch "
+                    f"carries {n}")
+            if (ids < 0).any() or (ids >= self.adapters.slots).any():
+                raise ValueError(
+                    f"adapter slot ids must be in [0, "
+                    f"{self.adapters.slots}); got {ids.tolist()}")
+        return llama.bind_adapters(params, self._adapter_leaves(),
+                                   jnp.asarray(ids))
+
     def init_cache(self) -> dict:
         """Fresh zeroed cache, sharded on the engine mesh. For the paged
         layout this also resets the host allocator (pool, radix cache,
@@ -931,6 +998,10 @@ class InferenceEngine:
             return jnp.swapaxes(out, 0, 1)  # [B, G]
 
         head_spec = ({"w": P()},) if with_head else ()
+        # base pspecs, NOT the adapter-wrapped dispatch specs: the draft
+        # reads only embed/final_norm/lm_head, and its caller (the
+        # LearnedDrafter) holds the UNBOUND base tree — adapters shape
+        # per-token logits through verify, never through the draft
         return jax.jit(shard_map(
             impl, self.topo.mesh,
             in_specs=(self._pspecs,) + head_spec + (P(), P()),
@@ -1015,7 +1086,8 @@ class InferenceEngine:
                 jnp.asarray(np.asarray(top_k, np.int32).reshape(1)),
                 jnp.asarray(np.asarray(top_p, np.float32).reshape(1)))
 
-    def prefill(self, params, prompt_ids, sample=None) -> tuple:
+    def prefill(self, params, prompt_ids, sample=None,
+                adapter_id=None) -> tuple:
         """Run one prompt through the full-sequence model. Returns
         (kv_blocks, last_logits [1, V] fp32) — or, on a
         ``sample_on_device`` engine (which REQUIRES ``sample=(key,
@@ -1026,6 +1098,9 @@ class InferenceEngine:
         pre-final-norm hidden state [1, H]. Pads to the prompt's bucket
         host-side; jit reuses one executable per bucket size."""
         samp = self._sample_args(sample)
+        if self.adapters is not None or adapter_id is not None:
+            params = self.bind_adapter_ids(
+                params, None if adapter_id is None else [adapter_id], 1)
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -1037,7 +1112,8 @@ class InferenceEngine:
                                  jnp.asarray([ids.size], jnp.int32), *samp)
 
     def prefill_chunked(self, params, cache, prompt_ids, slot: int,
-                        start: int = 0, sample=None) -> tuple:
+                        start: int = 0, sample=None,
+                        adapter_id=None) -> tuple:
         """Prefill one prompt as fixed-width chunk dispatches writing K/V
         straight into ``slot`` (consumes ``cache``). Returns (cache,
         last_logits [1, V] fp32) — or (cache, sampled token [1] int32) on
@@ -1053,6 +1129,9 @@ class InferenceEngine:
         chunks attend over but never recompute). ``prompt_ids`` is always
         the FULL prompt — chunk positions are absolute."""
         samp = self._sample_args(sample)
+        if self.adapters is not None or adapter_id is not None:
+            params = self.bind_adapter_ids(
+                params, None if adapter_id is None else [adapter_id], 1)
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -1111,7 +1190,8 @@ class InferenceEngine:
         return cache, logits
 
     def prefill_paged(self, params, cache, prompt_ids, slot: int,
-                      sample=None) -> tuple:
+                      sample=None, adapter_id=None,
+                      cache_salt: str = "") -> tuple:
         """Paged admission: prefix-match, share, and prefill one prompt
         into ``slot`` (consumes ``cache``). Returns (cache, last_logits
         [1, V] fp32 — or the sampled token [1] int32 on a
@@ -1134,28 +1214,30 @@ class InferenceEngine:
             raise ValueError("empty prompt")
         rh = self.return_hidden
         hidden = None
-        cached = self.paged.match_prefix(slot, ids)
+        cached = self.paged.match_prefix(slot, ids, salt=cache_salt)
         if cached > 0:
             cache = self._set_length_jit(self._sync_tables(cache), slot,
                                          cached)
             out = self.prefill_chunked(params, cache, ids, slot,
-                                       start=cached, sample=sample)
+                                       start=cached, sample=sample,
+                                       adapter_id=adapter_id)
             cache, logits = out[:2]
             hidden = out[2] if rh else None
             n = -(-(len(ids) - cached) // self.prefill_chunk)
         elif len(ids) <= self.prefill_chunk:
-            out = self.prefill(params, ids, sample=sample)
+            out = self.prefill(params, ids, sample=sample,
+                               adapter_id=adapter_id)
             kv, logits = out[:2]
             hidden = out[2] if rh else None
             cache = self.insert(cache, kv, slot, len(ids))
             n = 1
         else:
             out = self.prefill_chunked(params, cache, ids, slot,
-                                       sample=sample)
+                                       sample=sample, adapter_id=adapter_id)
             cache, logits = out[:2]
             hidden = out[2] if rh else None
             n = -(-len(ids) // self.prefill_chunk)
-        self.paged.register_prompt(slot, ids)
+        self.paged.register_prompt(slot, ids, salt=cache_salt)
         base = (cache, logits, n, cached)
         return base + (hidden,) if rh else base
 
@@ -1169,15 +1251,20 @@ class InferenceEngine:
 
         return page_transport.transport_spec(self)
 
-    def export_prefix(self, cache, ids, first_token=None) -> dict:
+    def export_prefix(self, cache, ids, first_token=None,
+                      cache_salt: str = "") -> dict:
         """Serialize the longest radix-cached prefix of ``ids`` as a
         transport payload (paged engines only): pinned pages, byte-exact
         leaves, CRC. ``first_token`` rides along when the match covers
-        the whole prompt — the disaggregated handoff's seat state."""
+        the whole prompt — the disaggregated handoff's seat state.
+        ``cache_salt`` (the tenant) keys the lookup AND rides the
+        payload, so a handoff can only land in the same tenant's
+        subtree on the receiver."""
         from picotron_tpu.inference import page_transport
 
         return page_transport.export_prefix(self, cache, ids,
-                                            first_token=first_token)
+                                            first_token=first_token,
+                                            tenant=cache_salt)
 
     def import_prefix(self, cache, payload) -> tuple:
         """Land a transport payload's pages in the local pool + radix
@@ -1219,7 +1306,7 @@ class InferenceEngine:
         return self._release_jit(cache, slot)
 
     def decode_step(self, params, cache, tokens, key, temperature,
-                    top_k, top_p) -> tuple:
+                    top_k, top_p, adapter_ids=None) -> tuple:
         """One token for every slot. tokens/temperature/top_k/top_p are
         [slots] host or device arrays; returns (cache, next_tokens [slots],
         logits [slots, V] fp32). On a ``sample_on_device`` engine the
@@ -1229,6 +1316,8 @@ class InferenceEngine:
         pre-final-norm hidden states — the learned drafter's input).
         Consumes ``cache``."""
         self._hook("decode")
+        if self.adapters is not None or adapter_ids is not None:
+            params = self.bind_adapter_ids(params, adapter_ids, self.slots)
         if self.paged is not None:
             cache = self._pre_write(cache, 1)
         out = self._dispatch(lambda: self._decode_jit(
@@ -1249,7 +1338,7 @@ class InferenceEngine:
         return out
 
     def decode_block(self, params, cache, tokens, keys, eos_id, budget,
-                     temperature, top_k, top_p) -> tuple:
+                     temperature, top_k, top_p, adapter_ids=None) -> tuple:
         """``decode_block_len`` tokens for every slot in one dispatch.
         ``keys`` is [decode_block_len, 2] (one PRNG key per in-block step);
         ``eos_id`` [slots] int32 (−1 = none), ``budget`` [slots] int32
@@ -1263,6 +1352,8 @@ class InferenceEngine:
                 f"keys has {keys.shape[0]} rows; decode_block_len is "
                 f"{self.decode_block_len} (one key per in-block step)")
         self._hook("decode", budget)
+        if self.adapters is not None or adapter_ids is not None:
+            params = self.bind_adapter_ids(params, adapter_ids, self.slots)
         poison = self._poison("decode")
         if self.paged is not None:
             cache = self._pre_write(cache, self.decode_block_len,
@@ -1285,7 +1376,8 @@ class InferenceEngine:
         return out
 
     def verify(self, params, cache, tokens, key, eos_id, budget,
-               temperature, top_k, top_p, draft_len=None) -> tuple:
+               temperature, top_k, top_p, draft_len=None,
+               adapter_ids=None) -> tuple:
         """One speculative draft-verify dispatch for every slot
         (``spec_len > 0`` engines only). ``tokens`` is
         [slots, spec_len + 1] int32 — column 0 is each slot's current last
@@ -1327,6 +1419,8 @@ class InferenceEngine:
                     f"{self.spec_len}]; got {draft_len.tolist()}")
             valid = draft_len + 1
         self._hook("verify", budget)
+        if self.adapters is not None or adapter_ids is not None:
+            params = self.bind_adapter_ids(params, adapter_ids, self.slots)
         poison = self._poison("verify")
         if self.paged is not None:
             # the verify writes spec_len + 1 rows OPTIMISTICALLY for every

@@ -133,7 +133,8 @@ def check_spec(engine, payload: dict) -> dict:
     return local
 
 
-def export_prefix(engine, cache, ids, first_token=None) -> dict:
+def export_prefix(engine, cache, ids, first_token=None,
+                  tenant: str = "") -> dict:
     """Serialize the longest radix-cached prefix of ``ids`` out of
     ``cache``'s pool. The matched pages are pinned (transient pool refs)
     for the duration; the payload's ``token_ids`` are the covered prefix
@@ -141,13 +142,17 @@ def export_prefix(engine, cache, ids, first_token=None) -> dict:
     a partial leaf, exactly what the local radix holds). ``first_token``
     (the handoff seat state) is attached only when the match covers ALL
     of ``ids`` — a partial export cannot vouch for logits it does not
-    cover. Returns the payload dict; its ``bytes_total`` is the raw
-    (pre-base64) page byte count the handoff metrics account."""
+    cover. ``tenant`` scopes the radix lookup AND rides in the payload:
+    a tenant's exported chunks can only ever graft into the importer's
+    same-tenant radix domain, so the handoff path preserves the
+    isolation the salted radix keys establish locally. Returns the
+    payload dict; its ``bytes_total`` is the raw (pre-base64) page byte
+    count the handoff metrics account."""
     _require_paged(engine)
     p = engine.paged
     ids = [int(t) for t in ids]
     spec = transport_spec(engine)
-    pids, matched = p.acquire_prefix(ids)
+    pids, matched = p.acquire_prefix(ids, salt=tenant)
     try:
         pages = []
         crc = 0
@@ -182,6 +187,7 @@ def export_prefix(engine, cache, ids, first_token=None) -> dict:
         pages=pages,
         crc32=crc,
         bytes_total=total,
+        tenant=str(tenant),
     )
     if first_token is not None and matched == len(ids):
         payload["first_token"] = int(first_token)
@@ -243,11 +249,12 @@ def import_prefix(engine, cache, payload) -> tuple:
     spec = check_spec(engine, payload)
     p = engine.paged
     ids = [int(t) for t in payload.get("token_ids") or []]
+    tenant = str(payload.get("tenant") or "")
     pages = _decode_pages(spec, payload)
     if not ids:
         return cache, {"tokens": 0, "pages_imported": 0, "created": 0,
                        "bytes_total": 0}
-    need = p.radix.plan_adopt(ids)
+    need = p.radix.plan_adopt(ids, salt=tenant)
     if not need:
         # the local radix already covers the whole payload: a remote hit
         # that cost zero pages (the convergent case under affinity churn)
@@ -281,7 +288,7 @@ def import_prefix(engine, cache, payload) -> tuple:
         # them and the pool is exactly as before the import
         p.release_pages(pids)
         raise
-    created = p.finish_import(ids, chunk_pids)
+    created = p.finish_import(ids, chunk_pids, salt=tenant)
     engine.obs.registry.counter(
         "picotron_handoff_bytes_total",
         "raw KV page bytes moved by the transport, by direction",

@@ -502,14 +502,30 @@ class RadixCache:
     write past the fork COWs it. ``insert`` registers a prefilled
     prompt's pages (the cache becomes a holder: refcount +1). Eviction is
     LRU over refcount-1 leaves (pages nobody but the cache holds);
-    freeing a leaf can expose its parent as the next candidate."""
+    freeing a leaf can expose its parent as the next candidate.
+
+    Every lookup/registration operation takes a ``salt`` (default ``""``)
+    naming an isolation domain — multi-tenant serving salts with the
+    tenant id so identical prompts under different tenants NEVER share
+    pages (a cross-tenant prefix hit would leak one tenant's KV bytes
+    into another's decode). Each salt owns its own trie root; eviction
+    and accounting span all of them, so an idle tenant's cached prefixes
+    still yield to a busy one under pressure."""
 
     def __init__(self, page_len: int, pool: PagePool):
         self.page_len = int(page_len)
         self.pool = pool
-        self.root = _Node((), -1, None)
+        self.root = _Node((), -1, None)  # the default ("") salt's root
+        self._roots = {"": self.root}
         self._clock = 0
         self.evictions = 0
+
+    def _root_for(self, salt: str) -> _Node:
+        root = self._roots.get(salt)
+        if root is None:
+            root = _Node((), -1, None)
+            self._roots[salt] = root
+        return root
 
     def _touch(self, node: _Node) -> None:
         self._clock += 1
@@ -524,11 +540,12 @@ class RadixCache:
             n += 1
         return n
 
-    def match(self, ids) -> tuple:
-        """Longest cached prefix of ``ids``: returns (pages, matched)
-        where ``pages`` back positions ``[0, matched)`` in order (the
-        last may be partial: ``matched`` can end mid-page)."""
-        node, pages, matched = self.root, [], 0
+    def match(self, ids, salt: str = "") -> tuple:
+        """Longest cached prefix of ``ids`` within ``salt``'s domain:
+        returns (pages, matched) where ``pages`` back positions
+        ``[0, matched)`` in order (the last may be partial: ``matched``
+        can end mid-page)."""
+        node, pages, matched = self._root_for(salt), [], 0
         rest = list(ids)
         while True:
             chunk = tuple(rest[: self.page_len])
@@ -552,14 +569,14 @@ class RadixCache:
                 self._touch(best)
             return pages, matched
 
-    def insert(self, ids, page_at) -> int:
-        """Register a prefilled prompt's pages: ``page_at(i)`` resolves
-        the prompt's logical page ``i`` (the slot's table). Existing
-        nodes are touched, new ones take a cache reference on the slot's
-        page. The partial tail (a prompt ending mid-page) becomes a
-        partial leaf unless an existing child already covers it. Returns
-        the number of nodes created."""
-        node, created = self.root, 0
+    def insert(self, ids, page_at, salt: str = "") -> int:
+        """Register a prefilled prompt's pages under ``salt``'s domain:
+        ``page_at(i)`` resolves the prompt's logical page ``i`` (the
+        slot's table). Existing nodes are touched, new ones take a cache
+        reference on the slot's page. The partial tail (a prompt ending
+        mid-page) becomes a partial leaf unless an existing child already
+        covers it. Returns the number of nodes created."""
+        node, created = self._root_for(salt), 0
         n = len(ids)
         full = n // self.page_len
         for i in range(full):
@@ -591,13 +608,14 @@ class RadixCache:
                 created += 1
         return created
 
-    def plan_adopt(self, ids) -> list:
-        """Chunk indices of ``ids`` with no existing trie node — the pages
-        a cross-replica import must supply (non-destructive dry run of
-        ``adopt``). Once one chunk is missing, every deeper chunk needs a
-        node too (its parent path would be new), so the plan is always a
-        suffix of the chunk list."""
-        node = self.root
+    def plan_adopt(self, ids, salt: str = "") -> list:
+        """Chunk indices of ``ids`` with no existing trie node in
+        ``salt``'s domain — the pages a cross-replica import must supply
+        (non-destructive dry run of ``adopt``). Once one chunk is
+        missing, every deeper chunk needs a node too (its parent path
+        would be new), so the plan is always a suffix of the chunk
+        list."""
+        node = self._root_for(salt)
         n = len(ids)
         full = n // self.page_len
         tail = n % self.page_len
@@ -615,8 +633,8 @@ class RadixCache:
                 return [full]
         return []
 
-    def adopt(self, ids, page_for: dict) -> tuple:
-        """Graft imported pages into the trie: ``page_for[i]`` backs
+    def adopt(self, ids, page_for: dict, salt: str = "") -> tuple:
+        """Graft imported pages into ``salt``'s trie: ``page_for[i]`` backs
         chunk ``i`` of ``ids`` (the last may be partial). New nodes take a
         cache reference on their page (the importer's own alloc reference
         is dropped by the caller afterwards, leaving exactly the cache as
@@ -625,7 +643,7 @@ class RadixCache:
         page (if any was supplied) is returned in ``dups`` for the caller
         to free — idempotent under the dispatch-retry discipline. Returns
         (created, duplicate_page_ids)."""
-        node, created, dups = self.root, 0, []
+        node, created, dups = self._root_for(salt), 0, []
         n = len(ids)
         full = n // self.page_len
         for i in range(full):
@@ -661,12 +679,13 @@ class RadixCache:
         return created, dups
 
     def _leaves(self):
-        stack = [self.root]
-        while stack:
-            n = stack.pop()
-            if n is not self.root and not n.children:
-                yield n
-            stack.extend(n.children.values())
+        for root in self._roots.values():
+            stack = list(root.children.values())
+            while stack:
+                n = stack.pop()
+                if not n.children:
+                    yield n
+                stack.extend(n.children.values())
 
     def evictable_count(self) -> int:
         """Pages eviction could free, cascading: nodes whose ENTIRE
@@ -685,7 +704,8 @@ class RadixCache:
                 return total, False
             return total + 1, True
 
-        return sum(count(c)[0] for c in self.root.children.values())
+        return sum(count(c)[0] for root in self._roots.values()
+                   for c in root.children.values())
 
     def evict_one(self) -> bool:
         """Free the least-recently-used refcount-1 leaf's page. Returns
@@ -704,13 +724,16 @@ class RadixCache:
         return True
 
     def clear(self) -> None:
-        """Drop every cache reference (pool reset path)."""
-        stack = list(self.root.children.values())
+        """Drop every cache reference, across all salts (pool reset
+        path)."""
+        stack = [n for root in self._roots.values()
+                 for n in root.children.values()]
         while stack:
             n = stack.pop()
             self.pool.unref(n.page_id)
             stack.extend(n.children.values())
-        self.root.children = {}
+        for root in self._roots.values():
+            root.children = {}
 
 
 class PagedKV:
@@ -798,7 +821,8 @@ class PagedKV:
             pid = self.pool.alloc()
         return pid
 
-    def match_prefix(self, slot: int, ids, cap_last: bool = True) -> int:
+    def match_prefix(self, slot: int, ids, cap_last: bool = True,
+                     salt: str = "") -> int:
         """Admission half of prefix sharing: find the longest cached
         prefix of ``ids``, take references on its pages into ``slot``'s
         table, and return the cached length (capped at ``len(ids) - 1``
@@ -823,7 +847,7 @@ class PagedKV:
         self.prompt_tokens += len(ids)
         if not self.prefix_cache:
             return 0
-        pages, matched = self.radix.match(ids)
+        pages, matched = self.radix.match(ids, salt=salt)
         cached = min(matched, len(ids) - (1 if cap_last else 0))
         npages = self.pages_for(cached)
         for i in range(npages):
@@ -863,15 +887,16 @@ class PagedKV:
 
     # ---- page transport (prefill/decode disaggregation) -------------------
 
-    def acquire_prefix(self, ids) -> tuple:
-        """Export pin: radix-match ``ids`` and take a TRANSIENT reference
-        on every matched page so eviction (and any COW planning) cannot
-        touch them while the transport serializes their bytes. Returns
-        (page_ids, matched_tokens); the caller MUST ``release_pages`` the
-        returned pages when done — the pin is a holder like any other."""
+    def acquire_prefix(self, ids, salt: str = "") -> tuple:
+        """Export pin: radix-match ``ids`` (within ``salt``'s domain) and
+        take a TRANSIENT reference on every matched page so eviction (and
+        any COW planning) cannot touch them while the transport
+        serializes their bytes. Returns (page_ids, matched_tokens); the
+        caller MUST ``release_pages`` the returned pages when done — the
+        pin is a holder like any other."""
         if not self.prefix_cache:
             return [], 0
-        pages, matched = self.radix.match(ids)
+        pages, matched = self.radix.match(ids, salt=salt)
         npages = self.pages_for(matched)
         held = []
         for i in range(npages):
@@ -899,22 +924,23 @@ class PagedKV:
             raise
         return pids
 
-    def finish_import(self, ids, chunk_pids: dict) -> int:
-        """Graft written import pages into the radix cache and drop the
-        importer's references: created nodes end held by the cache alone
-        (refcount 1, evictable — exactly a registered prompt's state);
-        duplicate chunks' pages free immediately. Returns nodes
-        created."""
-        created, _ = self.radix.adopt(ids, chunk_pids)
+    def finish_import(self, ids, chunk_pids: dict, salt: str = "") -> int:
+        """Graft written import pages into ``salt``'s radix domain and
+        drop the importer's references: created nodes end held by the
+        cache alone (refcount 1, evictable — exactly a registered
+        prompt's state); duplicate chunks' pages free immediately.
+        Returns nodes created."""
+        created, _ = self.radix.adopt(ids, chunk_pids, salt=salt)
         self.release_pages(chunk_pids.values())
         return created
 
-    def register_prompt(self, slot: int, ids) -> None:
-        """Insert a freshly prefilled prompt's pages into the radix
-        cache (post-prefill: the pages hold final bytes; the slot's
-        decode writes land past the prompt and COW first)."""
+    def register_prompt(self, slot: int, ids, salt: str = "") -> None:
+        """Insert a freshly prefilled prompt's pages into ``salt``'s
+        radix domain (post-prefill: the pages hold final bytes; the
+        slot's decode writes land past the prompt and COW first)."""
         if self.prefix_cache:
-            self.radix.insert(ids, lambda i: int(self.tables[slot, i]))
+            self.radix.insert(ids, lambda i: int(self.tables[slot, i]),
+                              salt=salt)
 
     def quant_flags(self) -> np.ndarray:
         """Per-page ``hot_bf16`` policy flags for the device

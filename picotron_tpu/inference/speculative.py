@@ -336,6 +336,13 @@ class SpecController:
         self._idle = [0] * self.slots
         self._tried: list = [{self.kinds[0]} for _ in range(self.slots)]
         self._snap = [(0.0, 0.0)] * self.slots  # counter values at last eval
+        # per-slot TPOT SLO in SECONDS (None = best-effort): tokens
+        # arrive in per-dispatch bursts, so the inter-token gap a client
+        # sees is the dispatch wall time — a slot whose measured
+        # verify+draft latency exceeds its SLO gets its draft length
+        # halved regardless of accept rate (multi-tenant serving's SLO
+        # input; the batcher sets it at admission via reset())
+        self._slo: list = [None] * self.slots
         # shadow tallies so the loop still closes under obs.enabled:
         # false (the NullRegistry's counters read 0 forever)
         self._local = [(0.0, 0.0)] * self.slots
@@ -403,15 +410,38 @@ class SpecController:
 
     # ---- batcher surface ---------------------------------------------------
 
-    def reset(self, slot: int) -> None:
+    def reset(self, slot: int, tpot_slo_s: Optional[float] = None) -> None:
         """A fresh request took ``slot``: restart it at the optimistic
-        full draft with the primary drafter and clean stats."""
+        full draft with the primary drafter and clean stats.
+        ``tpot_slo_s`` (multi-tenant serving) is the request's token-gap
+        budget in seconds — a slot whose measured dispatch latency
+        cannot afford the full draft width starts at 1 instead of
+        ``gmax`` and is capped down each round it overshoots."""
+        self._slo[slot] = tpot_slo_s
         self._g[slot] = self.gmax
+        if tpot_slo_s is not None and self._over_slo(slot):
+            # the measured verify cadence already misses this budget:
+            # start at the narrowest useful draft, not the optimistic max
+            self._g[slot] = 1
         self._kind[slot] = self.kinds[0]
         self._streak[slot] = 0
         self._idle[slot] = 0
         self._tried[slot] = {self.kinds[0]}
         self._snap[slot] = self._counts(slot)
+
+    def _over_slo(self, slot: int) -> bool:
+        """Whether the slot's measured per-dispatch latency (verify +
+        draft — the burst gap its client observes) exceeds its TPOT SLO.
+        False without an SLO or before the latency histograms hold
+        ``latency_min_samples`` — the SLO input engages on EVIDENCE,
+        like the controller's cost term."""
+        slo = self._slo[slot]
+        if slo is None:
+            return False
+        c_v = self._mean_latency("verify")
+        if c_v is None:
+            return False
+        return c_v + (self._mean_latency("draft") or 0.0) > slo
 
     def lens(self) -> np.ndarray:
         """Per-slot draft length for the NEXT round [slots] int32."""
@@ -445,6 +475,15 @@ class SpecController:
                 self._tried[slot] = {self._kind[slot]}
                 self._snap[slot] = self._counts(slot)
                 self._decide("probe")
+            return
+        if g > 1 and self._over_slo(slot):
+            # SLO input (multi-tenant serving): the dispatch burst gap
+            # misses this slot's token-cadence budget — halve the width
+            # now, without waiting for the accept-rate window; ramp-ups
+            # re-earn width only once the cadence fits again
+            self._g[slot] = g // 2
+            self._streak[slot] = 0
+            self._decide("slo_cap")
             return
         prop, acc = self._counts(slot)
         sprop, sacc = self._snap[slot]

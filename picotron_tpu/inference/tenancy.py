@@ -1,0 +1,386 @@
+"""Multi-tenant serving: the tenant registry and the adapter pack.
+
+One deployment, many products. A **tenant** is a named serving identity
+carrying four things:
+
+- an optional LoRA adapter (rank, seed or .npz weights) applied as an
+  additive residual on the seven decoder-layer projections — batched
+  with every other tenant's adapter in ONE dispatch by the segmented
+  matmul (ops/pallas/lora_matmul.py);
+- a **priority class** (higher sheds later): under queue or page
+  pressure the scheduler sheds the lowest class first and the admission
+  ladder seats higher classes first;
+- **quotas** (in-flight token and KV-page budgets) priced through the
+  batcher's existing ``commitment()`` / ``page_commitment`` ladder;
+- **SLO targets** (TTFT / TPOT milliseconds) that steer chunked-prefill
+  interleaving, the SpecController's per-slot spec_len, and router
+  placement.
+
+The **AdapterPack** is the device-side half: fixed-capacity stacked
+``a [L, T, in, r]`` / ``b [L, T, r, out]`` arrays per projection leaf,
+zero everywhere a slot is free. Slot 0 is the reserved NULL adapter
+(A = B = 0) — base-only rows point at it and bypass exactly. Hot
+add/remove writes one slot of the host master and bumps ``version``;
+the engine re-places the pack on its mesh at the next dispatch, so
+tenant churn never recompiles a program (shapes are capacity-static).
+
+The **TenantRegistry** is the host-side half: name -> Tenant + pack
+slot, loaded from config or a JSON manifest::
+
+    {"tenants": [
+        {"name": "acme", "priority": 2, "adapter_rank": 8,
+         "adapter_seed": 7, "max_tokens": 4096, "max_pages": 256,
+         "ttft_slo_ms": 300.0, "tpot_slo_ms": 50.0},
+        {"name": "bulk", "priority": 0}
+    ]}
+
+and mutated at runtime via serve.py's ``/tenants`` admin endpoint.
+Rank-0 tenants (no adapter) share slot 0 and consume no pack capacity.
+
+Tenant identity also salts the KV reuse planes: the radix prefix cache
+keys per-tenant subtrees (paged_kv.RadixCache) and the page-transport
+chunk keys carry the tenant (page_transport), so identical prompts
+under different tenants never share pages or handoff chunks — the
+adapter changes every activation a cached page holds — while same-
+tenant sharing still works cluster-wide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from picotron_tpu.models import llama
+from picotron_tpu.ops.pallas.lora_matmul import ADAPTER_DTYPE, NULL_ADAPTER
+
+# The seven projection leaves an adapter modifies (the PR 13 dispatch
+# seam; the LM head stays base-only — classic LoRA placement).
+LORA_LEAVES = llama.QUANT_WEIGHT_LEAVES
+
+# Default tenant identity for requests that name none: base model, no
+# adapter, middle priority, no quotas, no SLOs.
+BASE_TENANT = "base"
+
+# Default random-init scale for seed-derived adapters (smoke/bench/test
+# path): small enough that tiny test models keep coherent generations,
+# large enough that tenants' outputs measurably differ.
+DEFAULT_ADAPTER_SCALE = 0.05
+
+
+def adapter_dims(m) -> dict:
+    """Per-leaf ``(in_features, out_features)`` for the seven projection
+    weights, from the model config (matches llama.init_params)."""
+    H, I, D = m.hidden_size, m.intermediate_size, m.head_dim
+    Hq, Hkv = m.num_attention_heads * D, m.num_key_value_heads * D
+    return {
+        "wq": (H, Hq), "wk": (H, Hkv), "wv": (H, Hkv), "wo": (Hq, H),
+        "w_gate": (H, I), "w_up": (H, I), "w_down": (I, H),
+    }
+
+
+@dataclasses.dataclass
+class Tenant:
+    """One serving identity. ``priority``: higher holds admission longer
+    under pressure (0 = best-effort, shed first). ``adapter_rank`` 0
+    means base-only (null adapter, slot 0). Quotas are in-flight
+    ceilings; None = unlimited. SLO targets are milliseconds; None =
+    no target."""
+    name: str
+    priority: int = 1
+    adapter_rank: int = 0
+    adapter_seed: int = 0
+    adapter_scale: float = DEFAULT_ADAPTER_SCALE
+    adapter_npz: str | None = None
+    max_tokens: int | None = None
+    max_pages: int | None = None
+    ttft_slo_ms: float | None = None
+    tpot_slo_ms: float | None = None
+
+    def __post_init__(self):
+        if not self.name or "/" in self.name or '"' in self.name:
+            raise ValueError(
+                f"tenant name {self.name!r} must be non-empty and free of "
+                f"'/' and '\"' (it labels metrics and salts cache keys)")
+        if self.priority < 0:
+            raise ValueError(
+                f"tenant {self.name}: priority must be >= 0 "
+                f"(0 = best-effort, shed first)")
+        if self.adapter_rank < 0:
+            raise ValueError(
+                f"tenant {self.name}: adapter_rank must be >= 0 "
+                f"(0 = base-only)")
+        for f in ("max_tokens", "max_pages"):
+            v = getattr(self, f)
+            if v is not None and v < 1:
+                raise ValueError(
+                    f"tenant {self.name}: {f} must be >= 1 or absent")
+        for f in ("ttft_slo_ms", "tpot_slo_ms"):
+            v = getattr(self, f)
+            if v is not None and v <= 0:
+                raise ValueError(
+                    f"tenant {self.name}: {f} must be > 0 ms or absent")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Tenant":
+        known = {f.name for f in dataclasses.fields(cls)}
+        bad = set(d) - known
+        if bad:
+            raise ValueError(
+                f"unknown tenant field(s) {sorted(bad)} for "
+                f"{d.get('name', '?')!r} (known: {sorted(known)})")
+        return cls(**d)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class AdapterPack:
+    """Fixed-capacity stacked adapter storage for one model shape.
+
+    ``slots`` total adapter slots (slot 0 reserved null), ``rank`` the
+    capacity rank R: a tenant of rank r <= R occupies the first r
+    columns of its slot, the rest stay zero — exact, since zero columns
+    contribute nothing to the residual. Mutations write the host master
+    and bump ``version``; ``device_leaves()`` lazily (re-)materializes
+    the jnp arrays, so callers that cache by version re-place only
+    after churn. Shapes never change after construction — hot
+    add/remove never recompiles a serving program."""
+
+    def __init__(self, m, *, slots: int = 8, rank: int = 16,
+                 rows: int | None = None):
+        if slots < 2:
+            raise ValueError(
+                f"adapter_slots must be >= 2 (slot 0 is the reserved "
+                f"null adapter); got {slots}")
+        if rank < 1:
+            raise ValueError(f"adapter_rank capacity must be >= 1; "
+                             f"got {rank}")
+        self.slots, self.rank = int(slots), int(rank)
+        self.rows = int(rows or m.num_hidden_layers)
+        self.dims = adapter_dims(m)
+        self._host = {
+            name: (np.zeros((self.rows, self.slots, din, self.rank),
+                            np.float32),
+                   np.zeros((self.rows, self.slots, self.rank, dout),
+                            np.float32))
+            for name, (din, dout) in self.dims.items()
+        }
+        self.version = 0
+        self._device = None
+        self._device_version = -1
+        self._lock = threading.Lock()
+
+    # -- mutation (host master; device refresh is lazy) ----------------------
+
+    def set_slot(self, slot: int, leaves: dict) -> None:
+        """Install adapter weights into ``slot``. ``leaves`` maps leaf
+        name -> (a [rows, in, r], b [rows, r, out]) with r <= capacity;
+        missing leaves zero out (adapter doesn't touch them)."""
+        self._check_slot(slot)
+        with self._lock:
+            for name, (ha, hb) in self._host.items():
+                ha[:, slot] = 0.0
+                hb[:, slot] = 0.0
+                if name not in leaves:
+                    continue
+                a, b = leaves[name]
+                a = np.asarray(a, np.float32)
+                b = np.asarray(b, np.float32)
+                din, dout = self.dims[name]
+                r = a.shape[-1]
+                if (a.shape != (self.rows, din, r)
+                        or b.shape != (self.rows, r, dout)
+                        or r > self.rank):
+                    raise ValueError(
+                        f"adapter leaf {name}: got a {a.shape} / b "
+                        f"{b.shape}; want a [{self.rows}, {din}, r] / "
+                        f"b [{self.rows}, r, {dout}] with r <= "
+                        f"{self.rank}")
+                ha[:, slot, :, :r] = a
+                hb[:, slot, :r, :] = b
+            self.version += 1
+
+    def clear_slot(self, slot: int) -> None:
+        """Zero a slot back to null (hot remove)."""
+        self._check_slot(slot)
+        with self._lock:
+            for ha, hb in self._host.values():
+                ha[:, slot] = 0.0
+                hb[:, slot] = 0.0
+            self.version += 1
+
+    def random_leaves(self, rank: int, seed: int,
+                      scale: float = DEFAULT_ADAPTER_SCALE) -> dict:
+        """Seed-derived adapter weights (the smoke/bench/test path —
+        deterministic per (rank, seed), visibly non-null)."""
+        if not 1 <= rank <= self.rank:
+            raise ValueError(
+                f"adapter rank {rank} outside [1, capacity {self.rank}]")
+        rng = np.random.default_rng(seed)
+        out = {}
+        for name, (din, dout) in self.dims.items():
+            out[name] = (
+                rng.normal(0.0, scale,
+                           (self.rows, din, rank)).astype(np.float32),
+                rng.normal(0.0, scale,
+                           (self.rows, rank, dout)).astype(np.float32))
+        return out
+
+    def npz_leaves(self, path: str) -> dict:
+        """Adapter weights from an .npz archive with ``{leaf}.a`` /
+        ``{leaf}.b`` arrays (offline-trained adapters)."""
+        with np.load(path) as z:
+            out = {}
+            for name in self.dims:
+                ka, kb = f"{name}.a", f"{name}.b"
+                if ka in z and kb in z:
+                    out[name] = (z[ka], z[kb])
+            if not out:
+                raise ValueError(
+                    f"adapter archive {path} has no '<leaf>.a'/'<leaf>.b' "
+                    f"arrays for leaves {sorted(self.dims)}")
+        return out
+
+    # -- device side ---------------------------------------------------------
+
+    def device_leaves(self, place=None) -> dict:
+        """The pack as jnp arrays, ``{leaf: {"a": [L, T, in, R],
+        "b": [L, T, R, out]}}`` — cached until the next mutation.
+        ``place`` (optional) maps (leaf_name, side, host_array) -> device
+        array so the engine can land shards straight on its mesh."""
+        with self._lock:
+            if self._device is not None \
+                    and self._device_version == self.version:
+                return self._device
+            put = place or (lambda _n, _s, arr: jnp.asarray(
+                arr, ADAPTER_DTYPE))
+            self._device = {
+                name: {"a": put(name, "a", ha), "b": put(name, "b", hb)}
+                for name, (ha, hb) in self._host.items()
+            }
+            self._device_version = self.version
+            return self._device
+
+    def bytes_per_token(self) -> int:
+        """Adapter bytes one decoded token streams for one adapter-bound
+        row: each layer reads its [in, R] + [R, out] fp32 pair."""
+        per_layer = sum((din + dout) * self.rank
+                        for din, dout in self.dims.values())
+        return self.rows * per_layer * np.dtype(np.float32).itemsize
+
+    def _check_slot(self, slot: int) -> None:
+        if not NULL_ADAPTER < slot < self.slots:
+            raise ValueError(
+                f"adapter slot {slot} outside (0, {self.slots}) — slot 0 "
+                f"is the reserved null adapter")
+
+
+class TenantRegistry:
+    """name -> (Tenant, adapter slot), with hot add/remove.
+
+    The registry owns slot assignment on its AdapterPack (rank-0 tenants
+    share the null slot 0 and consume no capacity). The implicit
+    ``base`` tenant always resolves — requests that name no tenant get
+    it — unless the manifest defines its own ``base`` entry, which then
+    governs (e.g. to give anonymous traffic a priority or quota)."""
+
+    def __init__(self, pack: AdapterPack | None = None):
+        self.pack = pack
+        self._tenants: dict = {}
+        self._slots: dict = {}
+        self._lock = threading.Lock()
+        self._base = Tenant(name=BASE_TENANT)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_manifest(cls, path: str,
+                      pack: AdapterPack | None = None) -> "TenantRegistry":
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or not isinstance(
+                doc.get("tenants", None), list):
+            raise ValueError(
+                f"tenant manifest {path} must be a JSON object with a "
+                f"'tenants' list")
+        reg = cls(pack)
+        for entry in doc["tenants"]:
+            reg.add(Tenant.from_dict(entry))
+        return reg
+
+    # -- mutation ------------------------------------------------------------
+
+    def add(self, tenant: Tenant) -> int:
+        """Register a tenant (hot). Returns its adapter slot. Raises on
+        duplicate names, missing pack, or a full pack."""
+        with self._lock:
+            if tenant.name in self._tenants:
+                raise ValueError(f"tenant {tenant.name!r} already exists")
+            slot = NULL_ADAPTER
+            if tenant.adapter_rank > 0:
+                if self.pack is None:
+                    raise ValueError(
+                        f"tenant {tenant.name!r} wants adapter rank "
+                        f"{tenant.adapter_rank} but no adapter pack is "
+                        f"configured (inference.tenancy.adapter_slots)")
+                used = set(self._slots.values())
+                free = [s for s in range(1, self.pack.slots)
+                        if s not in used]
+                if not free:
+                    raise ValueError(
+                        f"adapter pack full ({self.pack.slots - 1} "
+                        f"slots); remove a tenant first")
+                slot = free[0]
+                if tenant.adapter_npz:
+                    leaves = self.pack.npz_leaves(tenant.adapter_npz)
+                else:
+                    leaves = self.pack.random_leaves(
+                        tenant.adapter_rank, tenant.adapter_seed,
+                        tenant.adapter_scale)
+                self.pack.set_slot(slot, leaves)
+                self._slots[tenant.name] = slot
+            self._tenants[tenant.name] = tenant
+            return slot
+
+    def remove(self, name: str) -> None:
+        """Deregister (hot): the slot zeroes back to null, so in-flight
+        rows still pointing at it degrade to base-model output rather
+        than another tenant's adapter."""
+        with self._lock:
+            if name not in self._tenants:
+                raise KeyError(f"no tenant {name!r}")
+            del self._tenants[name]
+            slot = self._slots.pop(name, None)
+            if slot is not None and self.pack is not None:
+                self.pack.clear_slot(slot)
+
+    # -- lookup --------------------------------------------------------------
+
+    def resolve(self, name: str | None) -> tuple:
+        """(Tenant, adapter slot) for a request's tenant field; None or
+        "" resolves to the base identity. KeyError on unknown names —
+        serve.py turns that into a 4xx, never a silent base fallback
+        (a typo'd tenant must not dodge its quota)."""
+        name = name or BASE_TENANT
+        with self._lock:
+            if name in self._tenants:
+                return (self._tenants[name],
+                        self._slots.get(name, NULL_ADAPTER))
+        if name == BASE_TENANT:
+            return self._base, NULL_ADAPTER
+        raise KeyError(f"unknown tenant {name!r}")
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def snapshot(self) -> list:
+        """Admin-endpoint view: every tenant + its slot (base implied)."""
+        with self._lock:
+            return [{**t.to_dict(), "adapter_slot":
+                     self._slots.get(n, NULL_ADAPTER)}
+                    for n, t in sorted(self._tenants.items())]

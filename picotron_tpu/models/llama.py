@@ -198,16 +198,30 @@ def matmul(x, w):
     """``x @ w`` dispatching on the weight leaf's form: a plain array runs
     the dense matmul; a quantized ``{"q": int8, "s": fp32}`` pair (see
     ops/pallas/quant_matmul.py) runs the fused dequant matmul — the
-    Pallas kernel on TPU, the XLA int8-einsum fallback elsewhere. A
-    trace-time Python branch, exactly like the attend_impl dispatch: each
-    leaf form traces its own program, no runtime cost. Output dtype
-    follows ``x`` on the quantized path (the dense path's promotion rule
-    for same-dtype operands)."""
+    Pallas kernel on TPU, the XLA int8-einsum fallback elsewhere; an
+    adapter-wrapped ``{"w", "a", "b", "ids"}`` leaf (multi-tenant
+    serving, ops/pallas/lora_matmul.py) recurses on its base ``w`` —
+    which may itself be the quantized pair — and adds the per-row
+    segmented LoRA residual on top, so one dispatch mixes tenants while
+    the base weights stay int8 or bf16 untouched. A trace-time Python
+    branch, exactly like the attend_impl dispatch: each leaf form traces
+    its own program, no runtime cost. Output dtype follows ``x`` on the
+    quantized path (the dense path's promotion rule for same-dtype
+    operands) and the base output on the adapter path (the fp32 residual
+    casts onto it)."""
+    from picotron_tpu.ops.pallas.lora_matmul import (
+        is_lora_weight,
+        lora_matmul,
+    )
     from picotron_tpu.ops.pallas.quant_matmul import (
         is_quant_weight,
         quant_matmul,
     )
 
+    if is_lora_weight(w):
+        base = matmul(x, w["w"])
+        return base + lora_matmul(x, w["a"], w["b"],
+                                  w["ids"]).astype(base.dtype)
     if is_quant_weight(w):
         return quant_matmul(x, w["q"], w["s"])
     return x @ w
@@ -330,6 +344,83 @@ def param_pspecs(_: ModelConfig, fsdp: bool = False,
         }
         specs["lm_head"] = qspec(specs["lm_head"])
     return specs
+
+
+# Multi-tenant adapters: which projections contract over a tp-sharded
+# axis (row-parallel) — their adapter A shards WITH the contraction so
+# the residual's partial sums ride the same tp_reduce the base output
+# does; everywhere else A replicates and B shards its out-features.
+_ROW_PARALLEL = ("wo", "w_down")
+
+
+def adapter_pspecs(specs: Params) -> Params:
+    """Wrap a ``param_pspecs`` tree's seven projection leaves into the
+    adapter leaf form ``{"w": base_spec, "a", "b", "ids"}`` (see
+    ops/pallas/lora_matmul.py). a is [L, T, in, r] sharded 'pp' on the
+    stack and — row-parallel leaves only — 'tp' on the contraction;
+    b is [L, T, r, out] sharded 'pp' + 'tp' on out-features for
+    column-parallel leaves; ids is the [L, B] per-row adapter-id
+    broadcast, 'pp'-sharded with the stack. The base leaf spec (dense
+    or quantized pair) nests untouched, so adapter engines shard their
+    base weights exactly like non-adapter engines do."""
+    layers = dict(specs["layers"])
+    for name in QUANT_WEIGHT_LEAVES:
+        row = name in _ROW_PARALLEL
+        layers[name] = {
+            "w": layers[name],
+            "a": P("pp", None, "tp" if row else None, None),
+            "b": P("pp", None, None, None if row else "tp"),
+            "ids": P("pp", None),
+        }
+    return {**specs, "layers": layers}
+
+
+def bind_adapters(params: Params, pack_leaves: dict, ids) -> Params:
+    """Wrap the seven projection leaves with the adapter pack + this
+    dispatch's per-row adapter ids (``ids`` [B] int32) — the host-side
+    step before every adapter-engine dispatch. ``pack_leaves`` is
+    AdapterPack.device_leaves(): ``{leaf: {"a": [L, T, in, R],
+    "b": [L, T, R, out]}}``. ids broadcasts to [L, B] so the layer scan
+    slices a per-layer [B] row alongside each weight. Cheap: a dict
+    rebuild around existing device arrays plus one tiny broadcast."""
+    from picotron_tpu.ops.pallas.lora_matmul import is_lora_weight
+
+    if is_lora_weight(params["layers"]["wq"]):
+        raise ValueError("params are already adapter-bound — bind once "
+                         "per dispatch from the BASE tree")
+    ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+    L = params["layers"]["attn_norm"].shape[0]
+    ids_l = jnp.broadcast_to(ids[None, :], (L, ids.shape[0]))
+    layers = dict(params["layers"])
+    for name in QUANT_WEIGHT_LEAVES:
+        layers[name] = {"w": layers[name], "a": pack_leaves[name]["a"],
+                        "b": pack_leaves[name]["b"], "ids": ids_l}
+    return {**params, "layers": layers}
+
+
+def merge_adapter(params: Params, leaves: dict) -> Params:
+    """The merged-weight reference tree ``W + A @ B`` — TESTS AND PARITY
+    TOOLING ONLY (generate.py --check-adapter-parity): a dense engine
+    fed this tree is the solo-tenant oracle the segmented multi-tenant
+    dispatch's generations are pinned against. ``leaves`` maps leaf
+    name -> (a [L, in, r], b [L, r, out]) (AdapterPack.random_leaves
+    format). Dense trees only — an int8 engine's oracle merges into its
+    fake-quant dense twin (llama.dequantize_params), mirroring the
+    weight-parity gate."""
+    from picotron_tpu.ops.pallas.quant_matmul import is_quant_weight
+
+    layers = dict(params["layers"])
+    for name, (a, b) in leaves.items():
+        w = layers[name]
+        if is_quant_weight(w):
+            raise ValueError(
+                f"merge_adapter needs dense weights; {name} is quantized "
+                f"— dequantize_params first (the weight-parity recipe)")
+        delta = jnp.einsum("lkr,lrn->lkn", jnp.asarray(a, jnp.float32),
+                           jnp.asarray(b, jnp.float32),
+                           preferred_element_type=jnp.float32)
+        layers[name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    return {**params, "layers": layers}
 
 
 # --------------------------------------------------------------------------- #

@@ -173,6 +173,22 @@ def main(argv=None) -> int:
                          "request's tokens match — the `make quant-smoke` "
                          "gate proving the fused int8 pipeline implements "
                          "fake-quant semantics exactly")
+    ap.add_argument("--adapter", default=None,
+                    metavar="RANK[:SEED[:SCALE]]",
+                    help="serve every request through one seed-derived "
+                         "LoRA adapter (tenancy.AdapterPack, slot 1) "
+                         "over the base weights — the multi-tenant "
+                         "segmented dispatch with a single tenant")
+    ap.add_argument("--check-adapter-parity", action="store_true",
+                    help="run the batch again on an adapter-less dense "
+                         "engine fed the MERGED reference (W + A @ B, "
+                         "llama.merge_adapter; an int8 primary merges "
+                         "into its fake-quant dense twin) and fail "
+                         "unless every request's tokens match — the "
+                         "`make tenant-smoke` gate proving the "
+                         "segmented adapter matmul implements "
+                         "merged-weight semantics exactly (greedy-only, "
+                         "same exactness rule as --check-weight-parity)")
     ap.add_argument("--sample-on-device", action="store_true",
                     help="fused sampling epilogue: prefill/decode "
                          "dispatches sample inside the jitted program "
@@ -234,6 +250,33 @@ def main(argv=None) -> int:
         ap.error("--check-weight-parity is a greedy-only gate (fused vs "
                  "dense logits are allclose, not bit-equal; sampling can "
                  "flip at near-ties); drop --temperature")
+    if args.check_adapter_parity and args.adapter is None:
+        ap.error("--check-adapter-parity compares the segmented adapter "
+                 "dispatch against its merged-weight oracle; pass "
+                 "--adapter RANK[:SEED[:SCALE]]")
+    if args.check_adapter_parity and args.temperature != 0.0:
+        ap.error("--check-adapter-parity is a greedy-only gate (segmented "
+                 "vs merged logits are allclose, not bit-equal; sampling "
+                 "can flip at near-ties); drop --temperature")
+    if args.check_weight_parity and args.adapter is not None:
+        ap.error("--check-weight-parity's reference engine is "
+                 "adapter-less; run it without --adapter (adapter "
+                 "correctness has its own gate, --check-adapter-parity)")
+    adapter_rank, adapter_seed, adapter_scale = 0, 0, None
+    if args.adapter is not None:
+        from picotron_tpu.inference import tenancy as _tenancy
+
+        parts = str(args.adapter).split(":")
+        try:
+            adapter_rank = int(parts[0])
+            adapter_seed = int(parts[1]) if len(parts) > 1 else 0
+            adapter_scale = (float(parts[2]) if len(parts) > 2
+                             else _tenancy.DEFAULT_ADAPTER_SCALE)
+        except ValueError as e:
+            ap.error(f"bad --adapter spec {args.adapter!r} "
+                     f"(want RANK[:SEED[:SCALE]]): {e}")
+        if adapter_rank < 1:
+            ap.error("--adapter rank must be >= 1")
     if args.check_layout_parity and cfg.inference.kv_page_policy != "uniform":
         # checked on the EFFECTIVE config (flag or config file): mixed
         # pages quantize cold tails, so contiguous-vs-paged would be
@@ -241,14 +284,25 @@ def main(argv=None) -> int:
         ap.error("--check-layout-parity needs kv_page_policy 'uniform' "
                  "(hot_bf16 int8 tails make parity allclose, not exact)")
     t0 = time.perf_counter()
+    adapters = adapter_leaves = None
+    if args.adapter is not None:
+        adapters = _tenancy.AdapterPack(cfg.model, slots=2,
+                                        rank=adapter_rank)
+        adapter_leaves = adapters.random_leaves(
+            adapter_rank, adapter_seed, adapter_scale)
+        adapters.set_slot(1, adapter_leaves)
     engine = InferenceEngine(cfg, slots=args.slots,
                              max_seq_len=args.max_seq_len,
                              decode_block_len=args.decode_block_len,
                              prefill_chunk=args.prefill_chunk,
                              spec_len=args.spec_len,
-                             spec_ngram=args.spec_ngram)
+                             spec_ngram=args.spec_ngram,
+                             adapters=adapters)
     params = _load_weights(args, cfg, engine)
     requests = _build_requests(args, tokenizer)
+    if adapters is not None:
+        for r in requests:
+            r.adapter_slot = 1
     setup_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
@@ -288,6 +342,44 @@ def main(argv=None) -> int:
             return 1
         print(f"weight parity: int8 == fake-quant reference for "
               f"{len(results)} requests")
+
+    if args.check_adapter_parity:
+        # same batch, same seed, an ADAPTER-LESS dense engine fed the
+        # merged tree W + A @ B (llama.merge_adapter): every request's
+        # tokens must match exactly. The segmented gather (per-row A/B
+        # pair through the lora matmul, residual added before the tp
+        # collective) and the merged matmul compute the same values to
+        # fp32 tolerance; greedy pins the tokens. An int8 primary merges
+        # into its FAKE-QUANT dense twin — the same reference recipe as
+        # --check-weight-parity, so one run gates both the adapter path
+        # and its int8 composition.
+        import jax.numpy as jnp
+
+        from picotron_tpu.models import llama
+
+        eng2 = InferenceEngine(cfg, slots=args.slots,
+                               max_seq_len=args.max_seq_len,
+                               decode_block_len=args.decode_block_len,
+                               prefill_chunk=args.prefill_chunk,
+                               spec_len=args.spec_len,
+                               spec_ngram=args.spec_ngram,
+                               weight_dtype="bf16")
+        dense = _load_weights(args, cfg, eng2)
+        if engine.weight_dtype == "int8":
+            dense = llama.dequantize_params(
+                llama.quantize_params(dense), jnp.dtype(cfg.model.dtype))
+        merged = llama.merge_adapter(dense, adapter_leaves)
+        results2 = ContinuousBatcher(
+            eng2, eng2.shard_params(merged), seed=args.seed,
+        ).run(_build_requests(args, tokenizer))
+        bad = [u for u in results if results[u].tokens != results2[u].tokens]
+        if bad:
+            print(f"FAILED: adapter parity mismatch (segmented vs "
+                  f"merged-weight reference) for {bad}", file=sys.stderr)
+            return 1
+        print(f"adapter parity: segmented adapter == merged-weight "
+              f"reference for {len(results)} requests "
+              f"(rank={adapter_rank}, weights={engine.weight_dtype})")
 
     if args.check_layout_parity:
         # same batch, same seed/weights, the OTHER cache layout: every

@@ -127,14 +127,21 @@ class _Stopped(Exception):
 # --------------------------------------------------------------------------- #
 
 
-def prefix_key(prompt, page_len: int) -> Optional[str]:
+def prefix_key(prompt, page_len: int,
+               tenant: str = "") -> Optional[str]:
     """Affinity key: hash of the longest page-aligned prompt prefix, or
     None when the prompt holds no whole page (nothing the radix cache
-    could share — pure least-loaded placement)."""
+    could share — pure least-loaded placement). ``tenant`` salts the
+    key exactly as it salts the replica-side radix domains
+    (inference/tenancy.py): identical prompts under different tenants
+    share no pages, so they must not share an affinity owner's cache
+    bank either. Anonymous/base traffic ("") hashes as before."""
     n = (len(prompt) // page_len) * page_len
     if n <= 0:
         return None
     raw = ",".join(str(int(t)) for t in prompt[:n]).encode()
+    if tenant:
+        raw = tenant.encode() + b"|" + raw
     return hashlib.blake2b(raw, digest_size=8).hexdigest()
 
 
@@ -144,6 +151,35 @@ def _rendezvous(key: str, name: str) -> int:
     disruption when the replica set changes."""
     h = hashlib.blake2b(f"{key}|{name}".encode(), digest_size=8)
     return int.from_bytes(h.digest(), "big")
+
+
+def tenant_scrape(prom: dict) -> dict:
+    """Per-tenant load surfaced by one /metrics scrape: {tenant:
+    {"queue_depth", "active_slots", "ttft_p95"}} off the labeled
+    ``picotron_tenant_*`` families (tenancy-less replicas export none —
+    an empty dict, and placement scores exactly as before)."""
+    import re
+
+    tenants = set()
+    for k in prom:
+        if k.startswith(("picotron_tenant_queue_depth{",
+                         "picotron_tenant_ttft_seconds_count{")):
+            m = re.search(r'tenant="([^"]*)"', k)
+            if m:
+                tenants.add(m.group(1))
+    out = {}
+    for t in sorted(tenants):
+        label = f'tenant="{t}"'
+        sub = {k: v for k, v in prom.items() if label in k}
+        out[t] = {
+            "queue_depth": sub.get(
+                f"picotron_tenant_queue_depth{{{label}}}", 0.0),
+            "active_slots": sub.get(
+                f"picotron_tenant_active_slots{{{label}}}", 0.0),
+            "ttft_p95": hist_quantile(
+                sub, "picotron_tenant_ttft_seconds", 0.95),
+        }
+    return out
 
 
 def hist_quantile(prom: dict, name: str, q: float) -> float:
@@ -428,6 +464,11 @@ class Router:
                         "picotron_kv_pool_utilization", 0.0),
                     "ttft_p95": hist_quantile(
                         prom, "picotron_ttft_seconds", 0.95),
+                    # per-tenant load (empty on tenancy-less replicas):
+                    # placement adds the REQUESTING tenant's TTFT p95 on
+                    # each candidate, steering an SLO tenant away from
+                    # the replica that is slow for IT specifically
+                    "tenants": tenant_scrape(prom),
                 }
         except ReplicaFailure:
             scrape = None
@@ -554,19 +595,30 @@ class Router:
 
     # ---- placement --------------------------------------------------------
 
-    def _load(self, rep: Replica) -> float:
+    def _load(self, rep: Replica, tenant: str = "") -> float:
         """Load score under ``rep._mu`` (caller holds it): scraped queue
         depth + the router's own in-flight placements (fresher than any
-        scrape), active slots, pool occupancy, TTFT p95."""
+        scrape), active slots, pool occupancy, TTFT p95 — plus, for a
+        named tenant, THAT tenant's scraped TTFT p95 on this replica
+        (picotron_tenant_ttft_seconds): fleet-wide health can hide one
+        replica serving one tenant badly (its adapter contending with a
+        heavy co-tenant), and the per-tenant term is what routes around
+        it."""
         c = self.cfg
         s = rep.scrape
-        return (c.load_queue_weight * (s.get("queue_depth", 0.0)
+        load = (c.load_queue_weight * (s.get("queue_depth", 0.0)
                                        + rep.inflight)
                 + c.load_slot_weight * s.get("active_slots", 0.0)
                 + c.load_pool_weight * s.get("pool_utilization", 0.0)
                 + c.load_ttft_weight * s.get("ttft_p95", 0.0))
+        if tenant:
+            ts = s.get("tenants", {}).get(tenant)
+            if ts:
+                load += c.load_ttft_weight * ts.get("ttft_p95", 0.0)
+        return load
 
-    def _candidates(self, excluded=(), kind: str = "decode") -> list:
+    def _candidates(self, excluded=(), kind: str = "decode",
+                    tenant: str = "") -> list:
         """[(replica, load)] of currently placeable replicas for ``kind``
         of work: "decode" (the /generate path — prefill-only replicas are
         NOT candidates, they would otherwise score as idle decode
@@ -591,18 +643,19 @@ class Router:
                     continue
                 if now - rep.scrape_t > self.cfg.scrape_stale_s:
                     continue  # unknown load is unplaceable load
-                out.append((rep, self._load(rep)))
+                out.append((rep, self._load(rep, tenant)))
         return out
 
     def _eligible(self) -> list:
         return [rep for rep, _ in self._candidates()]
 
-    def _affinity_owner(self, prompt) -> Optional[Replica]:
+    def _affinity_owner(self, prompt,
+                        tenant: str = "") -> Optional[Replica]:
         """The rendezvous-top decode candidate for ``prompt``'s prefix
         key (load ignored): the replica whose radix cache accumulates
         this prefix under affinity placement — the cross-replica lookup's
         source of truth. None for page-less prompts or an empty set."""
-        key = prefix_key(prompt, self.cfg.affinity_page_len)
+        key = prefix_key(prompt, self.cfg.affinity_page_len, tenant)
         if key is None:
             return None
         cands = self._candidates()
@@ -611,15 +664,15 @@ class Router:
         return max((rep for rep, _ in cands),
                    key=lambda rep: _rendezvous(key, rep.name))
 
-    def place(self, prompt, excluded=(),
-              kind: str = "decode") -> Optional[Replica]:
+    def place(self, prompt, excluded=(), kind: str = "decode",
+              tenant: str = "") -> Optional[Replica]:
         """Pick a replica for ``prompt`` (None when nothing is eligible):
         the rendezvous affinity pick while it is within
         ``affinity_load_slack`` of the least-loaded candidate, else
         least-loaded. Reserves an inflight slot (and the half-open trial
         token) on the pick."""
-        cands = self._candidates(excluded, kind=kind)
-        key = prefix_key(prompt, self.cfg.affinity_page_len)
+        cands = self._candidates(excluded, kind=kind, tenant=tenant)
+        key = prefix_key(prompt, self.cfg.affinity_page_len, tenant)
         while cands:
             best = min(load for _, load in cands)
             pick = None
@@ -664,14 +717,16 @@ class Router:
         replay bookkeeping's zero-delivered path). Export failures feed
         the breaker exactly like request failures; sheds are graceful."""
         tried: set = set()
+        tenant = str(spec.get("tenant") or "")
         for _ in range(self.cfg.place_attempts):
-            rep = self.place(prompt, excluded=tried, kind="prefill")
+            rep = self.place(prompt, excluded=tried, kind="prefill",
+                             tenant=tenant)
             if rep is None:
                 break
             sub = {"prompt": prompt, "request_id": rid,
                    "uid": f"{rid}.pf{len(tried) + 1}"}
             for k in ("temperature", "top_k", "top_p", "eos_id",
-                      "timeout_s"):
+                      "timeout_s", "tenant"):
                 if k in spec:
                     sub[k] = spec[k]
             span = tracer.begin("handoff", parent=root, request_id=rid,
@@ -740,7 +795,7 @@ class Router:
         return None
 
     def _prefix_fetch(self, owner: Replica, rep: Replica,
-                      prompt: list) -> None:
+                      prompt: list, tenant: str = "") -> None:
         """Cross-replica prefix-cache lookup: pull ``owner``'s longest
         cached page-aligned prefix of ``prompt`` and import it at
         ``rep`` — a placement that escaped its affinity owner still
@@ -750,9 +805,15 @@ class Router:
         escape would have paid anyway)."""
         outcome = "error"
         try:
+            lookup = {"ids": prompt}
+            if tenant:
+                # scope the lookup to the tenant's radix domain — a
+                # lookup must never vouch pages across the isolation
+                # boundary (the payload itself carries the tenant, so
+                # the import lands in the right domain at ``rep``)
+                lookup["tenant"] = tenant
             st, body = _post_json(owner.host, owner.port, "/kv/pages",
-                                  {"ids": prompt},
-                                  self.cfg.probe_timeout_s)
+                                  lookup, self.cfg.probe_timeout_s)
             if st != 200 or body.get("matched", 0) \
                     < self.cfg.affinity_page_len:
                 outcome = "miss"
@@ -790,6 +851,10 @@ class Router:
             raise RouteRefused(400, f"bad max_new_tokens: {e}") from e
         if max_new < 1:
             raise RouteRefused(400, "max_new_tokens must be >= 1")
+        tenant = spec.get("tenant")
+        if tenant is not None and not isinstance(tenant, str):
+            raise RouteRefused(400, "tenant must be a string")
+        tenant = tenant or ""
         t0 = self._clock()
         tracer = self.obs.tracer
         root = tracer.begin("route", request_id=rid)
@@ -823,7 +888,8 @@ class Router:
                     if len(delivered) >= max_new:
                         finish = "length"
                         break
-                rep = self.place(prompt + delivered, excluded)
+                rep = self.place(prompt + delivered, excluded,
+                                 tenant=tenant)
                 if rep is None:
                     if delivered:
                         finish = "error"  # mid-stream with no survivor
@@ -839,9 +905,9 @@ class Router:
                     # its affinity owner, pull the owner's cached prefix
                     # so the shared prefix still prefills once per cluster
                     prefix_fetched = True
-                    owner = self._affinity_owner(prompt)
+                    owner = self._affinity_owner(prompt, tenant)
                     if owner is not None and owner.name != rep.name:
-                        self._prefix_fetch(owner, rep, prompt)
+                        self._prefix_fetch(owner, rep, prompt, tenant)
                 try:
                     outcome, detail = self._attempt(
                         rep, spec, rid, attempt, prompt, delivered,
@@ -951,7 +1017,8 @@ class Router:
         sub = {"prompt": prompt + delivered,
                "max_new_tokens": max_new - len(delivered),
                "stream": True, "uid": f"{rid}.a{n}", "request_id": rid}
-        for k in ("temperature", "top_k", "top_p", "eos_id", "timeout_s"):
+        for k in ("temperature", "top_k", "top_p", "eos_id", "timeout_s",
+                  "tenant"):
             if k in spec:
                 sub[k] = spec[k]
         if kv_payload is not None:

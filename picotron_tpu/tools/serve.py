@@ -52,6 +52,18 @@ API (all bodies JSON):
   (``{"seconds", "dir"}`` optional; defaults from ``obs.profile_dir`` /
   ``obs.profile_seconds``); 409 while one is running. The CLI wires
   SIGUSR2 to the same capture.
+- ``GET /tenants`` / ``POST /tenants`` / ``DELETE /tenants/<name>`` —
+  the multi-tenant admin plane (inference/tenancy.py, docs/SERVING.md
+  "Multi-tenant serving"): list registered tenants + adapter-pack
+  occupancy, hot-add one tenant (its LoRA weights land in a free pack
+  slot — no recompile), hot-remove (the slot zeroes back to null).
+  ``/generate``'s optional ``"tenant"`` field selects the serving
+  identity; unknown names are a 400, never a silent base fallback.
+  Per-tenant quotas 429 with ``"budget": "tenant_tokens" |
+  "tenant_pages"`` in the body; global budget 429s carry ``"tokens"`` /
+  ``"pages"`` — a client backoff can tell its own quota from fleet
+  pressure. Only present when a registry is configured
+  (``inference.tenancy`` or ``--tenant-manifest``).
 - ``POST /kv/export`` / ``GET|POST /kv/pages`` / ``POST /kv/import`` —
   the prefill/decode disaggregation plane (``inference.role``,
   inference/page_transport.py, docs/SERVING.md "Disaggregated
@@ -102,13 +114,19 @@ from typing import Optional
 
 
 class AdmissionError(Exception):
-    """A request rejected at the door (shed before submission)."""
+    """A request rejected at the door (shed before submission).
+    ``extra`` rides into the JSON error body — budget rejections use it
+    to name WHICH budget tripped (``"budget": "tokens" | "pages" |
+    "tenant_tokens" | "tenant_pages"``) so a router or client backoff
+    can tell global pressure from its own quota."""
 
-    def __init__(self, status: int, reason: str, retry_after: int = 1):
+    def __init__(self, status: int, reason: str, retry_after: int = 1,
+                 **extra):
         super().__init__(reason)
         self.status = status
         self.reason = reason
         self.retry_after = retry_after
+        self.extra = extra
 
 
 class _Waiter:
@@ -141,7 +159,7 @@ class FrontEnd:
                  default_timeout_s: Optional[float] = None,
                  stall_timeout_s: float = 60.0,
                  watchdog_poll_s: float = 0.25,
-                 log=print):
+                 tenants=None, log=print):
         from picotron_tpu.inference import ContinuousBatcher
         from picotron_tpu.obs import ProfileCapture
         from picotron_tpu.resilience.preemption import PreemptionGuard
@@ -191,6 +209,15 @@ class FrontEnd:
         self.obs.registry.gauge(
             "picotron_serve_role",
             "serving role of this replica", role=self.role).set(1.0)
+        # multi-tenant registry (inference/tenancy.py, docs/SERVING.md
+        # "Multi-tenant serving"): None = single-tenant serving, every
+        # request anonymous base traffic, exactly as before. When set,
+        # requests may name a tenant ("tenant" field) — UNKNOWN names
+        # are a 400, never a silent base fallback (a typo'd tenant must
+        # not dodge its quota) — and admission becomes priority-aware:
+        # under budget pressure queued lower classes shed before a
+        # higher-class arrival 429s.
+        self.tenants = tenants
         self.draining = False
         self.stopped = threading.Event()  # dispatch loop has exited
         self.dead = False  # loop died on an exception (vs clean drain)
@@ -201,8 +228,8 @@ class FrontEnd:
         # /metrics rendering of the same numbers
         self.rejections = self.obs.registry.counter_dict(
             "picotron_rejections_total",
-            ("queue_full", "token_budget", "page_budget", "draining",
-             "stalled", "dead", "role"),
+            ("queue_full", "token_budget", "page_budget", "tenant_quota",
+             "draining", "stalled", "dead", "role"),
             help="admission sheds by reason", label="reason")
         # leaf lock for the rejection counters: the "stalled" increment
         # happens precisely when _mu could NOT be acquired, so the
@@ -289,6 +316,7 @@ class FrontEnd:
                     "kv payloads dropped as locally unusable").inc()
                 self._event("kv_dropped", why=why[:200])
                 kv = None
+        tenant, slot = self._resolve_tenant(spec.get("tenant"))
         timeout_s = spec.get("timeout_s", self.default_timeout_s)
         try:
             req = Request(
@@ -300,7 +328,14 @@ class FrontEnd:
                 top_p=float(spec.get("top_p", 1.0)),
                 eos_id=spec.get("eos_id"),
                 timeout_s=None if timeout_s is None else float(timeout_s),
-                kv_import=kv)
+                kv_import=kv,
+                tenant=self._tenant_salt(tenant),
+                priority=tenant.priority if tenant is not None else 1,
+                adapter_slot=slot,
+                ttft_slo_ms=(tenant.ttft_slo_ms if tenant is not None
+                             else None),
+                tpot_slo_ms=(tenant.tpot_slo_ms if tenant is not None
+                             else None))
         except (TypeError, ValueError) as e:
             raise AdmissionError(400, f"bad request field: {e}",
                                  retry_after=0)
@@ -343,11 +378,52 @@ class FrontEnd:
                 raise AdmissionError(
                     503, f"wait queue full ({self.max_queue})",
                     retry_after=max(1, self.max_queue // 8))
+            # per-tenant quotas FIRST: a tenant over its own ceiling is
+            # ITS problem — it never triggers lower-class shedding, and
+            # the 429 body names the tenant budget so its backoff does
+            # not read as global pressure (Retry-After scales to the
+            # tenant's own deficit, the PR 7 page-deficit pattern).
+            if tenant is not None and tenant.max_tokens is not None:
+                tload = self._batcher.tenant_token_load(req.tenant)
+                if tload + cost > tenant.max_tokens:
+                    deficit = tload + cost - tenant.max_tokens
+                    self._reject("tenant_quota")
+                    raise AdmissionError(
+                        429,
+                        f"tenant {tenant.name!r} token quota exhausted "
+                        f"({tenant.max_tokens})",
+                        retry_after=min(30, 1 + deficit
+                                        // max(1, tenant.max_tokens // 4)),
+                        budget="tenant_tokens", tenant=tenant.name)
+            if (tenant is not None and tenant.max_pages is not None
+                    and self.engine.paged is not None):
+                pneed = self._batcher.page_commitment(req)
+                pload = self._batcher.tenant_page_load(req.tenant)
+                if pload + pneed > tenant.max_pages:
+                    deficit = pload + pneed - tenant.max_pages
+                    self._reject("tenant_quota")
+                    raise AdmissionError(
+                        429,
+                        f"tenant {tenant.name!r} page quota exhausted "
+                        f"({tenant.max_pages})",
+                        retry_after=min(30, 1 + deficit
+                                        // max(1, tenant.max_pages // 4)),
+                        budget="tenant_pages", tenant=tenant.name)
             if self._batcher.token_load() + cost > self.token_budget:
-                self._reject("token_budget")
-                raise AdmissionError(
-                    429, f"token budget exhausted ({self.token_budget})",
-                    retry_after=1)
+                # before 429ing, a positive-class arrival sheds QUEUED
+                # strictly-lower-class work (lowest class first) until
+                # its commitment fits — priority is meaningless if a
+                # full budget holds classes equal
+                deficit = (self._batcher.token_load() + cost
+                           - self.token_budget)
+                if req.priority > 0:
+                    self._batcher.shed_lower_priority(req.priority,
+                                                      tokens=deficit)
+                if self._batcher.token_load() + cost > self.token_budget:
+                    self._reject("token_budget")
+                    raise AdmissionError(
+                        429, f"token budget exhausted ({self.token_budget})",
+                        retry_after=1, budget="tokens")
             if self.engine.paged is not None:
                 # paged layout: price in POOL PAGES, not contiguous
                 # strips — ceil(commitment / page_len) against the pool,
@@ -356,6 +432,10 @@ class FrontEnd:
                 need = self._batcher.page_commitment(req)
                 usable = self.engine.paged.usable_pages
                 load = self._batcher.page_load()
+                if load + need > usable and req.priority > 0:
+                    self._batcher.shed_lower_priority(
+                        req.priority, pages=load + need - usable)
+                    load = self._batcher.page_load()
                 if load + need > usable:
                     deficit = load + need - usable
                     self._reject("page_budget")
@@ -364,7 +444,8 @@ class FrontEnd:
                         f"kv page pool exhausted (need {need} of "
                         f"{usable - min(load, usable)} pages free)",
                         retry_after=min(30, 1 + deficit
-                                        // max(1, usable // 4)))
+                                        // max(1, usable // 4)),
+                        budget="pages")
             if req.uid in self._waiters:
                 raise AdmissionError(400, f"duplicate uid {req.uid!r}",
                                      retry_after=0)
@@ -394,6 +475,93 @@ class FrontEnd:
             self._uid_seq += 1
             return f"r{self._uid_seq}"
 
+    # ---- multi-tenant serving (inference/tenancy.py) -----------------------
+
+    def _resolve_tenant(self, name) -> tuple:
+        """(Tenant, adapter slot) for a request's ``tenant`` field, or
+        (None, 0) for anonymous traffic on a single-tenant server.
+        Unknown names are a 400 — never a silent base fallback."""
+        if name is not None and not isinstance(name, str):
+            raise AdmissionError(400, "tenant must be a string",
+                                 retry_after=0)
+        if self.tenants is None:
+            if name:
+                raise AdmissionError(
+                    400, f"no tenant registry configured (got tenant "
+                         f"{name!r}; set inference.tenancy or "
+                         f"--tenant-manifest)", retry_after=0)
+            return None, 0
+        try:
+            return self.tenants.resolve(name)
+        except KeyError:
+            raise AdmissionError(
+                400, f"unknown tenant {name!r} (register via POST "
+                     f"/tenants)", retry_after=0)
+
+    @staticmethod
+    def _tenant_salt(tenant) -> str:
+        """The cache-isolation key a tenant stamps on radix subtrees and
+        transport chunks. The base identity salts as "" — anonymous
+        traffic keeps sharing the pre-tenancy default domain."""
+        from picotron_tpu.inference.tenancy import BASE_TENANT
+
+        if tenant is None or tenant.name == BASE_TENANT:
+            return ""
+        return tenant.name
+
+    def tenants_snapshot(self) -> dict:
+        """GET /tenants: every registered tenant + pack occupancy."""
+        if self.tenants is None:
+            raise AdmissionError(400, "no tenant registry configured",
+                                 retry_after=0)
+        out = {"tenants": self.tenants.snapshot()}
+        pack = self.tenants.pack
+        if pack is not None:
+            out["pack"] = {"slots": pack.slots, "rank": pack.rank,
+                           "version": pack.version,
+                           "adapter_bytes_per_token":
+                               pack.bytes_per_token()}
+        return out
+
+    def tenants_add(self, spec: dict) -> dict:
+        """POST /tenants: hot-register one tenant (adapter weights land
+        in a free pack slot; the next dispatch re-places the pack — no
+        recompile, shapes are capacity-static)."""
+        from picotron_tpu.inference.tenancy import Tenant
+
+        if self.tenants is None:
+            raise AdmissionError(
+                400, "no tenant registry configured (start with "
+                     "inference.tenancy or --tenant-manifest)",
+                retry_after=0)
+        try:
+            tenant = Tenant.from_dict(spec)
+            slot = self.tenants.add(tenant)
+        except (TypeError, ValueError) as e:
+            # duplicate names and a full pack are conflicts with current
+            # state (retryable after a remove), not malformed requests —
+            # but Tenant.from_dict's shape errors are; 409 covers both
+            # without parsing messages, and the body says which
+            raise AdmissionError(409, str(e), retry_after=0)
+        self._event("tenant_add", tenant=tenant.name, slot=slot,
+                    priority=tenant.priority, rank=tenant.adapter_rank)
+        return {"ok": True, "tenant": tenant.name, "adapter_slot": slot}
+
+    def tenants_remove(self, name: str) -> dict:
+        """DELETE /tenants/<name>: hot-deregister. The slot zeroes back
+        to null, so in-flight rows degrade to base output — never to
+        another tenant's adapter."""
+        if self.tenants is None:
+            raise AdmissionError(400, "no tenant registry configured",
+                                 retry_after=0)
+        try:
+            self.tenants.remove(name)
+        except KeyError:
+            raise AdmissionError(404, f"no tenant {name!r}",
+                                 retry_after=0)
+        self._event("tenant_remove", tenant=name)
+        return {"ok": True, "tenant": name}
+
     # ---- KV-page transport (prefill/decode disaggregation) ----------------
 
     def _require_paged(self) -> None:
@@ -417,6 +585,11 @@ class FrontEnd:
                 retry_after=5)
         self._require_paged()
         prompt = spec.get("prompt")
+        # the tenant salts the exported chunk keys exactly as it salts
+        # the radix domain the prefill lands in (resolved/validated again
+        # inside submit; this call only needs the canonical salt)
+        salt = self._tenant_salt(self._resolve_tenant(
+            spec.get("tenant"))[0])
         sub = dict(spec)
         sub["max_new_tokens"] = 1
         sub.pop("stream", None)
@@ -440,7 +613,8 @@ class FrontEnd:
                                       "unavailable)", retry_after=10)
         try:
             payload = self._batcher.export_prefix(prompt,
-                                                  first_token=first)
+                                                  first_token=first,
+                                                  tenant=salt)
         finally:
             self._mu.release()
         self._event("kv_export", uid=uid, tokens=len(payload["token_ids"]),
@@ -480,12 +654,15 @@ class FrontEnd:
         self._event("kv_import", **info)
         return info
 
-    def kv_pages(self, ids) -> dict:
+    def kv_pages(self, ids, tenant=None) -> dict:
         """GET/POST /kv/pages: the cross-replica prefix LOOKUP — the
         longest radix-cached prefix of ``ids`` as a transport payload
         (no first token: a lookup vouches for pages, not logits).
-        ``matched`` 0 = miss (an empty payload, nothing to import)."""
+        ``matched`` 0 = miss (an empty payload, nothing to import).
+        ``tenant`` scopes the lookup to that tenant's radix domain — a
+        lookup must never vouch pages across the isolation boundary."""
         self._require_paged()
+        salt = self._tenant_salt(self._resolve_tenant(tenant)[0])
         if (not isinstance(ids, list) or not ids
                 or not all(isinstance(t, int) for t in ids)):
             raise AdmissionError(400, "ids must be a non-empty list of "
@@ -494,7 +671,7 @@ class FrontEnd:
             raise AdmissionError(503, "dispatch stalled (lookup "
                                       "unavailable)", retry_after=10)
         try:
-            payload = self._batcher.export_prefix(ids)
+            payload = self._batcher.export_prefix(ids, tenant=salt)
         finally:
             self._mu.release()
         return {"matched": len(payload["token_ids"]), "kv": payload}
@@ -653,6 +830,8 @@ class FrontEnd:
         d["weight_bytes"] = self.weight_bytes
         d["weight_dtype"] = self.engine.weight_dtype
         d["role"] = self.role
+        if self.tenants is not None:
+            d["tenant_names"] = self.tenants.names()
         d["draining"] = self.draining
         d["dead"] = self.dead
         d["stalled"] = self.stalled
@@ -723,9 +902,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/tracez":
             self._json(200, f.trace_json())
         elif self.path.startswith("/kv/pages"):
-            # GET /kv/pages?ids=1,2,3 — the lookup surface for short
-            # prompts and manual inspection (POST takes a JSON body for
-            # long ones)
+            # GET /kv/pages?ids=1,2,3[&tenant=name] — the lookup surface
+            # for short prompts and manual inspection (POST takes a JSON
+            # body for long ones)
             from urllib.parse import parse_qs, urlparse
 
             q = parse_qs(urlparse(self.path).query)
@@ -736,11 +915,31 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(400, {"error": f"bad ids: {e}"})
                 return
             try:
-                self._json(200, f.kv_pages(ids))
+                self._json(200, f.kv_pages(
+                    ids, tenant=q.get("tenant", [None])[0]))
             except AdmissionError as e:
-                self._json(e.status, {"error": e.reason})
+                self._json(e.status, {"error": e.reason, **e.extra})
+        elif self.path == "/tenants":
+            try:
+                self._json(200, f.tenants_snapshot())
+            except AdmissionError as e:
+                self._json(e.status, {"error": e.reason, **e.extra})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
+
+    def do_DELETE(self) -> None:
+        # DELETE /tenants/<name> — hot tenant removal (the admin half of
+        # POST /tenants); in-flight rows degrade to base output
+        if not self.path.startswith("/tenants/"):
+            self._json(404, {"error": f"unknown path {self.path}"})
+            return
+        from urllib.parse import unquote
+
+        name = unquote(self.path[len("/tenants/"):])
+        try:
+            self._json(200, self.front.tenants_remove(name))
+        except AdmissionError as e:
+            self._json(e.status, {"error": e.reason, **e.extra})
 
     def _profilez(self, spec: dict) -> None:
         f = self.front
@@ -762,7 +961,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:
         if self.path not in ("/generate", "/profilez", "/kv/export",
-                             "/kv/import", "/kv/pages"):
+                             "/kv/import", "/kv/pages", "/tenants"):
             self._json(404, {"error": f"unknown path {self.path}"})
             return
         try:
@@ -792,18 +991,23 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/profilez":
             self._profilez(spec)
             return
-        if self.path in ("/kv/export", "/kv/import", "/kv/pages"):
+        if self.path in ("/kv/export", "/kv/import", "/kv/pages",
+                         "/tenants"):
             try:
                 if self.path == "/kv/export":
                     out = self.front.kv_export(spec)
                 elif self.path == "/kv/import":
                     out = self.front.kv_import(spec.get("kv") or spec)
+                elif self.path == "/tenants":
+                    out = self.front.tenants_add(spec)
                 else:
-                    out = self.front.kv_pages(spec.get("ids"))
+                    out = self.front.kv_pages(spec.get("ids"),
+                                              tenant=spec.get("tenant"))
             except AdmissionError as e:
                 headers = ([("Retry-After", str(e.retry_after))]
                            if e.retry_after else [])
-                self._json(e.status, {"error": e.reason}, headers)
+                self._json(e.status, {"error": e.reason, **e.extra},
+                           headers)
                 return
             self._json(200, out)
             return
@@ -812,7 +1016,8 @@ class _Handler(BaseHTTPRequestHandler):
         except AdmissionError as e:
             headers = ([("Retry-After", str(e.retry_after))]
                        if e.retry_after else [])
-            self._json(e.status, {"error": e.reason, "shed": True}, headers)
+            self._json(e.status, {"error": e.reason, "shed": True,
+                                  **e.extra}, headers)
             return
         # client-supplied correlation id, echoed on every response row
         # (falling back to the server uid): the observable a router's
@@ -938,10 +1143,37 @@ def _build_engine_and_params(args):
 
     chaos = ServingChaos(cfg.resilience)
     hooks = chaos if chaos.active else None
+    adapters, registry = _build_tenancy(cfg, args)
     engine = InferenceEngine(cfg, slots=args.slots,
-                             max_seq_len=args.max_seq_len, hooks=hooks)
+                             max_seq_len=args.max_seq_len, hooks=hooks,
+                             adapters=adapters)
     params = _load_weights(args, cfg, engine)
-    return cfg, engine, params
+    return cfg, engine, params, registry
+
+
+def _build_tenancy(cfg, args):
+    """(AdapterPack, TenantRegistry) from inference.tenancy + the
+    --tenant-manifest override, or (None, None) when no tenancy is
+    configured (the bit-pinned single-tenant default: no pack, so the
+    compiled programs are byte-identical to the pre-tenancy engine).
+    The pack is built whenever a registry is — even all-rank-0 tenants
+    may hot-add an adapter tenant later, and capacity must exist from
+    the start (add/remove never recompiles)."""
+    tcfg = cfg.inference.tenancy
+    manifest = getattr(args, "tenant_manifest", "") or tcfg.manifest
+    if not manifest and not tcfg.tenants:
+        return None, None
+    from picotron_tpu.inference import tenancy
+
+    pack = tenancy.AdapterPack(cfg.model, slots=tcfg.adapter_slots,
+                               rank=tcfg.adapter_rank)
+    if manifest:
+        registry = tenancy.TenantRegistry.from_manifest(manifest, pack)
+    else:
+        registry = tenancy.TenantRegistry(pack)
+    for entry in tcfg.tenants:  # config extends (or replaces) a manifest
+        registry.add(tenancy.Tenant.from_dict(entry))
+    return pack, registry
 
 
 def _post(port: int, spec: dict, stream: bool = False):
@@ -1138,6 +1370,12 @@ def main(argv=None) -> int:
     ap.add_argument("--stall-timeout", type=float, default=60.0,
                     help="dispatch-stall watchdog threshold (0 = off); a "
                          "stall flips /healthz to 503")
+    ap.add_argument("--tenant-manifest", default="",
+                    help="JSON tenant manifest ({\"tenants\": [...]}, "
+                         "inference/tenancy.py) — overrides "
+                         "inference.tenancy.manifest; enables the "
+                         "multi-tenant plane (adapter pack, /tenants "
+                         "admin endpoint, per-tenant quotas/SLOs)")
     ap.add_argument("--smoke", action="store_true",
                     help="built-in tiny CPU model + scripted client drive "
                          "(the `make serve-smoke` target)")
@@ -1148,14 +1386,14 @@ def main(argv=None) -> int:
                          "tools/trace_dump.py)")
     args = ap.parse_args(argv)
 
-    cfg, engine, params = _build_engine_and_params(args)
+    cfg, engine, params, registry = _build_engine_and_params(args)
 
     server = Server(
         engine, params, host=args.host,
         port=0 if args.smoke else args.port, seed=args.seed,
         max_queue=args.max_queue, token_budget=args.token_budget,
         default_timeout_s=args.default_timeout_s,
-        stall_timeout_s=args.stall_timeout)
+        stall_timeout_s=args.stall_timeout, tenants=registry)
     # SIGTERM/SIGINT -> graceful drain (the PreemptionGuard pattern: first
     # signal is cooperative, second aborts). SIGUSR2 -> one timed
     # jax.profiler capture into obs.profile_dir (the POST /profilez
@@ -1171,7 +1409,8 @@ def main(argv=None) -> int:
         token_budget=server.front.token_budget,
         attend_impl=engine.attend_impl, role=server.front.role,
         kv=str(engine.cache_dtype), kv_layout=engine.kv_layout,
-        tp=engine.topo.tp_size)
+        tp=engine.topo.tp_size,
+        tenants=(registry.names() if registry is not None else None))
 
     if args.smoke:
         rc = _smoke(server, obs_dump=args.obs_dump)

@@ -448,6 +448,80 @@ def test_j005_negative_paired_double_buffer_idiom(tmp_path):
     assert found == []
 
 
+def test_j003_segmented_gather_adapter_walk(tmp_path):
+    """The ISSUE-16 segmented multi-LoRA matmul shape
+    (ops/pallas/lora_matmul.py): a per-row grid whose A/B blocks are
+    steered by a scalar-prefetch adapter-id vector. The shipped form
+    resolves the row id via the BlockSpec index maps — the kernel body
+    never reads program_id at all — and an in-body rank-chunk walk that
+    re-reads program_id per iteration to re-derive the adapter row is
+    the J003 hazard. Precision both ways keeps the baseline empty."""
+    found = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(ids_ref, x_ref, a_ref, b_ref, o_ref):
+            def body(j, acc):
+                bi = pl.program_id(0)  # the trap: the index maps own this
+                t = ids_ref[bi]
+                ab = a_ref[t, pl.ds(j * 8, 8), :]
+                return acc + x_ref[0, :, pl.ds(j * 8, 8)] @ ab
+            o_ref[0] = lax.fori_loop(0, 4, body, 0.0) @ b_ref[0]
+        """)
+    assert _rules(found) == ["PICO-J003"]
+
+    clean = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def kernel(ids_ref, x_ref, a_ref, b_ref, o_ref):
+            def body(j, acc):
+                ab = a_ref[0, pl.ds(j * 8, 8), :]
+                return acc + x_ref[0, :, pl.ds(j * 8, 8)] @ ab
+            t = lax.fori_loop(0, 4, body, 0.0)
+            o_ref[0] = t @ b_ref[0]
+        """, name="fix_clean.py")
+    assert clean == []
+
+
+def test_j005_segmented_gather_hand_rolled_dma(tmp_path):
+    """The hand-rolled variant lora_matmul.py avoids: DMA-ing each row's
+    chosen adapter pair into VMEM scratch inside a per-row loop. A
+    per-iteration start whose only wait sits after the loop is the J005
+    hazard; the paired in-body start+wait (serial gather) stays silent —
+    the shipped kernel needs neither because scalar-prefetch index maps
+    do the steering."""
+    found = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(ids_ref, pack_ref, buf, sem, o_ref):
+            def body(j, acc):
+                pltpu.make_async_copy(pack_ref.at[ids_ref[j]], buf,
+                                      sem).start()
+                return acc + buf[0]
+            acc = lax.fori_loop(0, 4, body, 0.0)
+            pltpu.make_async_copy(pack_ref.at[0], buf, sem).wait()
+            o_ref[0] = acc
+        """)
+    assert _rules(found) == ["PICO-J005"]
+
+    clean = _scan(tmp_path, """
+        from jax import lax
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(ids_ref, pack_ref, buf, sem, o_ref):
+            def body(j, acc):
+                dma = pltpu.make_async_copy(pack_ref.at[ids_ref[j]], buf,
+                                            sem)
+                dma.start()
+                dma.wait()
+                return acc + buf[0]
+            o_ref[0] = lax.fori_loop(0, 4, body, 0.0)
+        """, name="fix_clean.py")
+    assert clean == []
+
+
 def test_j005_negative_thread_start_and_serial_pair(tmp_path):
     # receiver typing: thread.start()/event.wait() are not DMAs; a serial
     # in-body start+wait pair is the pre-pipelining idiom and stays silent

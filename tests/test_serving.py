@@ -362,7 +362,8 @@ def test_http_generate_stream_health_and_stats():
         assert stats["completed"] == stats["admitted"] == 2
         assert stats["rejected"] == {"queue_full": 0, "token_budget": 0,
                                      "page_budget": 0, "draining": 0,
-                                     "stalled": 0, "dead": 0, "role": 0}
+                                     "stalled": 0, "dead": 0, "role": 0,
+                                     "tenant_quota": 0}
         assert not stats["draining"] and not stats["stalled"]
     finally:
         srv.drain_and_join(timeout=60)

@@ -1,0 +1,558 @@
+"""Multi-tenant serving (ISSUE 16): the segmented multi-LoRA matmul, the
+tenant registry/adapter pack, and the tenancy plumbing through the
+batcher, the serve front end, and the KV reuse planes.
+
+The acceptance surface:
+
+- kernel-level parity: the Pallas segmented-gather kernel (interpret
+  mode — the CPU tier-1 gate) and the XLA gather-einsum fallback are
+  both exact against the per-row reference ``(x[b] @ a[ids[b]]) @
+  b[ids[b]]``, and null-adapter rows (slot 0) produce an EXACTLY zero
+  residual;
+- engine-level equivalence: a MIXED batch (3 adapters + base-only rows
+  in one dispatch) produces, per tenant, greedy tokens bit-identical to
+  a solo adapter-less engine fed the merged-weight ``W + BA`` reference
+  — across decode_block / speculative verify / chunked prefill,
+  dense AND flash attends, contiguous AND paged KV layouts, bf16-dense
+  AND int8 bases, tp=1 and tp=2 (the int8 oracle merges into the
+  fake-quant dense twin, mirroring the weight-parity gate);
+- the null-adapter identity: an engine CARRYING a live adapter pack
+  serves base-only rows bit-identical to an engine built without one;
+- isolation: tenant names salt the radix prefix domains — identical
+  prompts under different tenants never share pages;
+- scheduling: priority classes admit highest-first and shed
+  lowest-first under budget pressure, TTFT-SLO requests jump their
+  class's queue, and a TPOT-SLO slot over budget halves its draft width
+  (``slo_cap``);
+- the HTTP surface: unknown tenants 400 (never a silent base fallback),
+  ``/tenants`` hot add/remove, per-tenant quota 429s naming the tripped
+  budget.
+
+``make tenant-smoke`` runs the CLI gate (generate.py
+--check-adapter-parity) + the mixed-tenant bench on top of this file.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_config
+from picotron_tpu.inference import ContinuousBatcher, InferenceEngine, Request
+from picotron_tpu.inference import tenancy
+from picotron_tpu.inference.paged_kv import RadixCache
+from picotron_tpu.config import SpecControllerConfig
+from picotron_tpu.inference.speculative import SpecController
+from picotron_tpu.models import llama
+from picotron_tpu.obs.metrics import MetricsRegistry
+from picotron_tpu.ops.pallas import lora_matmul as lm
+
+MAX_LEN = 96
+
+
+# --------------------------------------------------------------------------- #
+# kernel parity (direct calls)
+# --------------------------------------------------------------------------- #
+
+
+def _reference(x, a, b, ids):
+    out = np.zeros(x.shape[:2] + (b.shape[2],), np.float32)
+    xf = np.asarray(x, np.float32)
+    for i, t in enumerate(ids):
+        out[i] = (xf[i] @ np.asarray(a)[t]) @ np.asarray(b)[t]
+    return out
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("B,S,K,N,r", [(4, 1, 32, 48, 8), (3, 5, 64, 40, 4),
+                                       (2, 16, 48, 64, 16)])
+def test_lora_matmul_impls_match_reference(B, S, K, N, r, dtype):
+    """Pallas (interpret) and the XLA fallback against the per-row
+    gather reference: decode (S=1), verify (small S), prefill-chunk
+    (larger S) shapes, repeated and out-of-order ids, a null row in
+    every batch."""
+    rng = np.random.default_rng(0)
+    T = 4
+    x = jnp.asarray(rng.normal(size=(B, S, K)).astype(np.float32)).astype(
+        jnp.dtype(dtype))
+    a = jnp.asarray(rng.normal(size=(T, K, r)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(T, r, N)).astype(np.float32))
+    a = a.at[0].set(0.0)  # slot 0 = the null adapter
+    b = b.at[0].set(0.0)
+    ids = np.array([0, 2, 1, 2][:B], np.int32)
+    ref = _reference(x, a, b, ids)
+    got_p = np.asarray(lm.lora_matmul(x, a, b, ids, interpret=True))
+    got_x = np.asarray(lm.lora_matmul(x, a, b, ids, impl="xla"))
+    tol = 2e-5 if dtype == "float32" else 2e-2
+    np.testing.assert_allclose(got_p, ref, rtol=tol, atol=tol)
+    np.testing.assert_allclose(got_x, ref, rtol=tol, atol=tol)
+    assert got_p.dtype == got_x.dtype == np.float32
+    # the null row's residual is EXACTLY zero on both impls — base-only
+    # rows riding a mixed dispatch bypass bit-exactly
+    np.testing.assert_array_equal(got_p[0], 0.0)
+    np.testing.assert_array_equal(got_x[0], 0.0)
+
+
+def test_lora_matmul_validates():
+    x = jnp.zeros((2, 1, 8))
+    a = jnp.zeros((3, 8, 4))
+    b = jnp.zeros((3, 4, 8))
+    with pytest.raises(ValueError, match=r"\[B, S, in\]"):
+        lm.lora_matmul(jnp.zeros((2, 8)), a, b, [0, 0])
+    with pytest.raises(ValueError, match="disagree"):
+        lm.lora_matmul(x, a, jnp.zeros((3, 5, 8)), [0, 0])
+    with pytest.raises(ValueError, match="impl"):
+        lm.lora_matmul(x, a, b, [0, 0], impl="dense")
+
+
+# --------------------------------------------------------------------------- #
+# AdapterPack + TenantRegistry (host side, no engine)
+# --------------------------------------------------------------------------- #
+
+
+def test_adapter_pack_capacity_version_and_null_slot(tiny_model_kwargs):
+    cfg = make_config(tiny_model_kwargs)
+    pack = tenancy.AdapterPack(cfg.model, slots=4, rank=8)
+    v0 = pack.version
+    d0 = pack.device_leaves()
+    assert pack.device_leaves() is d0  # cached until a mutation
+    leaves = pack.random_leaves(4, seed=1)  # rank 4 < capacity 8
+    pack.set_slot(1, leaves)
+    assert pack.version == v0 + 1
+    d1 = pack.device_leaves()
+    assert d1 is not d0
+    # shapes are capacity-static: rank-4 weights land in the first 4
+    # columns, the rest stay zero
+    a = np.asarray(d1["wq"]["a"])
+    assert a.shape[-1] == 8
+    assert np.any(a[:, 1, :, :4])
+    np.testing.assert_array_equal(a[:, 1, :, 4:], 0.0)
+    np.testing.assert_array_equal(a[:, 0], 0.0)  # null slot stays null
+    pack.clear_slot(1)
+    np.testing.assert_array_equal(
+        np.asarray(pack.device_leaves()["wq"]["a"][:, 1]), 0.0)
+    with pytest.raises(ValueError, match="slot 0"):
+        pack.set_slot(0, leaves)
+    with pytest.raises(ValueError, match="outside"):
+        pack.random_leaves(9, seed=0)  # rank above capacity
+    with pytest.raises(ValueError, match="adapter_slots"):
+        tenancy.AdapterPack(cfg.model, slots=1)
+    # bytes_per_token: every layer streams its [in, R] + [R, out] fp32
+    # pair for each projection leaf
+    L = cfg.model.num_hidden_layers
+    want = 4 * L * sum((din + dout) * 8
+                       for din, dout in tenancy.adapter_dims(
+                           cfg.model).values())
+    assert pack.bytes_per_token() == want
+
+
+def test_tenant_validation_registry_and_manifest(tiny_model_kwargs, tmp_path):
+    cfg = make_config(tiny_model_kwargs)
+    with pytest.raises(ValueError, match="name"):
+        tenancy.Tenant(name="a/b")
+    with pytest.raises(ValueError, match="priority"):
+        tenancy.Tenant(name="x", priority=-1)
+    with pytest.raises(ValueError, match="unknown tenant field"):
+        tenancy.Tenant.from_dict({"name": "x", "color": "red"})
+
+    pack = tenancy.AdapterPack(cfg.model, slots=3, rank=4)
+    reg = tenancy.TenantRegistry(pack)
+    assert reg.resolve(None)[1] == 0  # implicit base -> null slot
+    assert reg.resolve("")[0].name == tenancy.BASE_TENANT
+    with pytest.raises(KeyError, match="unknown tenant"):
+        reg.resolve("nope")
+    s1 = reg.add(tenancy.Tenant(name="acme", adapter_rank=2, priority=2))
+    s2 = reg.add(tenancy.Tenant(name="bulk", priority=0))  # rank 0
+    assert s1 == 1 and s2 == 0  # rank-0 tenants share the null slot
+    with pytest.raises(ValueError, match="already exists"):
+        reg.add(tenancy.Tenant(name="acme"))
+    reg.add(tenancy.Tenant(name="beta", adapter_rank=4))
+    with pytest.raises(ValueError, match="full"):
+        reg.add(tenancy.Tenant(name="gamma", adapter_rank=1))
+    reg.remove("beta")  # frees slot 2 and zeroes it
+    np.testing.assert_array_equal(
+        np.asarray(pack.device_leaves()["wq"]["a"][:, 2]), 0.0)
+    assert reg.add(tenancy.Tenant(name="gamma", adapter_rank=1)) == 2
+    with pytest.raises(KeyError):
+        reg.remove("never-was")
+
+    # manifest load; a defined "base" entry governs anonymous traffic
+    mf = tmp_path / "tenants.json"
+    mf.write_text(json.dumps({"tenants": [
+        {"name": "base", "priority": 0, "max_tokens": 7},
+        {"name": "acme", "priority": 2, "adapter_rank": 2,
+         "adapter_seed": 7, "ttft_slo_ms": 300.0},
+    ]}))
+    reg2 = tenancy.TenantRegistry.from_manifest(
+        str(mf), tenancy.AdapterPack(cfg.model, slots=3, rank=4))
+    t, slot = reg2.resolve(None)
+    assert t.max_tokens == 7 and slot == 0
+    assert reg2.resolve("acme")[0].ttft_slo_ms == 300.0
+    with pytest.raises(ValueError, match="tenants"):
+        bad = tmp_path / "bad.json"
+        bad.write_text("[]")
+        tenancy.TenantRegistry.from_manifest(str(bad))
+
+
+# --------------------------------------------------------------------------- #
+# isolation: per-tenant radix domains
+# --------------------------------------------------------------------------- #
+
+
+def test_radix_cache_salt_isolation():
+    """Identical token chunks under different salts occupy separate trie
+    domains; the default '' salt is the pre-tenancy behavior."""
+    from picotron_tpu.inference.paged_kv import PagePool
+
+    pool = PagePool(num_pages=16)
+    r = RadixCache(page_len=4, pool=pool)
+    ids = list(range(1, 13))  # three full pages
+    pa = [pool.alloc() for _ in range(3)]
+    assert r.insert(ids, lambda i: pa[i], salt="acme") == 3
+    pages, matched = r.match(ids, salt="acme")
+    assert matched == 12 and pages == pa
+    for other in ("", "bulk"):
+        pages, matched = r.match(ids, salt=other)
+        assert matched == 0 and pages == []
+    # same chunks under another salt take their OWN nodes AND pages
+    pb = [pool.alloc() for _ in range(3)]
+    assert r.insert(ids, lambda i: pb[i], salt="bulk") == 3
+    assert r.match(ids, salt="bulk")[0] == pb
+    assert r.match(ids, salt="acme")[0] == pa
+    assert not set(pa) & set(pb)  # no cross-tenant page sharing
+
+
+# --------------------------------------------------------------------------- #
+# scheduling: priority classes, SLO-aware admission, spec slo_cap
+# --------------------------------------------------------------------------- #
+
+
+def _bare_batcher(tiny_model_kwargs):
+    """A batcher whose queue/shed logic is exercised WITHOUT dispatching
+    (engine construction is cheap; compilation happens at dispatch)."""
+    cfg = make_config(tiny_model_kwargs, seq=MAX_LEN)
+    eng = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN)
+    return ContinuousBatcher(eng, params=None)
+
+
+def test_pick_priority_fifo_and_ttft_jump(tiny_model_kwargs):
+    b = _bare_batcher(tiny_model_kwargs)
+    for r in (Request("lo", [1], priority=0),
+              Request("a", [1], priority=1),
+              Request("b", [1], priority=1),
+              Request("hi", [1], priority=2),
+              Request("slo", [1], priority=1, ttft_slo_ms=100.0)):
+        b._pending.append(r)
+    assert b._pending[b._pick()].uid == "hi"  # highest class first
+    b._pending = type(b._pending)(
+        r for r in b._pending if r.uid != "hi")
+    # within class 1: the TTFT-SLO request jumps its best-effort peers
+    assert b._pending[b._pick()].uid == "slo"
+
+
+def test_shed_lower_priority_frees_lowest_class_first(tiny_model_kwargs):
+    b = _bare_batcher(tiny_model_kwargs)
+    reqs = [Request("lo1", [1, 2], max_new_tokens=10, priority=0),
+            Request("lo2", [1, 2], max_new_tokens=10, priority=0),
+            Request("mid", [1, 2], max_new_tokens=10, priority=1)]
+    for r in reqs:
+        b._pending.append(r)
+    per = b.commitment(reqs[0])
+    # demand one request's worth: only the NEWEST class-0 request sheds
+    freed_t, _ = b.shed_lower_priority(2, tokens=per)
+    assert freed_t == per
+    assert [r.uid for r in b._pending] == ["lo1", "mid"]
+    shed = b.take_results()
+    assert list(shed) == ["lo2"] and shed["lo2"].finish_reason == "shed"
+    # a class-1 arrival must NOT shed its own class
+    assert b.shed_lower_priority(1, tokens=10 * per)[0] == per
+    assert [r.uid for r in b._pending] == ["mid"]
+    # tenant load prices queued + in-flight work per tenant
+    b._pending.append(Request("t1", [1, 2], max_new_tokens=10,
+                              tenant="acme"))
+    assert b.tenant_token_load("acme") == per
+    assert b.tenant_token_load("other") == 0
+
+
+def test_spec_controller_slo_cap():
+    """A slot whose measured dispatch cadence misses its TPOT budget
+    halves its draft width immediately (decision 'slo_cap'); without an
+    SLO the same latencies change nothing."""
+    reg = MetricsRegistry()
+    h = reg.histogram("picotron_dispatch_seconds",
+                      "dispatch wall time incl. host sync, by kind",
+                      kind="verify")
+    for _ in range(8):
+        h.observe(0.05)  # 50ms verify cadence on the record
+    cfg = SpecControllerConfig(enabled=True, window=64, hysteresis=2,
+                               latency_min_samples=4)
+    c = SpecController(cfg, reg, slots=1, max_spec_len=8, block_len=8)
+    c.reset(0)  # no SLO: full optimistic draft
+    assert int(c.lens()[0]) == 8
+    c.after_round(0)
+    assert int(c.lens()[0]) == 8  # no SLO -> no cap
+    c.reset(0, tpot_slo_s=0.010)  # 10ms budget vs 50ms measured
+    assert int(c.lens()[0]) == 1  # starts narrow: cadence already misses
+    c.reset(0, tpot_slo_s=0.500)  # roomy budget: optimistic start holds
+    assert int(c.lens()[0]) == 8
+    c._slo[0] = 0.010  # budget tightens mid-flight
+    c.after_round(0)
+    assert int(c.lens()[0]) == 4  # halved, not re-evaluated by accept
+    assert c.decisions.get("slo_cap") == 1
+
+
+# --------------------------------------------------------------------------- #
+# engine-level equivalence: the mixed batch vs solo merged references
+# --------------------------------------------------------------------------- #
+
+N_TENANTS = 3
+RANK = 4
+SCALE = 0.5  # large enough to steer greedy argmax on the tiny model
+
+
+def _pack_and_leaves(cfg):
+    pack = tenancy.AdapterPack(cfg.model, slots=N_TENANTS + 1, rank=RANK)
+    leaves = {}
+    for t in range(1, N_TENANTS + 1):
+        leaves[t] = pack.random_leaves(RANK, seed=t, scale=SCALE)
+        pack.set_slot(t, leaves[t])
+    return pack, leaves
+
+
+def _params(cfg, seed=0):
+    return jax.jit(lambda k: llama.init_params(k, cfg.model))(
+        jax.random.PRNGKey(seed))
+
+
+def _prompts():
+    return {slot: [(7 * slot + 3 * i) % 199 + 1 for i in range(8)]
+            for slot in range(N_TENANTS + 1)}
+
+
+def _run_mixed(eng, params, prompts, max_new=10, **req_kw):
+    reqs = [Request(uid=f"t{slot}", prompt=list(p), max_new_tokens=max_new,
+                    adapter_slot=slot,
+                    tenant=f"tenant{slot}" if slot else "", **req_kw)
+            for slot, p in prompts.items()]
+    return ContinuousBatcher(eng, params, seed=0).run(reqs)
+
+
+@pytest.mark.parametrize("attend_impl,kv_layout,tp", [
+    ("dense", "contiguous", 1),
+    ("dense", "paged", 1),
+    ("flash", "contiguous", 1),
+    ("flash", "paged", 2),
+])
+def test_mixed_batch_matches_merged_refs(tiny_model_kwargs, attend_impl,
+                                         kv_layout, tp):
+    """3 adapters + a base-only row in ONE continuous batch: each row's
+    greedy tokens are bit-identical to a solo adapter-less engine fed
+    that tenant's merged-weight (W + BA) tree — across attend kernels,
+    KV layouts, and a tp=2 mesh. The base row doubles as the null
+    identity through the same dispatch."""
+    cfg = make_config(tiny_model_kwargs, tp=tp, seq=MAX_LEN)
+    pack, leaves = _pack_and_leaves(cfg)
+    kw = dict(slots=N_TENANTS + 1, max_seq_len=MAX_LEN,
+              attend_impl=attend_impl, kv_layout=kv_layout)
+    eng = InferenceEngine(cfg, adapters=pack, **kw)
+    base = _params(cfg)
+    prompts = _prompts()
+    mixed = _run_mixed(eng, eng.shard_params(base), prompts)
+
+    ref_eng = InferenceEngine(cfg, **kw)
+    for slot, p in prompts.items():
+        tree = (base if slot == 0
+                else llama.merge_adapter(base, leaves[slot]))
+        ref = ContinuousBatcher(ref_eng, ref_eng.shard_params(tree),
+                                seed=0).run(
+            [Request(uid="solo", prompt=list(p), max_new_tokens=10)])
+        assert mixed[f"t{slot}"].tokens == ref["solo"].tokens, slot
+        assert mixed[f"t{slot}"].finish_reason == ref["solo"].finish_reason
+    # adapters actually bite: tenants diverge from the base row even on
+    # a shared-prefix-free prompt set
+    assert any(mixed[f"t{t}"].tokens != mixed["t0"].tokens
+               for t in range(1, N_TENANTS + 1))
+    if kv_layout == "paged":
+        # per-tenant radix domains: each tenant's prompt registered under
+        # its own salt, never the anonymous ("") domain
+        radix = eng.paged.radix
+        for slot in range(1, N_TENANTS + 1):
+            salt = f"tenant{slot}"
+            assert radix.match(prompts[slot], salt=salt)[1] > 0
+            assert radix.match(prompts[slot], salt="")[1] == 0
+
+
+def test_mixed_verify_matches_merged_refs(tiny_model_kwargs):
+    """The speculative-verify dispatch (spec_len=3, repetitive prompts so
+    drafts accept): mixed-tenant greedy tokens == solo merged
+    references, and the spec run == its own spec-off twin."""
+    cfg = make_config(tiny_model_kwargs, seq=MAX_LEN)
+    pack, leaves = _pack_and_leaves(cfg)
+    kw = dict(slots=N_TENANTS + 1, max_seq_len=MAX_LEN)
+    eng = InferenceEngine(cfg, adapters=pack, spec_len=3, **kw)
+    base = _params(cfg)
+    prompts = {slot: ([5, 9, 5, 9] * 2) for slot in range(N_TENANTS + 1)}
+    mixed = _run_mixed(eng, eng.shard_params(base), prompts, max_new=12)
+    ref_eng = InferenceEngine(cfg, **kw)  # spec-off: greedy oracle
+    for slot, p in prompts.items():
+        tree = (base if slot == 0
+                else llama.merge_adapter(base, leaves[slot]))
+        ref = ContinuousBatcher(ref_eng, ref_eng.shard_params(tree),
+                                seed=0).run(
+            [Request(uid="solo", prompt=list(p), max_new_tokens=12)])
+        assert mixed[f"t{slot}"].tokens == ref["solo"].tokens, slot
+
+
+def test_chunked_prefill_adapter_matches_merged(tiny_model_kwargs):
+    """The chunked-prefill dispatch under an adapter id: final logits
+    agree with the merged-weight engine's chunked prefill AND with the
+    adapter engine's own one-shot prefill."""
+    cfg = make_config(tiny_model_kwargs, seq=MAX_LEN)
+    pack, leaves = _pack_and_leaves(cfg)
+    kw = dict(slots=2, max_seq_len=MAX_LEN, prefill_chunk=8)
+    eng = InferenceEngine(cfg, adapters=pack, **kw)
+    base = _params(cfg)
+    params = eng.shard_params(base)
+    prompt = [(5 * i + 2) % 199 + 1 for i in range(20)]
+    cache, last = eng.prefill_chunked(params, eng.init_cache(), prompt,
+                                      slot=1, adapter_id=2)
+    oneshot = eng.prefill(params, prompt, adapter_id=2)[1]
+    np.testing.assert_allclose(np.asarray(last)[0], np.asarray(oneshot)[0],
+                               rtol=1e-4, atol=1e-4)
+    ref_eng = InferenceEngine(cfg, **kw)
+    merged = ref_eng.shard_params(llama.merge_adapter(base, leaves[2]))
+    _, ref_last = ref_eng.prefill_chunked(merged, ref_eng.init_cache(),
+                                          prompt, slot=1)
+    ref = np.asarray(ref_last)[0]
+    got = np.asarray(last)[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    assert int(np.argmax(got)) == int(np.argmax(ref))
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_int8_mixed_matches_fakequant_merged(tiny_model_kwargs, tp):
+    """Multi-LoRA over the int8 base on tp=1 AND tp=2: the oracle is an
+    adapter-less dense engine fed fake-quant(W) + BA — the quantization
+    error is in both trees, so any difference is the segmented adapter
+    path composed with the fused dequant matmul."""
+    cfg = make_config(tiny_model_kwargs, tp=tp, seq=MAX_LEN)
+    pack, leaves = _pack_and_leaves(cfg)
+    kw = dict(slots=N_TENANTS + 1, max_seq_len=MAX_LEN)
+    eng = InferenceEngine(cfg, adapters=pack, weight_dtype="int8", **kw)
+    base = _params(cfg)
+    qp = llama.quantize_params(base)
+    prompts = _prompts()
+    mixed = _run_mixed(eng, eng.shard_params(qp), prompts)
+    fq = llama.dequantize_params(qp, jnp.dtype(cfg.model.dtype))
+    ref_eng = InferenceEngine(cfg, **kw)
+    for slot, p in prompts.items():
+        tree = fq if slot == 0 else llama.merge_adapter(fq, leaves[slot])
+        ref = ContinuousBatcher(ref_eng, ref_eng.shard_params(tree),
+                                seed=0).run(
+            [Request(uid="solo", prompt=list(p), max_new_tokens=10)])
+        assert mixed[f"t{slot}"].tokens == ref["solo"].tokens, slot
+
+
+def test_null_pack_engine_identical_to_packless(tiny_model_kwargs):
+    """An engine CARRYING a live pack but serving only slot-0 rows is
+    bit-identical to an engine built without one — logits included, not
+    just argmax (the null residual is exactly zero)."""
+    cfg = make_config(tiny_model_kwargs, seq=MAX_LEN)
+    pack, _ = _pack_and_leaves(cfg)  # live adapters in slots 1..3
+    base = _params(cfg)
+    prompt = list(range(1, 9))
+    outs = []
+    for adapters in (pack, None):
+        eng = InferenceEngine(cfg, adapters=adapters, slots=2,
+                              max_seq_len=MAX_LEN)
+        params = eng.shard_params(base)
+        kv, logits = eng.prefill(params, prompt)
+        res = ContinuousBatcher(eng, params, seed=0).run(
+            [Request(uid="r", prompt=list(prompt), max_new_tokens=10)])
+        outs.append((np.asarray(logits), res["r"].tokens))
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    assert outs[0][1] == outs[1][1]
+    # binding ids on a packless engine is a loud error, not a silent drop
+    eng = InferenceEngine(cfg, slots=2, max_seq_len=MAX_LEN)
+    with pytest.raises(ValueError, match="no adapter pack"):
+        eng.bind_adapter_ids(base, [1, 0], 2)
+
+
+# --------------------------------------------------------------------------- #
+# the HTTP surface: tenant resolution, /tenants admin, quota 429 bodies
+# --------------------------------------------------------------------------- #
+
+
+def _req(port, method, path, body=None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request(method, path, None if body is None else json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read() or b"{}"))
+    conn.close()
+    return out
+
+
+def test_http_tenant_resolution_admin_and_quota(tiny_model_kwargs):
+    from picotron_tpu.tools import serve
+
+    cfg = make_config(tiny_model_kwargs, seq=MAX_LEN)
+    pack, _ = _pack_and_leaves(cfg)
+    reg = tenancy.TenantRegistry(pack)
+    reg.add(tenancy.Tenant(name="acme", priority=2, adapter_rank=RANK,
+                           adapter_seed=1, adapter_scale=SCALE))
+    reg.add(tenancy.Tenant(name="capped", priority=1, max_tokens=8))
+    eng = InferenceEngine(cfg, adapters=pack, slots=2, max_seq_len=MAX_LEN)
+    params = eng.shard_params(_params(cfg))
+    srv = serve.Server(eng, params, port=0, tenants=reg,
+                       log=lambda *a, **k: None)
+    srv.start()
+    try:
+        port = srv.port
+        spec = {"prompt": [1, 2, 3], "max_new_tokens": 6}
+        st, base_body = serve._post(port, spec)
+        assert st == 200
+        st, body = serve._post(port, {**spec, "tenant": "nope"})
+        assert st == 400 and "unknown tenant" in body["error"]
+        st, acme = serve._post(port, {**spec, "tenant": "acme"})
+        assert st == 200
+        assert acme["tokens"] != base_body["tokens"]  # the adapter bites
+        # per-tenant quota: commitment (3 + 20) blows max_tokens=8 and
+        # the 429 body names WHICH budget tripped, for WHOM
+        st, body = serve._post(port, {"prompt": [1, 2, 3],
+                                      "max_new_tokens": 20,
+                                      "tenant": "capped"})
+        assert st == 429
+        assert body["budget"] == "tenant_tokens"
+        assert body["tenant"] == "capped"
+        # admin surface: snapshot, hot add, duplicate 409, hot remove
+        st, snap = serve._get(port, "/tenants")
+        assert st == 200
+        assert {t["name"] for t in snap["tenants"]} == {"acme", "capped"}
+        assert snap["pack"]["adapter_bytes_per_token"] == \
+            pack.bytes_per_token()
+        st, added = _req(port, "POST", "/tenants",
+                         {"name": "hot", "priority": 0})
+        assert st == 200 and added["adapter_slot"] == 0
+        st, _ = _req(port, "POST", "/tenants", {"name": "hot"})
+        assert st == 409
+        st, body = serve._post(port, {**spec, "tenant": "hot"})
+        assert st == 200 and body["tokens"] == base_body["tokens"]
+        st, stats = serve._get(port, "/statz")
+        assert st == 200
+        assert stats["rejected"]["tenant_quota"] == 1
+        assert "hot" in stats["tenant_names"]
+        # hot remove: the name 400s afterwards (no silent base fallback)
+        st, body = _req(port, "DELETE", "/tenants/hot")
+        assert st == 200
+        st, _ = _req(port, "DELETE", "/tenants/hot")
+        assert st == 404
+        st, body = serve._post(port, {**spec, "tenant": "hot"})
+        assert st == 400
+    finally:
+        srv.drain_and_join(timeout=60)
