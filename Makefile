@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke tenant-smoke obs-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke tenant-smoke fleet-chaos-smoke fleet-bench obs-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -24,6 +24,7 @@ test-all: native lint
 	$(MAKE) router-chaos-smoke
 	$(MAKE) disagg-smoke
 	$(MAKE) tenant-smoke
+	$(MAKE) fleet-chaos-smoke
 
 # picolint static analysis (picotron_tpu/analysis/, docs/ANALYSIS.md):
 # JAX hot-path rules (host syncs on traced values, trace-time
@@ -218,6 +219,31 @@ tenant-smoke:
 	  --spec-len 3
 	JAX_PLATFORMS=cpu python bench_decode.py --tenants 3 --adapter-rank 4 \
 	  --weight-dtype int8
+
+# Elastic fleet chaos drill (ISSUE 17, tools/fleet.py, docs/SERVING.md
+# "Elastic fleet"): the controller bootstraps a 3-worker fleet against
+# an EMPTY router through the dynamic replica-set admin API, then the
+# acceptance drill under live traffic — SIGKILL a worker holding an
+# in-flight stream (the fleet replaces it within the restart-budget
+# ladder while the router replays the stream exactly-once, greedy
+# bit-identical), stall the controller's scrape plane (stale must never
+# read as dead: no replacement storm), inject an admission spike (a grow
+# decision within the cooloff window, zero requests shed), then the
+# scale-down drain back to min_workers (zero in-flight lost, hot radix
+# prefixes relocated to a survivor, replica deregistered) — with every
+# decision accounted in picotron_fleet_* counters. Exits nonzero on any
+# malfunction.
+fleet-chaos-smoke:
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.fleet --smoke
+
+# Elasticity latency bench (ISSUE 17): a real 3-worker SUBPROCESS fleet
+# (serve.py under supervise --serve; a SIGKILL is a real process-group
+# death) behind the router under the controller — the JSON records
+# scale_up_latency_s, replace_latency_s, ttft_p95_during_spike vs
+# ttft_p95_steady. Minutes on CPU (three cold jax startups are part of
+# what it measures), so it rides outside test-all.
+fleet-bench:
+	JAX_PLATFORMS=cpu python bench_decode.py --fleet
 
 # Serving chaos suite (tests/test_serving.py): dispatch-exception,
 # latency-spike, and poisoned-logits faults through the engine hooks —

@@ -970,6 +970,222 @@ def run_disagg() -> dict:
         os.unlink(cfg_path)
 
 
+# --------------------------------------------------------------------------- #
+# --fleet: elastic fleet controller bench (ISSUE 17)
+# --------------------------------------------------------------------------- #
+
+# Sized for a 1-core box: three subprocess jax workers plus the router
+# and controller share whatever CPU there is, so the model is as small
+# as the serving stack allows and the spike is just deep enough to put
+# requests in a queue (3 workers x 1 slot, 5 concurrent streams).
+_FLEET_MODEL = dict(
+    name="tiny-fleet", num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=4, hidden_size=128, intermediate_size=512,
+    vocab_size=2048, max_position_embeddings=256, dtype="float32",
+    attention_impl="sdpa")
+_FLEET_SIZES = dict(slots=1, stream_prompt=8, stream_tokens=16,
+                    n_steady=3, n_spike=5, kv_num_pages=128)
+
+
+def _stream_ttft(port: int, prompt, max_new: int):
+    """Stream one request via the router; returns (ttft_s, tokens,
+    done_row) — ttft is request-start to first token row on the wire."""
+    from picotron_tpu.tools.router import _stream_post
+
+    t0 = time.perf_counter()
+    first = {}
+
+    def on_tok(i, row):
+        if i == 0:
+            first["t"] = time.perf_counter() - t0
+
+    st, rows = _stream_post(port, {"prompt": list(prompt),
+                                   "max_new_tokens": max_new},
+                            on_token=on_tok)
+    toks = [r["token"] for r in rows if r.get("event") == "token"]
+    done = [r for r in rows if r.get("event") == "done"]
+    if st != 200 or len(done) != 1 or done[0].get("tokens") != toks:
+        raise RuntimeError(f"stream failed: HTTP {st}, rows={rows[-2:]}")
+    return first.get("t"), toks, done[0]
+
+
+def run_fleet() -> dict:
+    """The elastic-controller rung: a real 3-worker SUBPROCESS fleet
+    (serve.py under supervise --serve; a SIGKILL is a real process-group
+    death) behind the router, owned by the fleet controller.
+
+    Measures the three latencies that define elasticity on this stack:
+
+    - ``scale_up_latency_s``: controller start to 3 workers launched,
+      registered, and router-eligible (cold jax startup included — this
+      IS the price of a scale-up on CPU);
+    - ``replace_latency_s``: SIGKILL of a worker holding a live routed
+      stream to the fleet back at full strength (the stream itself must
+      finish exactly-once, greedy bit-identical, via router replay);
+    - ``ttft_p95_during_spike`` vs ``ttft_p95_steady``: first-token
+      latency under an admission spike that forces a grow decision,
+      against the unloaded floor."""
+    import tempfile
+    import threading
+
+    from picotron_tpu.config import FleetConfig, RouterConfig
+    from picotron_tpu.tools.fleet import (FleetController, RouterAdmin,
+                                          SubprocessLauncher)
+    from picotron_tpu.tools.router import RouterServer, _wait_for
+
+    sizes = dict(_FLEET_SIZES)
+    raw = {
+        "distributed": {"tp_size": 1, "use_cpu": True},
+        "model": dict(_FLEET_MODEL),
+        "training": {"seq_length": 64},
+        "dataset": {"name": "synthetic"},
+        "inference": {"kv_layout": "paged", "kv_page_len": 16,
+                      "kv_num_pages": sizes["kv_num_pages"],
+                      "decode_block_len": 1},
+    }
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(raw, f)
+        cfg_path = f.name
+
+    # generous stream budgets: a queued spike request legitimately waits
+    # for a slot on a contended box, and waiting is what the TTFT delta
+    # measures — a mid-queue idle timeout would misread it as a failure
+    rcfg = RouterConfig(probe_interval_s=0.2, scrape_stale_s=10.0,
+                        connect_timeout_s=30.0,
+                        stream_idle_timeout_s=300.0)
+    rs = RouterServer([], rcfg, allow_empty=True,
+                      log=lambda *a, **k: None)
+    rs.start()
+    launcher = SubprocessLauncher(
+        cfg_path, slots=sizes["slots"],
+        serve_args=("--stall-timeout", "0"))
+    fcfg = FleetConfig(
+        scrape_interval_s=0.5, scrape_timeout_s=5.0, hysteresis=2,
+        cooloff_s=2.0, queue_high=0.5, queue_low=0.25, pool_high=0.95,
+        pool_low=0.4, min_workers=3, max_workers=4, max_replaces=3,
+        replace_backoff_s=0.25, replace_backoff_max_s=2.0,
+        drain_timeout_s=60.0)
+    ctl = FleetController(fcfg, launcher, RouterAdmin("127.0.0.1", rs.port),
+                          log=lambda *a, **k: None)
+
+    def up():
+        with ctl._mu:
+            return [w for w in ctl.workers.values() if w.state == "up"]
+
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    out: dict = {}
+    try:
+        t0 = time.perf_counter()
+        ctl.start()
+        if not (_wait_for(lambda: len(up()) >= 3, timeout=600)
+                and rs.router.wait_eligible(3, timeout=60)):
+            raise RuntimeError("fleet never bootstrapped to 3 workers")
+        scale_up_latency_s = time.perf_counter() - t0
+
+        # warm every worker's stream shape, then the steady TTFT floor
+        for _ in range(3):
+            _stream_ttft(rs.port, prompt, 4)
+        steady = []
+        oracle = None
+        for _ in range(sizes["n_steady"]):
+            ttft, toks, _done = _stream_ttft(rs.port, prompt,
+                                             sizes["stream_tokens"])
+            steady.append(ttft)
+            if oracle is None:
+                oracle = toks
+            elif toks != oracle:
+                raise RuntimeError("greedy streams diverged across "
+                                   "workers (identical seeds required)")
+
+        # SIGKILL a worker holding this live stream; the router must
+        # replay it exactly-once and the controller must replace
+        killed = {}
+
+        def kill_at(i, row):
+            if i == 4 and not killed:
+                busy = None
+                for nm, rep in rs.router.replicas.items():
+                    with rep._mu:
+                        if rep.inflight > 0:
+                            busy = nm
+                            break
+                ws = up()
+                for w in ws:
+                    if w.router_name == busy:
+                        killed["worker"] = w.name
+                        w.handle.kill()
+                        return
+                killed["worker"] = ws[0].name
+                ws[0].handle.kill()
+
+        from picotron_tpu.tools.router import _stream_post
+
+        t_kill = time.perf_counter()
+        st, rows = _stream_post(rs.port,
+                                {"prompt": list(prompt),
+                                 "max_new_tokens": sizes["stream_tokens"]},
+                                on_token=kill_at)
+        toks = [r["token"] for r in rows if r.get("event") == "token"]
+        done = [r for r in rows if r.get("event") == "done"]
+        if not (st == 200 and killed and len(done) == 1
+                and done[0]["replays"] >= 1 and toks == oracle):
+            raise RuntimeError(
+                f"kill drill stream not exactly-once bit-identical: "
+                f"HTTP {st}, killed={killed}, tail={rows[-2:]}")
+        if not _wait_for(
+                lambda: (ctl.decisions().get("replace", 0) >= 1
+                         and len(up()) >= 3), timeout=600):
+            raise RuntimeError("dead worker never replaced")
+        replace_latency_s = time.perf_counter() - t_kill
+
+        # admission spike: concurrent streams over the fleet; the
+        # controller must decide to grow, and nothing may be shed
+        grow0 = ctl.decisions().get("grow", 0)
+        spike_ttfts: list = []
+        spike_errs: list = []
+
+        def spike_one():
+            try:
+                ttft, toks, _d = _stream_ttft(rs.port, prompt,
+                                              sizes["stream_tokens"])
+                if toks != oracle:
+                    raise RuntimeError("spike stream diverged")
+                if ttft is not None:
+                    spike_ttfts.append(ttft)
+            except Exception as e:  # noqa: BLE001 - collected and gated
+                spike_errs.append(repr(e))
+
+        threads = [threading.Thread(target=spike_one)
+                   for _ in range(sizes["n_spike"])]
+        for t in threads:
+            t.start()
+        grew = _wait_for(
+            lambda: ctl.decisions().get("grow", 0) > grow0, timeout=60)
+        for t in threads:
+            t.join(timeout=600)
+        if spike_errs:
+            raise RuntimeError(f"spike streams failed: {spike_errs[:3]}")
+        shed = rs.router.stats()["requests"]["shed"]
+        out = {
+            "scale_up_latency_s": round(scale_up_latency_s, 3),
+            "replace_latency_s": round(replace_latency_s, 3),
+            "ttft_p95_steady": _p(steady, 95),
+            "ttft_p50_steady": _p(steady, 50),
+            "ttft_p95_during_spike": _p(spike_ttfts, 95),
+            "ttft_p50_during_spike": _p(spike_ttfts, 50),
+            "grow_decided": bool(grew),
+            "spike_shed": int(shed),
+            "decisions": ctl.decisions(),
+            "sizes": sizes,
+        }
+        return out
+    finally:
+        ctl.stop(drain_workers=True)
+        rs.stop()
+        os.unlink(cfg_path)
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="decode throughput bench")
     ap.add_argument("--block-len", type=int, default=1,
@@ -997,6 +1213,14 @@ def main(argv=None) -> None:
                          "router — the JSON gains tpot_p95_colocated / "
                          "tpot_p95_disagg, handoff_bytes_per_request, "
                          "handoff_latency_s, cluster_prefix_hit_rate")
+    ap.add_argument("--fleet", action="store_true",
+                    help="elastic fleet controller bench (CPU proxy): a "
+                         "3-worker subprocess fleet behind the router "
+                         "under tools/fleet.py — SIGKILL-under-load "
+                         "replacement and an admission spike that forces "
+                         "a grow decision; the JSON gains "
+                         "scale_up_latency_s, replace_latency_s, and "
+                         "ttft_p95_during_spike vs ttft_p95_steady")
     ap.add_argument("--spec-auto", action="store_true",
                     help="closed-loop controller run: a mixed "
                          "repetitive/random-prompt workload through the "
@@ -1098,6 +1322,41 @@ def main(argv=None) -> None:
             raise SystemExit(
                 f"disagg gate failed: colocated p95 {colo:.4f}s is not "
                 f"worse than disaggregated {dis:.4f}s")
+        return
+    if args.fleet:
+        # the fleet bench is its own protocol (subprocess fleet + the
+        # elastic controller; elasticity latencies, not tokens/s) — CPU
+        # proxy by design until the TPU tunnel returns
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            res = run_fleet()
+        except Exception as e:  # noqa: BLE001 - the record IS the channel
+            print(json.dumps({
+                "metric": "fleet_elasticity_cpu_smoke", "value": None,
+                "unit": "replace_latency_s", "vs_baseline": None,
+                "code_failure": True,
+                "error": f"{type(e).__name__}: {e}"[:800]}))
+            raise
+        print(f"# fleet bench: scale_up={res['scale_up_latency_s']:.2f}s "
+              f"replace={res['replace_latency_s']:.2f}s "
+              f"ttft_p95 steady={res['ttft_p95_steady']:.4f}s "
+              f"spike={res['ttft_p95_during_spike']:.4f}s "
+              f"grow_decided={res['grow_decided']} "
+              f"shed={res['spike_shed']}", file=sys.stderr)
+        record = {"metric": "fleet_elasticity_cpu_smoke",
+                  "value": res["replace_latency_s"],
+                  "unit": "replace_latency_s", "vs_baseline": None,
+                  "validated": False, **res}
+        print(json.dumps(record))
+        # the gate: capacity loss and load spikes must both be answered
+        # (a replacement decision actually restored strength; the spike
+        # produced a grow decision and shed nothing)
+        if not res["grow_decided"]:
+            raise SystemExit("fleet gate failed: spike produced no grow "
+                             "decision")
+        if res["spike_shed"]:
+            raise SystemExit(f"fleet gate failed: spike shed "
+                             f"{res['spike_shed']} request(s)")
         return
     if args.spec_len > 0 and args.block_len != 1:
         ap.error("--spec-len replaces blocked decode; drop --block-len")

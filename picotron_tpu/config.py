@@ -699,6 +699,101 @@ class RouterConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Elastic fleet controller knobs (``tools/fleet.py``, docs/SERVING.md
+    "Elastic fleet"). Like ``RouterConfig``, deliberately NOT a section of
+    ``Config``: the controller owns a fleet of serve.py workers (each with
+    its own experiment config) and is configured per deployment — one JSON
+    object loaded with ``FleetConfig.from_dict`` (unknown keys ignored) or
+    plain CLI flags.
+
+    The control loop scrapes every worker's ``/metrics`` + ``/readyz`` each
+    ``scrape_interval_s`` and walks a fixed decision ladder per role:
+    replace dead workers first (budget-gated, never cooloff-gated — lost
+    capacity must not wait), then grow on a sustained high-watermark
+    breach, then drain on a sustained all-low reading. "Sustained" is
+    ``hysteresis`` consecutive ticks; grow/drain additionally respect a
+    per-role ``cooloff_s`` so one spike cannot thrash the fleet (the
+    SpecController discipline, lifted to fleet scale)."""
+
+    # -- control loop --
+    scrape_interval_s: float = 1.0  # tick cadence (scrape + decide)
+    scrape_timeout_s: float = 2.0  # per-HTTP-call scrape deadline
+    # consecutive breached ticks (or failed worker probes) before acting
+    hysteresis: int = 2
+    cooloff_s: float = 10.0  # min seconds between grow/drain per role
+    # -- watermarks (grow when ANY high is breached; drain only when ALL
+    # signals sit below their lows) --
+    queue_high: float = 8.0  # queued requests per worker (prefill queue
+    # depth on prefill workers — the signal a disaggregated fleet watches)
+    queue_low: float = 1.0
+    pool_high: float = 0.85  # KV pool utilization [0, 1]
+    pool_low: float = 0.30
+    ttft_slo_s: float = 0.0  # TTFT p95 above this -> grow (0 = off)
+    # -- fleet bounds (per role) --
+    min_workers: int = 1
+    max_workers: int = 8
+    # -- dead-worker replacement ladder (reuses the _RestartBudget
+    # semantics from tools/supervise.py: bounded attempts, exponential
+    # backoff, healthy-uptime replenishment) --
+    max_replaces: int = 3
+    replace_backoff_s: float = 0.5
+    replace_backoff_max_s: float = 30.0
+    healthy_reset_s: float = 600.0
+    launch_attempts: int = 2  # resilience.retry attempts per launch
+    # -- drain protocol --
+    drain_timeout_s: float = 120.0  # POST /drain -> worker exit deadline
+    # on a scale-down drain, export the victim's hottest radix prefixes
+    # to a surviving worker through the PR 15 page transport (GET
+    # /kv/prefixes -> POST /kv/pages -> POST /kv/import) so the drained
+    # worker's cache is not lost to the cluster; soft — any failure just
+    # skips the export
+    export_prefixes: bool = True
+    export_prefix_limit: int = 4  # hottest cached prefixes per drain
+
+    def validate(self) -> None:
+        for name in ("scrape_interval_s", "scrape_timeout_s", "cooloff_s",
+                     "replace_backoff_s", "replace_backoff_max_s",
+                     "drain_timeout_s"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"fleet.{name} must be > 0")
+        for name in ("hysteresis", "min_workers", "launch_attempts",
+                     "export_prefix_limit"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"fleet.{name} must be >= 1")
+        if self.max_replaces < 0:
+            raise ValueError("fleet.max_replaces must be >= 0 (0 = a dead "
+                             "worker is never replaced)")
+        if self.max_workers < self.min_workers:
+            raise ValueError(
+                f"fleet.max_workers ({self.max_workers}) must be >= "
+                f"min_workers ({self.min_workers})")
+        if self.replace_backoff_max_s < self.replace_backoff_s:
+            raise ValueError(
+                f"fleet.replace_backoff_max_s ({self.replace_backoff_max_s}) "
+                f"must be >= replace_backoff_s ({self.replace_backoff_s})")
+        for name in ("queue_high", "queue_low", "pool_high", "pool_low",
+                     "ttft_slo_s", "healthy_reset_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"fleet.{name} must be >= 0")
+        if self.queue_low > self.queue_high:
+            raise ValueError(
+                f"fleet.queue_low ({self.queue_low}) must be <= queue_high "
+                f"({self.queue_high}) — the hysteresis band inverts")
+        if self.pool_low > self.pool_high:
+            raise ValueError(
+                f"fleet.pool_low ({self.pool_low}) must be <= pool_high "
+                f"({self.pool_high}) — the hysteresis band inverts")
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "FleetConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        cfg = cls(**{k: v for k, v in raw.items() if k in known})
+        cfg.validate()
+        return cfg
+
+
+@dataclass
 class LoggingConfig:
     use_wandb: bool = False
     run_name: str = "picotron-tpu"

@@ -687,6 +687,30 @@ class RadixCache:
                     yield n
                 stack.extend(n.children.values())
 
+    def cached_prefixes(self, limit: int = 4) -> list:
+        """The hottest cached token prefixes across every isolation
+        domain: ``[(salt, ids)]`` for the ``limit`` most recently used
+        leaves, hottest first. A leaf's root path IS a maximal cached
+        prefix (interior nodes are covered by their descendants), so
+        these are exactly what a drain-time cache handoff
+        (tools/fleet.py) should export through the page transport."""
+        scored = []
+        for salt, root in self._roots.items():
+            stack = list(root.children.values())
+            while stack:
+                n = stack.pop()
+                if n.children:
+                    stack.extend(n.children.values())
+                    continue
+                ids: list = []
+                node = n
+                while node is not None and node.parent is not None:
+                    ids[:0] = node.tokens
+                    node = node.parent
+                scored.append((n.last_use, salt, ids))
+        scored.sort(key=lambda t: t[0], reverse=True)
+        return [(salt, ids) for _, salt, ids in scored[:max(0, limit)]]
+
     def evictable_count(self) -> int:
         """Pages eviction could free, cascading: nodes whose ENTIRE
         subtree is held only by the cache (freeing a leaf exposes its

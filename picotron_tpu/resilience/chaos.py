@@ -447,3 +447,72 @@ class RouterChaos:
             raise ConnectionResetError(
                 f"router chaos: stream from {name} severed after "
                 f"{tokens_so_far} tokens")
+
+
+class FleetChaos:
+    """Deterministic fault injection for the elastic fleet drill
+    (``tools/fleet.py``, docs/SERVING.md "Elastic fleet"). Composes with
+    ``RouterChaos``: RouterChaos breaks the data plane (streams, probes,
+    handoffs), FleetChaos breaks the CONTROL plane the fleet controller
+    runs on — the three ways an autoscaler itself goes wrong:
+
+    - ``kill_worker(handle)``   — SIGKILL-under-load: delegates to the
+      worker handle's own ``kill()`` (a real ``SIGKILL`` for subprocess
+      workers, the RouterChaos dispatch-bomb for in-process smoke
+      workers). The controller must detect the death off probe/liveness
+      signals and replace within its budget ladder;
+    - ``stall_scrape(name)``    — the controller's OWN telemetry read
+      from ``name`` fails: stale metrics must read as "unknown", never
+      as "dead" — a wedged scrape plane must not trigger a replacement
+      storm (``unstall_scrape`` heals it);
+    - ``inject_spike(n)``       — arms an admission spike: the drill's
+      load generator drains the armed count (``take_spike``) and fires
+      that many extra concurrent requests, the demand step the
+      controller must answer with a scale-up inside its cooloff window.
+
+    Thread-safety: armed state is mutated by the drill thread and read
+    by the controller tick / load-generator threads; one leaf lock
+    (picolint PICO-C003 discipline, same as RouterChaos).
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._scrape_stall: set = set()
+        self._spike = 0
+        self.kills = 0  # drill accounting: workers killed so far
+
+    def kill_worker(self, handle) -> None:
+        """SIGKILL one fleet worker through its handle (fires its
+        ``kill()`` — no drain, no goodbye; the crash flavor the
+        controller's replace path exists for)."""
+        with self._mu:
+            self.kills += 1
+        handle.kill()
+
+    def stall_scrape(self, name: str, on: bool = True) -> None:
+        with self._mu:
+            if on:
+                self._scrape_stall.add(name)
+            else:
+                self._scrape_stall.discard(name)
+
+    def unstall_scrape(self, name: str) -> None:
+        self.stall_scrape(name, on=False)
+
+    def scrape_stalls(self, name: str) -> bool:
+        """Fleet-controller scrape hook: should this worker's telemetry
+        read fail this tick?"""
+        with self._mu:
+            return name in self._scrape_stall
+
+    def inject_spike(self, n: int) -> None:
+        """Arm ``n`` extra concurrent requests for the drill's load
+        generator to fire on its next pass."""
+        with self._mu:
+            self._spike += int(n)
+
+    def take_spike(self) -> int:
+        """Load-generator hook: drain the armed spike count (consumes)."""
+        with self._mu:
+            n, self._spike = self._spike, 0
+            return n

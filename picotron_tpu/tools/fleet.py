@@ -1,0 +1,1195 @@
+"""Elastic fleet controller: self-resizing serving under spikes,
+preemption, and capacity loss (docs/SERVING.md "Elastic fleet").
+
+``tools/router.py`` gave the serving fabric placement, breakers, and
+mid-stream failover over a FIXED replica set; every scale event — a spot
+preemption, a dead decode worker, a prefill queue backing up — still
+needed an operator. The ``FleetController`` closes that loop: it OWNS a
+dynamic set of serve.py workers and runs a scrape → decide → actuate
+control cycle against the live telemetry plane.
+
+Control loop (one tick per ``fleet.scrape_interval_s``):
+
+1. **Scrape** every worker's ``/readyz`` + ``/metrics`` directly (queue
+   depth — the prefill queue on prefill-role workers — KV pool
+   occupancy, active slots, TTFT p95). A failed metrics read with a live
+   process is *stale*, never *dead*: a wedged scrape plane must not
+   trigger a replacement storm. Death is a dead process handle or
+   ``hysteresis`` consecutive connection-level failures.
+2. **Decide** per role, walking a fixed ladder:
+   - *replace* dead workers first — budget-gated (the ``_RestartBudget``
+     ladder from tools/supervise.py: bounded attempts, exponential
+     backoff, healthy-uptime replenishment), never cooloff-gated; lost
+     capacity must not wait behind a scale decision;
+   - *grow* when ANY high watermark is breached for ``hysteresis``
+     consecutive ticks (queue > queue_high, pool > pool_high, TTFT p95
+     over the SLO) and the role is under ``max_workers``;
+   - *drain* the least-loaded worker when ALL signals sit below their
+     low watermarks for ``hysteresis`` ticks and the role is above
+     ``min_workers``. Grow/drain share a per-role ``cooloff_s`` (the
+     PR 14 SpecController discipline lifted to fleet scale).
+3. **Actuate** off the tick thread: launches go through a pluggable
+   launcher (``SubprocessLauncher`` = serve.py under ``tools/supervise.py
+   --serve``; ``_SmokeLauncher`` = in-process servers for the chaos
+   drill) and register with the router through its dynamic replica-set
+   admin API (``POST /replicas`` / ``DELETE /replicas/<name>``). A drain
+   first relocates the victim's hottest radix prefixes to a survivor
+   through the PR 15 page transport (GET /kv/prefixes → POST /kv/pages →
+   POST /kv/import — soft: any failure just skips the export), then arms
+   the worker's stop surface, POSTs ``/drain`` (202, or 409 when the
+   stop signal already started one), waits for the in-flight work to
+   finish, and only then deregisters — a scale-down loses zero requests.
+
+Observability: every decision is counted
+(``picotron_fleet_decisions_total{action=replace|grow|drain|
+replace_exhausted}``), latencies land in
+``picotron_fleet_scale_up_seconds`` / ``picotron_fleet_replace_seconds``
+histograms, per-role worker counts in ``picotron_fleet_workers``, and
+each actuation emits a tracer span — the accounting the chaos smoke
+(`make fleet-chaos-smoke`) audits decision by decision.
+
+Locking discipline (picolint PICO-C001..C004): ``_mu`` is a LEAF lock
+guarding the worker registry and worker state transitions — never held
+across scrape I/O, launches, joins, or another lock. Streak/budget state
+is touched only by the controller tick thread and needs no lock at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from picotron_tpu.config import FleetConfig
+from picotron_tpu.obs import Obs
+from picotron_tpu.obs.metrics import parse_prometheus
+from picotron_tpu.resilience.retry import retry
+from picotron_tpu.tools.router import DuplicateReplica, hist_quantile
+from picotron_tpu.tools.supervise import _RestartBudget
+
+# how many ticks a scrape may miss before the reading is too old to
+# steer a watermark decision (distinct from death: stale load is
+# *unknown* load, and unknown load must park the streaks, not feed them)
+_FRESH_TICKS = 3.0
+
+
+# --------------------------------------------------------------------------- #
+# stdlib HTTP helpers (the same close-delimited HTTP/1.0 clients the
+# router's prober uses — the controller is a peer of that scrape plane)
+# --------------------------------------------------------------------------- #
+
+
+def _get_json(host: str, port: int, path: str, timeout: float):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get_text(host: str, port: int, path: str, timeout: float):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+
+
+def _req_json(method: str, host: str, port: int, path: str, body=None,
+              timeout: float = 5.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        payload = None if body is None else json.dumps(body)
+        conn.request(method, path, payload,
+                     {"Content-Type": "application/json"} if payload
+                     else {})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _post_json(host: str, port: int, path: str, body: dict,
+               timeout: float = 5.0):
+    return _req_json("POST", host, port, path, body, timeout)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+# --------------------------------------------------------------------------- #
+# router admin clients
+# --------------------------------------------------------------------------- #
+
+
+class RouterAdmin:
+    """HTTP client for the router's dynamic replica-set admin API
+    (``POST /replicas``, ``DELETE /replicas/<name>``). Register is
+    idempotent — a 409 means the replica is already in the set, which is
+    exactly what a controller restarted over a live fleet wants."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = timeout
+
+    def register(self, host: str, port: int) -> str:
+        name = f"{host}:{port}"
+        st, body = _post_json(self.host, self.port, "/replicas",
+                              {"replica": name}, self.timeout)
+        if st not in (200, 409):
+            raise RuntimeError(f"router register {name}: HTTP {st}: "
+                               f"{body.get('error', body)}")
+        return name
+
+    def deregister(self, name: str) -> None:
+        # ':' is path-safe; the router unquotes, so no encoding needed
+        st, body = _req_json("DELETE", self.host, self.port,
+                             f"/replicas/{name}", None, self.timeout)
+        if st not in (200, 404):  # 404 = already gone, the desired state
+            raise RuntimeError(f"router deregister {name}: HTTP {st}: "
+                               f"{body.get('error', body)}")
+
+    def replicas(self) -> dict:
+        st, body = _get_json(self.host, self.port, "/replicas",
+                             self.timeout)
+        if st != 200:
+            raise RuntimeError(f"router GET /replicas: HTTP {st}")
+        return body
+
+
+class DirectRouterAdmin:
+    """In-process adapter over a ``Router`` object — the unit-test seam
+    (``RouterAdmin`` is the same three calls over the wire)."""
+
+    def __init__(self, router):
+        self.router = router
+
+    def register(self, host: str, port: int) -> str:
+        name = f"{host}:{port}"
+        try:
+            self.router.add_replica(name)
+        except DuplicateReplica:
+            pass
+        return name
+
+    def deregister(self, name: str) -> None:
+        try:
+            self.router.remove_replica(name)
+        except KeyError:
+            pass
+
+    def replicas(self) -> dict:
+        now = self.router._clock()
+        return {n: r.snapshot(now)
+                for n, r in self.router.replicas.items()}
+
+
+# --------------------------------------------------------------------------- #
+# worker handles + launchers
+# --------------------------------------------------------------------------- #
+
+
+class SubprocessHandle:
+    """One worker = one process GROUP: ``supervise --serve`` plus the
+    serve.py child it restarts. ``terminate`` SIGTERMs the supervisor
+    (it forwards to the child, which drains, and does NOT relaunch a
+    stop-requested exit); ``kill`` SIGKILLs the whole group — the crash
+    flavor the controller's replace ladder exists for."""
+
+    def __init__(self, proc: subprocess.Popen, host: str, port: int):
+        self.proc = proc
+        self.host = host
+        self.port = int(port)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                self.proc.kill()
+            except ProcessLookupError:
+                pass
+
+    def terminate(self) -> None:
+        try:
+            self.proc.terminate()
+        except ProcessLookupError:
+            pass
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        try:
+            self.proc.wait(timeout=timeout)
+            return True
+        except subprocess.TimeoutExpired:
+            return False
+
+
+def _drain_pipe(stream) -> None:
+    for _ in iter(stream.readline, b""):
+        pass
+
+
+class SubprocessLauncher:
+    """Launch serve.py workers as real subprocesses under ``tools/
+    supervise.py --serve`` (in-worker crash/preempt restarts stay the
+    supervisor's job; WHOLE-worker loss is the fleet controller's).
+    ``launch`` blocks until the worker's health surface answers — model
+    init and jit warm-up are part of the scale-up latency the fleet
+    histograms measure."""
+
+    def __init__(self, config_path: str, *, slots: int = 2,
+                 max_seq_len: Optional[int] = None, serve_args=(),
+                 supervise_args=("--max-restarts", "2",
+                                 "--backoff", "0.25"),
+                 python: str = "", startup_timeout_s: float = 180.0):
+        self.config_path = config_path
+        self.slots = int(slots)
+        self.max_seq_len = max_seq_len
+        self.serve_args = tuple(serve_args)
+        self.supervise_args = tuple(supervise_args)
+        self.python = python or sys.executable
+        self.startup_timeout_s = startup_timeout_s
+
+    def launch(self, name: str, role: str) -> SubprocessHandle:
+        port = _free_port()
+        py = self.python
+        cmd = [py, "-m", "picotron_tpu.tools.supervise", "--serve",
+               *self.supervise_args, "--",
+               py, "-m", "picotron_tpu.tools.serve",
+               "--config", self.config_path, "--random-init",
+               "--port", str(port), "--slots", str(self.slots)]
+        if self.max_seq_len:
+            cmd += ["--max-seq-len", str(self.max_seq_len)]
+        if role != "both":
+            cmd += ["--role", role, "--kv-layout", "paged"]
+        cmd += list(self.serve_args)
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)  # own pgid: kill() takes the pair
+        threading.Thread(target=_drain_pipe, args=(proc.stdout,),
+                         name=f"fleet-pipe-{name}", daemon=True).start()
+        deadline = time.monotonic() + self.startup_timeout_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet worker {name} exited rc={proc.returncode} "
+                    f"during startup")
+            try:
+                st, _ = _get_json("127.0.0.1", port, "/healthz", 2.0)
+                if st == 200:
+                    return SubprocessHandle(proc, "127.0.0.1", port)
+            except OSError:
+                pass
+            time.sleep(0.25)
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except OSError:
+            pass
+        raise RuntimeError(f"fleet worker {name} not serving within "
+                           f"{self.startup_timeout_s}s")
+
+
+class _InProcHandle:
+    """Worker handle over an in-process ``serve.Server`` — the smoke and
+    test flavor of the ``SubprocessHandle`` protocol. ``kill`` is the
+    RouterChaos dispatch-bomb (the in-process SIGKILL: the dispatch loop
+    dies, waiters get terminal errors, the listener closes)."""
+
+    def __init__(self, server):
+        self.server = server
+        self.host = "127.0.0.1"
+        self.port = server.port
+
+    def alive(self) -> bool:
+        front = self.server.front
+        return not front.dead and not front.stopped.is_set()
+
+    def kill(self) -> None:
+        from picotron_tpu.resilience.chaos import RouterChaos
+
+        RouterChaos().kill(self.server)
+
+    def terminate(self) -> None:
+        self.server.front.begin_drain()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        try:
+            self.server.drain_and_join(timeout=timeout)
+        except OSError:
+            pass  # a killed worker already closed its own listener
+        return not self.alive()
+
+
+class _SmokeLauncher:
+    """In-process serve.Server workers over IDENTICAL tiny seed-0
+    random-init models (same params → greedy outputs are a shared
+    bit-exact oracle), streaming per token, on the paged KV layout so
+    the drain-time prefix export path is live. The `make
+    fleet-chaos-smoke` / test launcher."""
+
+    def __init__(self, slots: int = 2):
+        self.slots = int(slots)
+        self.servers: dict = {}  # name -> serve.Server (chaos targeting)
+        self._init = None
+
+    def launch(self, name: str, role: str) -> _InProcHandle:
+        import jax
+
+        from picotron_tpu.config import Config
+        from picotron_tpu.inference import InferenceEngine
+        from picotron_tpu.models import llama
+        from picotron_tpu.tools import serve
+        from picotron_tpu.tools.generate import SMOKE_CONFIG
+        from picotron_tpu.train import _ensure_devices
+
+        cfg = Config.from_dict(SMOKE_CONFIG)
+        cfg.inference.decode_block_len = 1
+        cfg.inference.kv_layout = "paged"
+        cfg.inference.kv_page_len = 8
+        # an explicit, generous pool: the drill's admission spike must
+        # queue (the watermark signal) rather than 429 on page pressure
+        cfg.inference.kv_num_pages = 96
+        if role != "both":
+            cfg.inference.role = role
+        _ensure_devices(cfg)
+        engine = InferenceEngine(cfg, slots=self.slots, max_seq_len=64)
+        if self._init is None:
+            self._init = jax.jit(lambda k: llama.init_params(k, cfg.model))
+        params = engine.shard_params(self._init(jax.random.PRNGKey(0)))
+        # like the page pool above, the admission token budget must be
+        # roomy enough that the spike QUEUES: the default slots *
+        # max_seq_len (128) lets only ~3 of the drill's requests in per
+        # worker before 429 — a shed the watermarks would never see
+        srv = serve.Server(engine, params, port=0, token_budget=4096,
+                           log=lambda *a, **k: None)
+        srv.start()
+        self.servers[name] = srv
+        return _InProcHandle(srv)
+
+
+# --------------------------------------------------------------------------- #
+# the controller
+# --------------------------------------------------------------------------- #
+
+
+class FleetWorker:
+    """One controller-owned worker. State machine::
+
+        launching ──> up ──> draining ──> (removed)
+             │         └───> dead ──────> (removed; budget-gated replace)
+             └───────> failed ──────────> (removed; budget-gated replace)
+
+    Transitions happen under the controller's ``_mu``; the scrape fields
+    are written by the tick thread only."""
+
+    __slots__ = ("name", "role", "state", "handle", "router_name",
+                 "launched_t", "scrape", "scrape_t", "down_fails")
+
+    def __init__(self, name: str, role: str):
+        self.name = name
+        self.role = role
+        self.state = "launching"
+        self.handle = None
+        self.router_name = ""
+        self.launched_t = 0.0
+        self.scrape: dict = {}
+        self.scrape_t = float("-inf")
+        self.down_fails = 0
+
+
+class FleetController:
+    """Scrape → decide → actuate over a dynamic serve.py fleet (module
+    docstring has the ladder). ``launcher`` provides ``launch(name,
+    role) -> handle``; ``admin`` provides ``register/deregister``
+    against the router; ``roles`` lists the roles managed independently
+    (e.g. ``("prefill", "decode")`` for a disaggregated fleet)."""
+
+    def __init__(self, cfg: FleetConfig, launcher, admin, *,
+                 roles=("both",), chaos=None, obs: Optional[Obs] = None,
+                 log=print, clock=time.monotonic):
+        cfg.validate()
+        self.cfg = cfg
+        self.launcher = launcher
+        self.admin = admin
+        self.roles = tuple(roles)
+        self.chaos = chaos
+        self.obs = obs or Obs(enabled=True)
+        self.registry = self.obs.registry
+        self._log = log
+        self._clock = clock
+        self.workers: dict = {}  # name -> FleetWorker, guarded by _mu
+        self._mu = threading.Lock()  # LEAF: state only, never I/O
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._threads: list = []  # launch/drain actuation threads
+        self._seq = 0
+        # tick-thread-only state (no lock by design, not oversight):
+        # streaks, the replace budget, and the delayed-replace queue are
+        # touched exclusively by the controller thread
+        self._streaks = {r: {"high": 0, "low": 0, "last": float("-inf")}
+                         for r in self.roles}
+        self._budget = _RestartBudget(
+            cfg.max_replaces, cfg.replace_backoff_s,
+            cfg.replace_backoff_max_s, healthy_reset=cfg.healthy_reset_s)
+        self._pending: list = []  # (role, due_t, reason, t0)
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        for role in self.roles:
+            for _ in range(self.cfg.min_workers):
+                self._spawn_launch(role, "bootstrap", self._clock())
+        self._thread = threading.Thread(target=self._run,
+                                        name="fleet-controller",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, drain_workers: bool = False,
+             timeout: float = 60.0) -> None:
+        """Stop the control loop (joins the tick + actuation threads).
+        With ``drain_workers``, also walks every remaining worker through
+        terminate → wait → deregister — the whole-fleet rollout."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        for t in list(self._threads):
+            t.join(timeout=timeout)
+        if not drain_workers:
+            return
+        with self._mu:
+            remaining = list(self.workers.values())
+        for w in remaining:
+            h = w.handle
+            if h is not None:
+                try:
+                    h.terminate()
+                    h.wait(timeout)
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+            if w.router_name:
+                try:
+                    self.admin.deregister(w.router_name)
+                except Exception:  # noqa: BLE001
+                    pass
+            with self._mu:
+                self.workers.pop(w.name, None)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the loop must outlive
+                # any single tick; a scrape/decide bug degrades to a
+                # logged skip, never a silently dead autoscaler
+                self._event("tick_error", error=repr(e))
+            if self._stop.wait(self.cfg.scrape_interval_s):
+                return
+
+    # ---- observability ----------------------------------------------------
+
+    def _event(self, evt: str, **fields) -> None:
+        self._log(json.dumps({"evt": evt, "t": round(time.time(), 3),
+                              **fields}), flush=True)
+
+    def _decision(self, action: str, **fields) -> None:
+        self.registry.counter(
+            "picotron_fleet_decisions_total",
+            "fleet scale decisions by action", action=action).inc()
+        self._event(f"fleet_{action}", **fields)
+
+    def decisions(self) -> dict:
+        """{action: count} — the smoke/test accounting surface."""
+        prom = parse_prometheus(self.registry.prometheus())
+        out = {}
+        for action in ("replace", "grow", "drain", "replace_exhausted"):
+            v = prom.get('picotron_fleet_decisions_total'
+                         f'{{action="{action}"}}')
+            if v is not None:
+                out[action] = int(v)
+        return out
+
+    # ---- scrape plane -----------------------------------------------------
+
+    def _scrape(self, w: FleetWorker):
+        """One worker's control-plane read → ``(status, scrape)`` with
+        status ``ok`` | ``stale`` | ``down``. Stale (metrics unreadable,
+        process not provably dead) parks the watermark streaks; only
+        ``down`` — connection-level failure or a dead readyz — feeds the
+        death counter."""
+        if self.chaos is not None and self.chaos.scrape_stalls(w.name):
+            return "stale", None
+        h = w.handle
+        t = self.cfg.scrape_timeout_s
+        try:
+            st, body = _get_json(h.host, h.port, "/readyz", t)
+        except OSError:
+            return "down", None
+        if body.get("state") == "dead":
+            return "down", None
+        draining = (body.get("state") == "draining"
+                    or bool(body.get("draining")))
+        try:
+            mst, text = _get_text(h.host, h.port, "/metrics", t)
+        except OSError:
+            return "stale", None
+        if mst != 200:
+            return "stale", None
+        prom = parse_prometheus(text)
+        queue_metric = ("picotron_prefill_queue_depth"
+                        if w.role == "prefill"
+                        else "picotron_queue_depth")
+        return "ok", {
+            "queue": prom.get(queue_metric, 0.0),
+            "pool": prom.get("picotron_kv_pool_utilization", 0.0),
+            "active": prom.get("picotron_active_slots", 0.0),
+            "ttft_p95": hist_quantile(prom, "picotron_ttft_seconds",
+                                      0.95),
+            "draining": draining,
+        }
+
+    # ---- one control tick -------------------------------------------------
+
+    def tick(self) -> None:
+        """One scrape → decide → actuate pass (public so the unit tests
+        drive the ladder deterministically with a fake clock)."""
+        cfg = self.cfg
+        now = self._clock()
+        with self._mu:
+            snapshot = list(self.workers.values())
+
+        # 1. scrape (all I/O, no lock held)
+        results = []
+        for w in snapshot:
+            if w.state not in ("up", "draining"):
+                continue
+            alive = w.handle is not None and w.handle.alive()
+            status, scrape = ("down", None) if not alive \
+                else self._scrape(w)
+            results.append((w, alive, status, scrape))
+
+        newly_dead = []
+        with self._mu:
+            for w, alive, status, scrape in results:
+                if status == "ok":
+                    w.scrape = scrape
+                    w.scrape_t = now
+                    w.down_fails = 0
+                else:
+                    if status == "down":
+                        w.down_fails += 1
+                    self.registry.counter(
+                        "picotron_fleet_scrape_failures_total",
+                        "failed worker scrapes", worker=w.name,
+                        kind=status).inc()
+                if w.state == "up" and (
+                        not alive or w.down_fails >= cfg.hysteresis):
+                    w.state = "dead"
+                    newly_dead.append(w)
+            failed = [w for w in self.workers.values()
+                      if w.state == "failed"]
+            for w in newly_dead + failed:
+                self.workers.pop(w.name, None)
+
+        # 2. ladder rung 1: replace dead/failed — budget-gated, never
+        # cooloff-gated (lost capacity must not wait behind a scale
+        # decision)
+        for w in newly_dead + failed:
+            if w.router_name:
+                try:
+                    self.admin.deregister(w.router_name)
+                except Exception as e:  # noqa: BLE001 — router may be
+                    # mid-restart; the replica is unroutable either way
+                    self._event("deregister_failed", worker=w.name,
+                                error=repr(e))
+            uptime = max(0.0, now - w.launched_t) if w.launched_t else 0.0
+            step = self._budget.record(uptime)
+            if step is None:
+                self._decision("replace_exhausted", worker=w.name,
+                               role=w.role, was=w.state)
+                continue
+            kind, delay = step
+            self._decision("replace", worker=w.name, role=w.role,
+                           was=w.state, ladder=kind,
+                           delay_s=round(delay, 3))
+            self._pending.append((w.role, now + delay, "replace", now))
+
+        # delayed replacements whose backoff has elapsed
+        due = [p for p in self._pending if p[1] <= now]
+        self._pending = [p for p in self._pending if p[1] > now]
+        for role, _, reason, t0 in due:
+            self._spawn_launch(role, reason, t0)
+
+        # 3. rungs 2/3 per role: grow / drain on sustained watermarks
+        with self._mu:
+            workers_now = list(self.workers.values())
+        fresh_horizon = (_FRESH_TICKS * cfg.scrape_interval_s
+                         + cfg.scrape_timeout_s)
+        for role in self.roles:
+            mine = [w for w in workers_now
+                    if w.role == role and w.state in ("launching", "up")]
+            pending_n = sum(1 for r, _, _, _ in self._pending
+                            if r == role)
+            draining_n = sum(1 for w in workers_now
+                             if w.role == role and w.state == "draining")
+            fresh = [w for w in mine
+                     if w.state == "up" and w.scrape
+                     and now - w.scrape_t <= fresh_horizon]
+            self.registry.gauge(
+                "picotron_fleet_workers", "live workers by role",
+                role=role).set(float(len(mine)))
+            st = self._streaks[role]
+            high = bool(fresh) and any(self._breach_high(w)
+                                       for w in fresh)
+            low = bool(fresh) and all(self._below_low(w) for w in fresh)
+            st["high"] = st["high"] + 1 if high else 0
+            st["low"] = st["low"] + 1 if (low and not high) else 0
+            cooled = now - st["last"] >= cfg.cooloff_s
+            if (st["high"] >= cfg.hysteresis and cooled
+                    and len(mine) + pending_n + draining_n
+                    < cfg.max_workers):
+                st["last"] = now
+                st["high"] = 0
+                self._decision("grow", role=role, workers=len(mine))
+                self._spawn_launch(role, "grow", now)
+            elif (st["low"] >= cfg.hysteresis and cooled
+                  and draining_n == 0 and pending_n == 0
+                  and sum(1 for w in mine if w.state == "up")
+                  > cfg.min_workers):
+                victim = min(fresh, key=lambda w: (
+                    w.scrape.get("queue", 0.0),
+                    w.scrape.get("active", 0.0),
+                    w.scrape.get("pool", 0.0)))
+                st["last"] = now
+                st["low"] = 0
+                self._decision("drain", role=role, worker=victim.name)
+                self._spawn_drain(victim)
+
+    def _breach_high(self, w: FleetWorker) -> bool:
+        s, cfg = w.scrape, self.cfg
+        ttft = s.get("ttft_p95")
+        return (s.get("queue", 0.0) > cfg.queue_high
+                or s.get("pool", 0.0) > cfg.pool_high
+                or (cfg.ttft_slo_s > 0 and ttft is not None
+                    and ttft > cfg.ttft_slo_s))
+
+    def _below_low(self, w: FleetWorker) -> bool:
+        s, cfg = w.scrape, self.cfg
+        return (s.get("queue", 0.0) < cfg.queue_low
+                and s.get("pool", 0.0) < cfg.pool_low)
+
+    # ---- actuation (off the tick thread) ----------------------------------
+
+    def _spawn_launch(self, role: str, reason: str, t0: float) -> None:
+        with self._mu:
+            self._seq += 1
+            w = FleetWorker(f"w{self._seq}-{role}", role)
+            self.workers[w.name] = w
+        t = threading.Thread(target=self._do_launch, args=(w, reason, t0),
+                             name=f"fleet-launch-{w.name}", daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _spawn_drain(self, w: FleetWorker) -> None:
+        with self._mu:
+            w.state = "draining"
+        t = threading.Thread(target=self._do_drain, args=(w,),
+                             name=f"fleet-drain-{w.name}", daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _do_launch(self, w: FleetWorker, reason: str, t0: float) -> None:
+        try:
+            handle = retry(
+                lambda: self.launcher.launch(w.name, w.role),
+                attempts=self.cfg.launch_attempts, backoff=0.5,
+                desc=f"fleet-launch-{w.role}")
+        except Exception as e:  # noqa: BLE001 — every launch failure
+            # (quota, port clash, dead config) walks the budget ladder
+            with self._mu:
+                w.state = "failed"
+                self.workers[w.name] = w  # re-park for the tick to judge
+            self._event("launch_failed", worker=w.name, role=w.role,
+                        reason=reason, error=repr(e))
+            return
+        try:
+            router_name = self.admin.register(handle.host, handle.port)
+        except Exception as e:  # noqa: BLE001
+            # an unregistered worker serves nothing: reap it and let the
+            # budget ladder decide whether to try again
+            self._event("register_failed", worker=w.name, error=repr(e))
+            try:
+                handle.terminate()
+                handle.wait(10.0)
+            except Exception:  # noqa: BLE001
+                pass
+            with self._mu:
+                w.handle = handle
+                w.state = "failed"
+                self.workers[w.name] = w
+            return
+        now = self._clock()
+        with self._mu:
+            w.handle = handle
+            w.router_name = router_name
+            w.launched_t = now
+            w.state = "up"
+        hist = ("picotron_fleet_replace_seconds" if reason == "replace"
+                else "picotron_fleet_scale_up_seconds")
+        self.registry.histogram(
+            hist, "decision-to-registered latency").observe(now - t0)
+        self.obs.tracer.record(f"fleet_{reason}", t0, now, worker=w.name,
+                               role=w.role, port=handle.port)
+        self._event("worker_up", worker=w.name, role=w.role,
+                    port=handle.port, reason=reason,
+                    latency_s=round(now - t0, 3))
+
+    def _do_drain(self, w: FleetWorker) -> None:
+        """The drain protocol: export the victim's hottest prefixes to a
+        survivor (soft), arm the stop surface, POST /drain (202, or 409
+        when the stop signal already began one), wait out the in-flight
+        work, deregister. A worker that blows ``drain_timeout_s`` is
+        killed — a drain must terminate."""
+        cfg = self.cfg
+        h = w.handle
+        t0 = self._clock()
+        if cfg.export_prefixes:
+            self._export_prefixes(w)
+        try:
+            h.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+        st = 0
+        try:
+            st, _ = _post_json(h.host, h.port, "/drain", {},
+                               cfg.scrape_timeout_s)
+        except OSError:
+            pass  # drain already finished and closed the listener
+        clean = False
+        try:
+            clean = h.wait(cfg.drain_timeout_s)
+        except Exception:  # noqa: BLE001
+            pass
+        if not clean:
+            try:
+                h.kill()
+                h.wait(10.0)
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            self.admin.deregister(w.router_name)
+        except Exception as e:  # noqa: BLE001
+            self._event("deregister_failed", worker=w.name,
+                        error=repr(e))
+        with self._mu:
+            self.workers.pop(w.name, None)
+        now = self._clock()
+        self.obs.tracer.record("fleet_drain", t0, now, worker=w.name,
+                               role=w.role, clean=clean)
+        self._event("worker_drained", worker=w.name, role=w.role,
+                    drain_status=st, clean=clean,
+                    latency_s=round(now - t0, 3))
+
+    def _export_prefixes(self, w: FleetWorker) -> int:
+        """Relocate the victim's hottest radix prefixes to one surviving
+        decode-capable worker through the PR 15 page transport. Soft by
+        contract: any failure (contiguous layout, empty cache, dead
+        survivor) skips the export — a drain never blocks on it."""
+        cfg = self.cfg
+        with self._mu:
+            survivors = [x for x in self.workers.values()
+                         if x.name != w.name and x.state == "up"
+                         and x.role in ("both", "decode")]
+        if not survivors:
+            return 0
+        tgt = survivors[0].handle
+        t = max(cfg.scrape_timeout_s, 10.0)
+        moved = 0
+
+        def count(outcome: str) -> None:
+            self.registry.counter(
+                "picotron_fleet_prefix_exports_total",
+                "drain-time prefix-relocation attempts by outcome",
+                outcome=outcome).inc()
+
+        try:
+            pst, body = _get_json(
+                w.handle.host, w.handle.port,
+                f"/kv/prefixes?limit={cfg.export_prefix_limit}", t)
+            if pst != 200:
+                # contiguous layout (503) or a worker already gone: the
+                # path RAN and chose to skip — count it so the drill can
+                # pin the protocol without requiring a warm cache
+                count("unsupported")
+                return 0
+            entries = body.get("prefixes", [])
+            if not entries:
+                count("empty")  # a cold victim has nothing to move
+            for entry in entries:
+                gst, pages = _post_json(
+                    w.handle.host, w.handle.port, "/kv/pages",
+                    {"ids": entry["ids"], "tenant": entry.get("tenant")},
+                    t)
+                if gst != 200 or not pages.get("matched"):
+                    count("miss")
+                    continue
+                ist, _ = _post_json(tgt.host, tgt.port, "/kv/import",
+                                    {"kv": pages["kv"]}, t)
+                if ist == 200:
+                    moved += 1
+                    count("moved")
+                else:
+                    count("import_failed")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            count("error")
+            self._event("prefix_export_skipped", worker=w.name,
+                        error=repr(e))
+        if moved:
+            self._event("prefix_export", worker=w.name, moved=moved,
+                        to=survivors[0].name)
+        return moved
+
+
+# --------------------------------------------------------------------------- #
+# smoke drive (`make fleet-chaos-smoke`) + CLI
+# --------------------------------------------------------------------------- #
+
+
+def _smoke() -> int:
+    """The ISSUE 17 acceptance drill end to end, zero operator actions:
+    (1) SIGKILL a worker under a live stream → router replays the client
+    stream exactly-once and greedy bit-identical, the controller
+    replaces the dead worker within its budget ladder; (2) a stalled
+    scrape plane does NOT trigger a replacement storm; (3) an injected
+    admission spike → the controller grows within its cooloff window and
+    nothing is shed; (4) the post-spike scale-down drain loses zero
+    in-flight requests — with the ``picotron_fleet_*`` counters
+    accounting for every decision. Returns an exit code."""
+    from picotron_tpu.config import RouterConfig
+    from picotron_tpu.resilience.chaos import FleetChaos, RouterChaos
+    from picotron_tpu.tools import serve
+    from picotron_tpu.tools.router import (
+        RouterServer, _stream_post, _wait_for)
+
+    fail: list = []
+
+    def check(name: str, ok) -> None:
+        print(f"fleet-chaos-smoke: {name}: {'ok' if ok else 'FAIL'}",
+              flush=True)
+        if not ok:
+            fail.append(name)
+
+    rchaos = RouterChaos()
+    fchaos = FleetChaos()
+    # probe/staleness tolerances are LOOSE here on purpose: the whole
+    # fleet shares one interpreter, so 10 concurrent spike streams starve
+    # prober threads past tight timeouts — breakers would open and
+    # scrapes would stale out from GIL contention, not from any fault.
+    # The breaker/staleness mechanics have their own drills (router
+    # --smoke); this drill is about the CONTROLLER's decisions.
+    rcfg = RouterConfig(
+        probe_interval_s=0.05, probe_timeout_s=2.0, breaker_failures=5,
+        breaker_backoff_s=0.05, breaker_backoff_max_s=0.4,
+        breaker_probe_attempts=4, scrape_stale_s=10.0,
+        stream_idle_timeout_s=60.0, connect_timeout_s=20.0)
+    rs = RouterServer([], rcfg, chaos=rchaos, allow_empty=True,
+                      log=lambda *a, **k: None)
+    rs.start()
+    router = rs.router
+    launcher = _SmokeLauncher(slots=2)
+    fcfg = FleetConfig(
+        scrape_interval_s=0.05, scrape_timeout_s=2.0, hysteresis=2,
+        cooloff_s=0.75, queue_high=0.5, queue_low=0.25, pool_high=0.9,
+        pool_low=0.5, min_workers=3, max_workers=5, max_replaces=3,
+        replace_backoff_s=0.05, replace_backoff_max_s=0.4,
+        drain_timeout_s=60.0, export_prefixes=True,
+        export_prefix_limit=2)
+    ctl = FleetController(fcfg, launcher, RouterAdmin("127.0.0.1",
+                                                      rs.port),
+                          chaos=fchaos, log=lambda *a, **k: None)
+
+    def up_workers():
+        with ctl._mu:
+            return [w for w in ctl.workers.values() if w.state == "up"]
+
+    def fleet_prom(name: str) -> float:
+        prom = parse_prometheus(ctl.registry.prometheus())
+        return sum(v for k, v in prom.items() if k.startswith(name))
+
+    client_errors: list = []
+
+    def run_routed(spec: dict):
+        st, rows = _stream_post(rs.port, spec)
+        toks = [r["token"] for r in rows if r.get("event") == "token"]
+        done = [r for r in rows if r.get("event") == "done"]
+        ok = (st == 200 and len(done) == 1
+              and done[0]["finish_reason"] == "length"
+              and done[0]["tokens"] == toks)
+        if not ok:
+            client_errors.append((spec.get("request_id"), st, rows[-1:]))
+        return ok, toks
+
+    t_start = time.monotonic()
+    ctl.start()
+    try:
+        # ---- bootstrap: controller grows the fleet to min_workers ----
+        check("bootstrap_three_up", _wait_for(
+            lambda: len(up_workers()) == 3, timeout=180))
+        check("bootstrap_router_eligible",
+              router.wait_eligible(3, timeout=30))
+        scale_up_latency_s = time.monotonic() - t_start
+
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]
+        spec = {"prompt": prompt, "max_new_tokens": 24}
+
+        # greedy oracle: all workers hold identical seed-0 params, so
+        # any one of them is the bit-exact reference
+        any_port = up_workers()[0].handle.port
+        st, body = serve._post(any_port, spec)
+        oracle = body.get("tokens") if st == 200 else None
+        check("oracle", st == 200 and len(oracle) == 24)
+        ok, toks = run_routed({**spec, "request_id": "flt-0"})
+        check("routed_bit_identical", ok and toks == oracle)
+
+        # seed every worker's radix cache so the eventual drain victim
+        # has hot prefixes to relocate
+        for w in up_workers():
+            serve._post(w.handle.port, spec)
+
+        # ---- drill 1: SIGKILL a worker holding an in-flight stream ----
+        killed: dict = {}
+
+        def kill_at(i, row) -> None:
+            if i == 4 and not killed:
+                busy = None
+                for nm, rep in router.replicas.items():
+                    with rep._mu:
+                        if rep.inflight > 0:
+                            busy = nm
+                            break
+                for w in up_workers():
+                    if w.router_name == busy:
+                        killed["worker"] = w.name
+                        fchaos.kill_worker(w.handle)
+                        return
+                # stream placed before our snapshot: kill any up worker
+                w = up_workers()[0]
+                killed["worker"] = w.name
+                fchaos.kill_worker(w.handle)
+
+        t_kill = time.monotonic()
+        st, rows = _stream_post(rs.port,
+                                {**spec, "request_id": "flt-kill",
+                                 "stream": True}, on_token=kill_at)
+        toks = [r["token"] for r in rows if r.get("event") == "token"]
+        done = [r for r in rows if r.get("event") == "done"]
+        check("kill_exactly_once_bit_identical",
+              st == 200 and killed and len(done) == 1
+              and done[0]["replays"] == 1 and done[0]["tokens"] == toks
+              and toks == oracle)
+        check("kill_replaced_within_budget", _wait_for(
+            lambda: (ctl.decisions().get("replace", 0) == 1
+                     and len(up_workers()) == 3
+                     and all(w.name != killed.get("worker")
+                             for w in up_workers())), timeout=180))
+        replace_latency_s = time.monotonic() - t_kill
+        check("kill_router_reconverged", router.wait_eligible(3,
+                                                              timeout=30))
+        check("replace_histogram_counted",
+              fleet_prom("picotron_fleet_replace_seconds_count") == 1)
+
+        # ---- drill 2: stall the scrape — stale is NOT dead ----
+        victim = up_workers()[0]
+        fchaos.stall_scrape(victim.name)
+        stall_fails0 = fleet_prom("picotron_fleet_scrape_failures_total")
+        time.sleep(fcfg.scrape_interval_s * 8)  # >> hysteresis ticks
+        with ctl._mu:
+            still_up = ctl.workers.get(victim.name)
+            still_up = still_up is not None and still_up.state == "up"
+        check("scrape_stall_not_death",
+              still_up and ctl.decisions().get("replace", 0) == 1
+              and fleet_prom("picotron_fleet_scrape_failures_total")
+              > stall_fails0)
+        fchaos.unstall_scrape(victim.name)
+        ok, toks = run_routed({**spec, "request_id": "flt-stall"})
+        check("scrape_stall_serving_unaffected", ok and toks == oracle)
+
+        # ---- drill 3: admission spike → grow within cooloff ----
+        fchaos.inject_spike(10)
+        n_spike = fchaos.take_spike()
+        grow0 = ctl.decisions().get("grow", 0)
+        t_spike = time.monotonic()
+        spike_done: list = []
+        spike_ttfts: list = []
+
+        def spike_one(i: int) -> None:
+            t0 = time.monotonic()
+            first: dict = {}
+
+            def on_tok(j, row):
+                if j == 0:
+                    first["t"] = time.monotonic() - t0
+
+            ok, toks = run_routed({**spec,
+                                   "request_id": f"flt-spike-{i}"})
+            spike_done.append(ok and toks == oracle)
+            if first.get("t") is not None:
+                spike_ttfts.append(first["t"])
+
+        threads = [threading.Thread(target=spike_one, args=(i,))
+                   for i in range(n_spike)]
+        for t in threads:
+            t.start()
+        grew = _wait_for(
+            lambda: ctl.decisions().get("grow", 0) > grow0, timeout=30)
+        grow_decision_s = time.monotonic() - t_spike
+        for t in threads:
+            t.join(timeout=300)
+        check("spike_grow_decision", grew)
+        # the slack term absorbs scheduler starvation on a loaded small
+        # box (the spike itself steals the tick thread's CPU) — what the
+        # check pins is that the grow lands DURING the spike, promptly
+        # after the cooloff gate opens, not after the load has passed
+        check("spike_grow_within_cooloff_window",
+              grew and grow_decision_s
+              <= fcfg.cooloff_s + 20 * fcfg.scrape_interval_s + 8.0)
+        check("spike_nothing_shed",
+              len(spike_done) == n_spike and all(spike_done)
+              and router.stats()["requests"]["shed"] == 0)
+        # the histogram count is MONOTONIC (bootstrap seeded it at 3):
+        # polling len(up_workers()) >= 4 instead would race the drain
+        # rung, which may take the grown worker back down the moment the
+        # spike's load falls — before this thread ever observes 4 up
+        check("spike_worker_joined", _wait_for(
+            lambda: fleet_prom(
+                "picotron_fleet_scale_up_seconds_count") >= 4,
+            timeout=180))
+
+        # ---- drill 4: scale-down drain loses zero in-flight ----
+        # keep a trickle of live requests flowing while the controller
+        # drains back to min_workers; every one must complete
+        trickle_stop = threading.Event()
+        trickle_ok: list = []
+
+        def trickle() -> None:
+            i = 0
+            while not trickle_stop.is_set():
+                ok, toks = run_routed(
+                    {**spec, "request_id": f"flt-trk-{i}"})
+                trickle_ok.append(ok and toks == oracle)
+                i += 1
+
+        tt = threading.Thread(target=trickle)
+        tt.start()
+        drained = _wait_for(
+            lambda: (ctl.decisions().get("drain", 0) >= 1
+                     and len(up_workers()) == 3), timeout=120)
+        trickle_stop.set()
+        tt.join(timeout=300)
+        check("scale_down_drained", drained)
+        check("drain_zero_inflight_lost",
+              len(trickle_ok) > 0 and all(trickle_ok))
+        check("drain_deregistered", _wait_for(
+            lambda: len(router.replicas) == 3, timeout=30))
+        check("drain_prefix_export",
+              fleet_prom("picotron_fleet_prefix_exports_total") >= 1)
+
+        # ---- accounting: every decision counted, nothing exhausted ----
+        d = ctl.decisions()
+        check("decision_accounting",
+              d.get("replace", 0) == 1 and d.get("grow", 0) >= 1
+              and d.get("drain", 0) >= 1
+              and d.get("replace_exhausted", 0) == 0)
+        check("workers_gauge",
+              fleet_prom("picotron_fleet_workers") == 3.0)
+        for err in client_errors[:5]:
+            print(f"fleet-chaos-smoke: client error: {err}", flush=True)
+        check("zero_client_errors", not client_errors)
+        print(json.dumps({
+            "scale_up_latency_s": round(scale_up_latency_s, 3),
+            "replace_latency_s": round(replace_latency_s, 3),
+            "grow_decision_s": round(grow_decision_s, 3),
+            "spike_requests": n_spike,
+        }), flush=True)
+    finally:
+        ctl.stop(drain_workers=True)
+        rs.stop()
+    return 1 if fail else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="elastic fleet controller over serve.py workers "
+                    "(scrape/decide/actuate: grow, drain, replace "
+                    "against a router's dynamic replica set)")
+    ap.add_argument("--router", default="", metavar="HOST:PORT",
+                    help="router admin address (POST/DELETE /replicas)")
+    ap.add_argument("--config", default="",
+                    help="serve.py experiment config JSON for launched "
+                         "workers")
+    ap.add_argument("--fleet-config", default="",
+                    help="JSON file of FleetConfig overrides")
+    ap.add_argument("--roles", default="both",
+                    help="comma-separated roles to manage "
+                         "(both | prefill,decode)")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--smoke", action="store_true",
+                    help="in-process kill/spike/stall/drain chaos drill "
+                         "(the `make fleet-chaos-smoke` target)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        rc = _smoke()
+        print(f"fleet-chaos-smoke: {'PASS' if rc == 0 else 'FAIL'}",
+              flush=True)
+        return rc
+
+    if not args.router or not args.config:
+        raise SystemExit("pass --router HOST:PORT and --config "
+                         "CONFIG.json (or --smoke)")
+    host, _, port = args.router.rpartition(":")
+    if not host or not port:
+        raise SystemExit(f"--router must be HOST:PORT, got "
+                         f"{args.router!r}")
+    if args.fleet_config:
+        with open(args.fleet_config) as f:
+            fcfg = FleetConfig.from_dict(json.load(f))
+    else:
+        fcfg = FleetConfig()
+    launcher = SubprocessLauncher(args.config, slots=args.slots)
+    ctl = FleetController(
+        fcfg, launcher, RouterAdmin(host, int(port)),
+        roles=tuple(r.strip() for r in args.roles.split(",") if r.strip()))
+    ctl.start()
+    ctl._event("fleet", router=args.router, roles=list(ctl.roles))
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        ctl.stop(drain_workers=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

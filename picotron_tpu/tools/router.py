@@ -94,11 +94,17 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import unquote
 
 from picotron_tpu.config import RouterConfig
 from picotron_tpu.obs import GLOBAL_REGISTRY, Obs
 from picotron_tpu.obs.metrics import parse_prometheus
 from picotron_tpu.resilience.retry import retry
+
+
+class DuplicateReplica(ValueError):
+    """A dynamic registration named a replica already in the set (the
+    admin API's 409, distinct from a malformed spec's 400)."""
 
 
 class ReplicaFailure(Exception):
@@ -280,6 +286,11 @@ class Replica:
         self.host = host
         self.port = int(port)
         self._mu = threading.Lock()
+        # set when this replica leaves the set (deregistered by the admin
+        # API) or the router stops: the prober's sleep/ladder waits on it,
+        # so removal interrupts even a breaker-open reprobe backoff
+        self.gone = threading.Event()
+        self._prober: Optional[threading.Thread] = None
         self.breaker = "closed"  # closed | open | half_open
         self.fails = 0  # consecutive hard failures
         self.okays = 0  # consecutive clean probes (half-open recovery)
@@ -321,19 +332,19 @@ class Router:
 
     def __init__(self, replicas, cfg: Optional[RouterConfig] = None, *,
                  obs: Optional[Obs] = None, chaos=None, log=print,
-                 clock=time.monotonic):
+                 clock=time.monotonic, allow_empty: bool = False):
         self.cfg = cfg or RouterConfig()
         self.cfg.validate()
         self.replicas: dict = {}
         for spec in replicas:
-            if isinstance(spec, str):
-                host, _, port = spec.rpartition(":")
-                spec = (f"{host}:{port}", host, int(port))
-            name, host, port = spec
+            name, host, port = self._parse_spec(spec)
             if name in self.replicas:
                 raise ValueError(f"duplicate replica name {name!r}")
             self.replicas[name] = Replica(name, host, port)
-        if not self.replicas:
+        if not self.replicas and not allow_empty:
+            # allow_empty is the elastic bootstrap (tools/fleet.py): the
+            # fleet controller starts an empty router and registers
+            # workers through the admin API as they come up
             raise ValueError("router needs at least one replica")
         self.chaos = chaos
         self.obs = obs or Obs(enabled=True)
@@ -375,23 +386,116 @@ class Router:
         self._rid_mu = threading.Lock()
         self._rid_seq = 0
         self._stop = threading.Event()
+        # replica-set mutation lock (leaf: pure dict copy-and-swap under
+        # it, never I/O, never another lock). Reads DON'T take it: every
+        # reader iterates whatever dict object self.replicas bound at
+        # that moment, and mutations swap in a fresh dict (copy-on-write)
+        # rather than mutating the one readers may be iterating.
+        self._set_mu = threading.Lock()
+        self._started = False
         self._threads: list = []
         self._start_t = clock()
+
+    @staticmethod
+    def _parse_spec(spec) -> tuple:
+        """(name, host, port) from a replica spec: "host:port" (the name
+        IS the address) or a (name, host, port) tuple. Raises ValueError
+        on malformed input — the admin API's 400."""
+        if isinstance(spec, str):
+            host, _, port = spec.rpartition(":")
+            if not host or not port:
+                raise ValueError(
+                    f"replica spec must be HOST:PORT, got {spec!r}")
+            spec = (f"{host}:{port}", host, port)
+        name, host, port = spec
+        return str(name), str(host), int(port)
 
     # ---- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
-        for rep in self.replicas.values():
-            t = threading.Thread(target=self._probe_loop, args=(rep,),
-                                 name=f"router-probe-{rep.name}",
-                                 daemon=True)
-            t.start()
+        with self._set_mu:
+            self._started = True
+            reps = list(self.replicas.values())
+        for rep in reps:
+            self._spawn_prober(rep)
+
+    def _spawn_prober(self, rep: Replica) -> None:
+        t = threading.Thread(target=self._probe_loop, args=(rep,),
+                             name=f"router-probe-{rep.name}",
+                             daemon=True)
+        rep._prober = t
+        with self._set_mu:
             self._threads.append(t)
+        t.start()
 
     def stop(self) -> None:
         self._stop.set()
-        for t in self._threads:
+        with self._set_mu:
+            reps = list(self.replicas.values())
+            threads = list(self._threads)
+        for rep in reps:
+            rep.gone.set()  # wake probers parked in per-replica sleeps
+        for t in threads:
             t.join(timeout=10)
+
+    # ---- dynamic replica set (the fleet controller's admin surface) -------
+
+    def add_replica(self, spec) -> Replica:
+        """Register one replica at runtime (the POST /replicas surface).
+        The set swap is copy-on-write under ``_set_mu`` so in-progress
+        candidate scans never see a mutating dict; the new replica gets
+        its prober thread immediately when the router is running. The
+        rendezvous hash re-ranks automatically — affinity owners are
+        recomputed per placement over the live set. Raises
+        ``DuplicateReplica`` (409) on a name collision, ``ValueError``
+        (400) on a malformed spec."""
+        name, host, port = self._parse_spec(spec)
+        rep = Replica(name, host, port)
+        with self._set_mu:
+            if name in self.replicas:
+                raise DuplicateReplica(f"replica {name!r} already "
+                                       f"registered")
+            replicas = dict(self.replicas)
+            replicas[name] = rep
+            self.replicas = replicas
+            started = self._started
+        if started:
+            self._spawn_prober(rep)
+        self.registry.counter(
+            "picotron_router_replica_set_total",
+            "dynamic replica-set mutations", op="add").inc()
+        self._event("replica_add", replica=name, addr=f"{host}:{port}")
+        return rep
+
+    def remove_replica(self, name: str, join_timeout: float = 10.0) -> dict:
+        """Deregister one replica at runtime (the DELETE /replicas/<name>
+        surface). Safe mid-stream: in-flight routes hold the Replica
+        OBJECT, which stays valid — they finish (or fail over) on their
+        own; only new placements stop seeing it. The prober thread is
+        woken through ``rep.gone`` (it interrupts even a breaker-open
+        backoff ladder) and joined, and the breaker/inflight state dies
+        with the object — nothing leaks. Raises KeyError when unknown
+        (the admin API's 404). Returns the final snapshot."""
+        with self._set_mu:
+            rep = self.replicas.get(name)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            replicas = dict(self.replicas)
+            del replicas[name]
+            self.replicas = replicas
+        rep.gone.set()
+        t = rep._prober
+        if t is not None:
+            t.join(timeout=join_timeout)
+            with self._set_mu:
+                if t in self._threads:
+                    self._threads.remove(t)
+        self.registry.counter(
+            "picotron_router_replica_set_total",
+            "dynamic replica-set mutations", op="remove").inc()
+        self._event("replica_remove", replica=name,
+                    prober_joined=t is None or not t.is_alive())
+        return rep.snapshot(self._clock())
 
     def wait_eligible(self, n: int = 1, timeout: float = 30.0) -> bool:
         """Block until >= n replicas are placeable (startup convenience for
@@ -404,8 +508,13 @@ class Router:
                 return False
         return False
 
-    def _sleep(self, seconds: float) -> None:
-        if self._stop.wait(seconds):
+    def _sleep(self, seconds: float, rep: Optional[Replica] = None) -> None:
+        """Interruptible sleep. With ``rep``, waits on that replica's
+        ``gone`` event so a deregistration wakes its prober even out of
+        a breaker-open backoff ladder; either wake source (gone or
+        router stop) raises ``_Stopped``."""
+        ev = self._stop if rep is None else rep.gone
+        if ev.wait(seconds) or self._stop.is_set():
             raise _Stopped()
 
     def _event(self, evt: str, **fields) -> None:
@@ -421,14 +530,14 @@ class Router:
 
     def _probe_loop(self, rep: Replica) -> None:
         try:
-            while not self._stop.is_set():
+            while not self._stop.is_set() and not rep.gone.is_set():
                 try:
                     self._probe_once(rep)
                 except ReplicaFailure as e:
                     if self._probe_fail(rep, str(e)):
                         self._reprobe_open(rep)
                         continue
-                self._sleep(self.cfg.probe_interval_s)
+                self._sleep(self.cfg.probe_interval_s, rep)
         except _Stopped:
             pass
 
@@ -541,9 +650,9 @@ class Router:
         def capped_sleep(d: float) -> None:
             # retry()'s raw exponential has no cap of its own: clamp
             # every inter-reprobe delay at the configured ceiling
-            self._sleep(min(d, self.cfg.breaker_backoff_max_s))
+            self._sleep(min(d, self.cfg.breaker_backoff_max_s), rep)
 
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not rep.gone.is_set():
             try:
                 retry(lambda: self._probe_once(rep),
                       attempts=self.cfg.breaker_probe_attempts,
@@ -553,7 +662,7 @@ class Router:
                       sleep=capped_sleep)
                 return
             except ReplicaFailure:
-                self._sleep(self.cfg.breaker_backoff_max_s)
+                self._sleep(self.cfg.breaker_backoff_max_s, rep)
 
     def _request_success(self, rep: Replica) -> None:
         closed = False
@@ -1187,11 +1296,15 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif self.path == "/tracez":
             self._json(200, r.obs.tracer.chrome_trace())
+        elif self.path == "/replicas":
+            now = r._clock()
+            self._json(200, {name: rep.snapshot(now)
+                             for name, rep in sorted(r.replicas.items())})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self) -> None:
-        if self.path != "/generate":
+        if self.path not in ("/generate", "/replicas"):
             self._json(404, {"error": f"unknown path {self.path}"})
             return
         try:
@@ -1211,6 +1324,9 @@ class _Handler(BaseHTTPRequestHandler):
         if not isinstance(spec, dict):
             self._json(400, {"error": "request body must be a JSON object"})
             return
+        if self.path == "/replicas":
+            self._add_replica(spec)
+            return
         r = self.router
         rid = str(spec.get("request_id") or r._next_rid())
         if spec.get("stream"):
@@ -1227,6 +1343,42 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             status = 500 if payload["finish_reason"] == "error" else 200
             self._json(status, payload)
+
+    def _add_replica(self, spec: dict) -> None:
+        """POST /replicas — the fleet controller's registration surface.
+        Body: {"replica": "host:port"} or {"replica": {"name", "host",
+        "port"}}. 200 with the new snapshot, 409 on a duplicate name,
+        400 on a malformed spec."""
+        raw = spec.get("replica")
+        if isinstance(raw, dict):
+            try:
+                raw = (raw.get("name") or f"{raw['host']}:{raw['port']}",
+                       raw["host"], raw["port"])
+            except KeyError as e:
+                self._json(400, {"error": f"replica spec missing {e}"})
+                return
+        try:
+            rep = self.router.add_replica(raw)
+        except DuplicateReplica as e:
+            self._json(409, {"error": str(e)})
+            return
+        except (ValueError, TypeError) as e:
+            self._json(400, {"error": f"bad replica spec: {e}"})
+            return
+        self._json(200, {"ok": True, "replica": rep.name,
+                         **rep.snapshot(self.router._clock())})
+
+    def do_DELETE(self) -> None:
+        if not self.path.startswith("/replicas/"):
+            self._json(404, {"error": f"unknown path {self.path}"})
+            return
+        name = unquote(self.path[len("/replicas/"):])
+        try:
+            snap = self.router.remove_replica(name)
+        except KeyError:
+            self._json(404, {"error": f"unknown replica {name!r}"})
+            return
+        self._json(200, {"ok": True, "replica": name, **snap})
 
     def _stream(self, spec: dict, rid: str) -> None:
         """NDJSON splice: the header is deferred until the route either

@@ -219,6 +219,13 @@ class FrontEnd:
         # higher-class arrival 429s.
         self.tenants = tenants
         self.draining = False
+        # leaf lock for the drain flag: POST /drain handler threads, the
+        # dispatch loop's guard check, and drain_and_join all race on it;
+        # drain_begins counts WINNING initiations (the regression surface
+        # for a double-run of the drain machinery — it must stay 1 when
+        # SIGTERM lands during an HTTP-initiated drain)
+        self._drain_mu = threading.Lock()
+        self.drain_begins = 0
         self.stopped = threading.Event()  # dispatch loop has exited
         self.dead = False  # loop died on an exception (vs clean drain)
         self.stalled = False
@@ -253,13 +260,42 @@ class FrontEnd:
             t.start()
             self._threads.append(t)
 
-    def begin_drain(self) -> None:
+    def begin_drain(self) -> bool:
         """Stop admitting, shed the unstarted queue, finish in-flight
-        slots, then stop the dispatch loop (readiness goes 503 at once)."""
-        if not self.draining:
+        slots, then stop the dispatch loop (readiness goes 503 at once).
+        Idempotent AND race-free: POST /drain (a fleet controller's
+        scale-down) and the PreemptionGuard's SIGTERM path can land
+        concurrently — exactly one caller wins the flag under the leaf
+        lock, so the drain machinery (the event, the shed, the eventual
+        exit) runs once no matter how many initiators fire. Returns
+        whether THIS caller won the initiation."""
+        with self._drain_mu:
+            first = not self.draining
             self.draining = True
+            if first:
+                self.drain_begins += 1
+        if first:
             self._event("drain_begin")
         self._wake.set()
+        return first
+
+    def drain(self) -> dict:
+        """POST /drain: the fleet controller's scale-down surface —
+        start a graceful drain over HTTP (readyz flips to "draining" at
+        once, in-flight finishes, the process exits 0 exactly as a
+        SIGTERM drain would). 409 when there is nothing to start: the
+        loop already exited (dead OR drained — no second drain can run)
+        or a drain already owns the flag (the first initiator holds the
+        contract; a controller seeing 409 treats the drain as already
+        under way)."""
+        if self.dead or self.stopped.is_set():
+            raise AdmissionError(
+                409, "dispatch loop already exited", retry_after=0,
+                state="dead" if self.dead else "stopped")
+        if not self.begin_drain():
+            raise AdmissionError(409, "already draining", retry_after=0,
+                                 state="draining")
+        return {"ok": True, "state": "draining"}
 
     def join(self, timeout: Optional[float] = None) -> None:
         self.stopped.wait(timeout)
@@ -676,6 +712,28 @@ class FrontEnd:
             self._mu.release()
         return {"matched": len(payload["token_ids"]), "kv": payload}
 
+    def kv_prefixes(self, limit: int = 4) -> dict:
+        """GET /kv/prefixes: enumerate this replica's hottest radix-cached
+        prefixes (token ids + owning tenant), hottest first — the surface
+        a fleet controller's drain-time cache handoff walks (each entry
+        round-trips /kv/pages here -> /kv/import at a survivor, so a
+        drained worker's cache is not lost to the cluster). Bounded lock
+        acquire like every scrape-plane surface: a wedged dispatch makes
+        this degrade to 503, never deadlock."""
+        self._require_paged()
+        if limit < 1:
+            raise AdmissionError(400, f"limit must be >= 1, got {limit}",
+                                 retry_after=0)
+        if not self._mu.acquire(timeout=10.0):
+            raise AdmissionError(503, "dispatch stalled (enumeration "
+                                      "unavailable)", retry_after=10)
+        try:
+            entries = self.engine.paged.radix.cached_prefixes(limit)
+        finally:
+            self._mu.release()
+        return {"prefixes": [{"ids": list(ids), "tenant": salt or None}
+                             for salt, ids in entries]}
+
     # ---- dispatch loop ----------------------------------------------------
 
     def _on_token(self, uid: str, tok: int) -> None:
@@ -919,6 +977,21 @@ class _Handler(BaseHTTPRequestHandler):
                     ids, tenant=q.get("tenant", [None])[0]))
             except AdmissionError as e:
                 self._json(e.status, {"error": e.reason, **e.extra})
+        elif self.path.startswith("/kv/prefixes"):
+            # GET /kv/prefixes?limit=N — the drain-time cache handoff's
+            # enumeration surface (tools/fleet.py)
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                limit = int(q.get("limit", ["4"])[0])
+            except ValueError as e:
+                self._json(400, {"error": f"bad limit: {e}"})
+                return
+            try:
+                self._json(200, f.kv_prefixes(limit))
+            except AdmissionError as e:
+                self._json(e.status, {"error": e.reason, **e.extra})
         elif self.path == "/tenants":
             try:
                 self._json(200, f.tenants_snapshot())
@@ -961,7 +1034,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:
         if self.path not in ("/generate", "/profilez", "/kv/export",
-                             "/kv/import", "/kv/pages", "/tenants"):
+                             "/kv/import", "/kv/pages", "/tenants",
+                             "/drain"):
             self._json(404, {"error": f"unknown path {self.path}"})
             return
         try:
@@ -990,6 +1064,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/profilez":
             self._profilez(spec)
+            return
+        if self.path == "/drain":
+            try:
+                self._json(202, self.front.drain())
+            except AdmissionError as e:
+                self._json(e.status, {"error": e.reason, **e.extra})
             return
         if self.path in ("/kv/export", "/kv/import", "/kv/pages",
                          "/tenants"):

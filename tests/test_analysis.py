@@ -1363,3 +1363,84 @@ def test_rule_catalog_is_stable():
         "PICO-C001", "PICO-C002", "PICO-C003", "PICO-C004"}
     for rule in RULES.values():
         assert rule.title and rule.rationale
+
+
+# --------------------------------------------------------------------------- #
+# fleet-controller thread fixture (ISSUE 17): the tools/fleet.py locking
+# discipline — leaf ``_mu`` for worker STATE only, every scrape/launch
+# I/O outside it — modeled as a lint fixture so the discipline that keeps
+# ``make lint`` clean with an empty baseline is itself pinned by a test.
+# --------------------------------------------------------------------------- #
+
+_FLEET_CLEAN = """
+    import threading
+    import time
+
+    class Controller:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._workers = {}
+            self._stop = threading.Event()
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+        def _run(self):
+            while not self._stop.wait(0.05):
+                self.tick()
+
+        def _scrape(self, name):
+            time.sleep(0.01)  # stands in for the HTTP metrics scrape
+            return {"queue_depth": 0.0}
+
+        def tick(self):
+            with self._mu:
+                names = list(self._workers)
+            scrapes = {n: self._scrape(n) for n in names}
+            with self._mu:
+                for n, s in scrapes.items():
+                    if n in self._workers:
+                        self._workers[n] = s
+
+        def stop(self):
+            self._stop.set()
+            t = self._thread
+            if t is not None:
+                t.join(timeout=5)
+    """
+
+
+def test_fleet_controller_thread_pattern_scans_clean(tmp_path):
+    """The controller idiom — snapshot names under ``_mu``, scrape with
+    the lock RELEASED, re-take it to apply — produces zero findings: the
+    pattern tools/fleet.py ships with an empty baseline."""
+    assert _scan(tmp_path, _FLEET_CLEAN) == []
+
+
+def test_fleet_controller_scrape_under_lock_is_caught(tmp_path):
+    """The tempting shortcut — scraping each worker while still holding
+    ``_mu`` — is exactly the hazard C002's one-hop propagation exists
+    for: the tick thread would serialize every HTTP round-trip against
+    the admin/stop paths."""
+    found = _scan(tmp_path, """
+        import threading
+        import time
+
+        class Controller:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._workers = {}
+
+            def _scrape(self, name):
+                time.sleep(0.01)  # the HTTP round-trip
+                return {"queue_depth": 0.0}
+
+            def tick(self):
+                with self._mu:
+                    for name in list(self._workers):
+                        self._workers[name] = self._scrape(name)
+        """)
+    assert _rules(found) == ["PICO-C002"]
+    assert "_scrape" in found[0].message

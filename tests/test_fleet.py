@@ -1,0 +1,373 @@
+"""Elastic fleet controller suite (ISSUE 17; docs/SERVING.md "Elastic
+fleet").
+
+Unit layers first — the decision ladder (replace before grow before
+drain), watermark hysteresis and cooloff, the budget-gated replacement
+ladder, and the stale-is-not-dead scrape discipline — driven with fake
+launchers/admins and a fake clock so every transition is exact; then the
+admin-plane integration: the real HTTP ``RouterAdmin`` against a live
+``RouterServer`` (register / 409-idempotent / deregister / 404), and the
+``DirectRouterAdmin`` in-process seam. The full chaos acceptance
+(SIGKILL-under-load replacement with bit-identical replay, spike-driven
+grow, zero-loss drain) is ``make fleet-chaos-smoke``.
+"""
+
+import threading
+import time
+
+import pytest
+
+from picotron_tpu.config import FleetConfig, RouterConfig
+from picotron_tpu.resilience.chaos import FleetChaos
+from picotron_tpu.tools.fleet import (
+    DirectRouterAdmin,
+    FleetController,
+    RouterAdmin,
+    _req_json,
+)
+from picotron_tpu.tools.router import Router, RouterServer
+
+
+# --------------------------------------------------------------------------- #
+# fakes
+# --------------------------------------------------------------------------- #
+
+
+class FakeHandle:
+    """A worker handle whose liveness the test scripts directly. The
+    port is unroutable-fast (connection refused), so controller code
+    paths that tolerate a dead listener get exercised for real."""
+
+    def __init__(self):
+        self.host = "127.0.0.1"
+        self.port = 1
+        self.live = True
+        self.calls = []
+
+    def alive(self):
+        return self.live
+
+    def kill(self):
+        self.calls.append("kill")
+        self.live = False
+
+    def terminate(self):
+        self.calls.append("terminate")
+
+    def wait(self, timeout=None):
+        self.calls.append("wait")
+        self.live = False
+        return True
+
+
+class FakeLauncher:
+    def __init__(self, fail_first=0):
+        self.fail_first = fail_first
+        self.launched = []
+        self.handles = {}
+
+    def launch(self, name, role):
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            raise RuntimeError("launch quota")
+        h = FakeHandle()
+        self.launched.append((name, role))
+        self.handles[name] = h
+        return h
+
+
+class FakeAdmin:
+    def __init__(self):
+        self.registered = []
+        self.deregistered = []
+
+    def register(self, host, port):
+        name = f"{host}:{port}"
+        self.registered.append(name)
+        return name
+
+    def deregister(self, name):
+        self.deregistered.append(name)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _fcfg(**kw):
+    base = dict(scrape_interval_s=0.01, scrape_timeout_s=0.2,
+                hysteresis=2, cooloff_s=10.0, queue_high=1.0,
+                queue_low=0.5, pool_high=0.9, pool_low=0.3,
+                min_workers=1, max_workers=4, max_replaces=2,
+                replace_backoff_s=0.5, replace_backoff_max_s=4.0,
+                healthy_reset_s=1e9, launch_attempts=1,
+                drain_timeout_s=5.0, export_prefixes=False)
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def _ctl(n=2, clock=None, chaos=None, **cfg_kw):
+    """A controller with ``n`` workers already up, tick-driven by the
+    test (no control thread started)."""
+    clock = clock or Clock()
+    launcher = FakeLauncher()
+    admin = FakeAdmin()
+    ctl = FleetController(_fcfg(**cfg_kw), launcher, admin, chaos=chaos,
+                          log=lambda *a, **k: None, clock=clock)
+    for _ in range(n):
+        ctl._spawn_launch("both", "bootstrap", clock())
+    _join_actuation(ctl)
+    assert len(_up(ctl)) == n
+    return ctl, launcher, admin, clock
+
+
+def _join_actuation(ctl, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    for t in list(ctl._threads):
+        t.join(timeout=max(0.01, deadline - time.monotonic()))
+        assert not t.is_alive(), f"actuation thread {t.name} wedged"
+
+
+def _up(ctl):
+    with ctl._mu:
+        return [w for w in ctl.workers.values() if w.state == "up"]
+
+
+def _feed(ctl, **scrape):
+    """Script every up worker's next scrape reading."""
+    vals = {"queue": 0.0, "pool": 0.0, "active": 0.0, "ttft_p95": 0.0,
+            "draining": False, **scrape}
+    ctl._scrape = lambda w: ("ok", dict(vals))
+
+
+# --------------------------------------------------------------------------- #
+# config
+# --------------------------------------------------------------------------- #
+
+
+def test_fleet_config_validation():
+    FleetConfig().validate()  # defaults are a valid config
+    for field, bad in [("hysteresis", 0), ("min_workers", 0),
+                      ("max_workers", 0), ("scrape_interval_s", 0.0),
+                      ("queue_high", -1.0), ("max_replaces", -1),
+                      ("launch_attempts", 0)]:
+        cfg = FleetConfig(**{field: bad})
+        with pytest.raises(ValueError, match=f"fleet.{field}"):
+            cfg.validate()
+    with pytest.raises(ValueError, match="fleet.queue_low"):
+        FleetConfig(queue_high=1.0, queue_low=2.0).validate()
+    with pytest.raises(ValueError, match="fleet.max_workers"):
+        FleetConfig(min_workers=4, max_workers=2).validate()
+
+
+def test_fleet_config_from_dict_filters_and_validates():
+    cfg = FleetConfig.from_dict({"queue_high": 12.0, "not_a_field": 1})
+    assert cfg.queue_high == 12.0
+    with pytest.raises(ValueError, match="fleet.hysteresis"):
+        FleetConfig.from_dict({"hysteresis": 0})
+
+
+# --------------------------------------------------------------------------- #
+# the decision ladder
+# --------------------------------------------------------------------------- #
+
+
+def test_grow_needs_sustained_breach_not_one_tick():
+    ctl, launcher, admin, clk = _ctl(2)
+    _feed(ctl, queue=5.0)
+    ctl.tick()  # one high tick: streak 1 < hysteresis 2
+    assert ctl.decisions().get("grow", 0) == 0
+    _feed(ctl, queue=0.0)
+    ctl.tick()  # breach not sustained: streak resets
+    _feed(ctl, queue=5.0)
+    ctl.tick()
+    assert ctl.decisions().get("grow", 0) == 0
+    ctl.tick()  # second consecutive high tick: grow
+    _join_actuation(ctl)
+    assert ctl.decisions().get("grow", 0) == 1
+    assert len(_up(ctl)) == 3
+    assert len(admin.registered) == 3
+
+
+def test_grow_respects_cooloff_and_max_workers():
+    ctl, launcher, admin, clk = _ctl(2, max_workers=4)
+    _feed(ctl, queue=5.0)
+    ctl.tick()
+    ctl.tick()
+    _join_actuation(ctl)
+    assert len(_up(ctl)) == 3
+    # still breaching, but inside the cooloff window: no second grow
+    ctl.tick()
+    ctl.tick()
+    ctl.tick()
+    assert ctl.decisions().get("grow", 0) == 1
+    clk.t += ctl.cfg.cooloff_s  # cooloff elapses -> the ladder re-arms
+    ctl.tick()
+    ctl.tick()
+    _join_actuation(ctl)
+    assert ctl.decisions().get("grow", 0) == 2
+    assert len(_up(ctl)) == 4
+    # at max_workers: sustained breach no longer grows
+    clk.t += ctl.cfg.cooloff_s
+    for _ in range(4):
+        ctl.tick()
+    assert ctl.decisions().get("grow", 0) == 2
+
+
+def test_dead_worker_replaced_without_waiting_for_cooloff():
+    """Rung 1 is budget-gated, never cooloff-gated: capacity loss right
+    after a scale decision must not wait out the cooloff window."""
+    ctl, launcher, admin, clk = _ctl(2)
+    _feed(ctl, queue=5.0)
+    ctl.tick()
+    ctl.tick()  # grow fires -> cooloff stamp is NOW
+    _join_actuation(ctl)
+    victim = _up(ctl)[0]
+    victim.handle.live = False  # SIGKILL flavor: process gone
+    ctl.tick()  # same instant as the grow: replace still decided
+    assert ctl.decisions().get("replace", 0) == 1
+    assert admin.deregistered == [victim.router_name]
+    clk.t += ctl.cfg.replace_backoff_s  # the ladder's first delay
+    ctl.tick()
+    _join_actuation(ctl)
+    assert len(_up(ctl)) == 3  # replacement landed (2 + the grow)
+
+
+def test_replace_budget_exhaustion_stops_the_crash_loop():
+    ctl, launcher, admin, clk = _ctl(1, max_replaces=1, min_workers=1)
+    _up(ctl)[0].handle.live = False
+    ctl.tick()
+    assert ctl.decisions().get("replace", 0) == 1
+    clk.t += ctl.cfg.replace_backoff_s
+    ctl.tick()
+    _join_actuation(ctl)
+    assert len(_up(ctl)) == 1
+    # the replacement dies instantly: the budget (1) is spent
+    _up(ctl)[0].handle.live = False
+    ctl.tick()
+    assert ctl.decisions().get("replace_exhausted", 0) == 1
+    clk.t += 60.0
+    for _ in range(3):
+        ctl.tick()
+    _join_actuation(ctl)
+    assert len(_up(ctl)) == 0  # no relaunch storm past the budget
+
+
+def test_failed_launch_walks_the_same_budget_ladder():
+    clock = Clock()
+    launcher = FakeLauncher(fail_first=1)
+    admin = FakeAdmin()
+    ctl = FleetController(_fcfg(), launcher, admin,
+                          log=lambda *a, **k: None, clock=clock)
+    ctl._spawn_launch("both", "bootstrap", clock())
+    _join_actuation(ctl)
+    assert not _up(ctl)  # launch failed -> worker parked as "failed"
+    ctl.tick()  # rung 1 reaps it and schedules a budgeted retry
+    assert ctl.decisions().get("replace", 0) == 1
+    clock.t += ctl.cfg.replace_backoff_s
+    ctl.tick()
+    _join_actuation(ctl)
+    assert len(_up(ctl)) == 1  # the retry (launcher now succeeds) landed
+
+
+def test_scrape_stall_is_stale_never_dead():
+    """A wedged scrape plane (FleetChaos.stall_scrape) must not read as
+    worker death — no replacement storm off a monitoring failure."""
+    chaos = FleetChaos()
+    ctl, launcher, admin, clk = _ctl(2, chaos=chaos, hysteresis=2)
+    w = _up(ctl)[0]
+    chaos.stall_scrape(w.name)
+    # the OTHER worker scrapes "down" (port 1 refuses) and dies after
+    # hysteresis ticks; the STALLED one must survive indefinitely
+    other = _up(ctl)[1]
+    for _ in range(6):
+        ctl.tick()
+    with ctl._mu:
+        assert ctl.workers[w.name].state == "up"
+        assert w.down_fails == 0
+        assert other.name not in ctl.workers  # down IS death...
+    assert ctl.decisions().get("replace", 0) == 1  # ...for the other
+
+
+def test_drain_picks_least_loaded_and_respects_min_workers():
+    ctl, launcher, admin, clk = _ctl(3, min_workers=2)
+    # script per-worker scrapes: w3 is the idle one
+    loads = {w.name: 2.0 for w in _up(ctl)}
+    idle = _up(ctl)[2]
+    loads[idle.name] = 0.0
+    ctl._scrape = lambda w: ("ok", {
+        "queue": loads[w.name] * 0.1, "pool": 0.0,
+        "active": loads[w.name], "ttft_p95": 0.0, "draining": False})
+    ctl.tick()
+    ctl.tick()
+    _join_actuation(ctl)
+    assert ctl.decisions().get("drain", 0) == 1
+    with ctl._mu:
+        assert idle.name not in ctl.workers  # the idle one went
+    assert idle.handle.calls[0] == "terminate"  # stop armed before wait
+    assert "wait" in idle.handle.calls
+    assert admin.deregistered == [idle.router_name]
+    # at min_workers now: sustained idle never drains below the floor
+    clk.t += ctl.cfg.cooloff_s
+    for _ in range(4):
+        ctl.tick()
+    assert ctl.decisions().get("drain", 0) == 1
+    assert len(_up(ctl)) == 2
+
+
+def test_stop_with_drain_workers_tears_down_and_deregisters():
+    ctl, launcher, admin, clk = _ctl(2)
+    ctl.stop(drain_workers=True)
+    with ctl._mu:
+        assert not ctl.workers
+    assert len(admin.deregistered) == 2
+
+
+# --------------------------------------------------------------------------- #
+# admin plane
+# --------------------------------------------------------------------------- #
+
+
+def test_direct_router_admin_is_idempotent():
+    r = Router([], RouterConfig(), allow_empty=True,
+               log=lambda *a, **k: None)
+    admin = DirectRouterAdmin(r)
+    name = admin.register("10.0.0.9", 809)
+    assert name in r.replicas
+    assert admin.register("10.0.0.9", 809) == name  # duplicate: no-op
+    assert len(r.replicas) == 1
+    admin.deregister(name)
+    assert name not in r.replicas
+    admin.deregister(name)  # already gone: no-op
+
+
+def test_router_admin_http_register_409_deregister_404():
+    rs = RouterServer([], RouterConfig(probe_interval_s=0.05,
+                                       probe_timeout_s=0.2),
+                      allow_empty=True, log=lambda *a, **k: None)
+    rs.start()
+    try:
+        admin = RouterAdmin("127.0.0.1", rs.port)
+        name = admin.register("10.0.0.7", 807)
+        assert name == "10.0.0.7:807" and name in rs.router.replicas
+        assert admin.register("10.0.0.7", 807) == name  # 409 tolerated
+        assert set(admin.replicas()) == {name}
+        # raw-status checks under the tolerant client
+        st, body = _req_json("POST", "127.0.0.1", rs.port, "/replicas",
+                             {"replica": "10.0.0.7:807"})
+        assert st == 409
+        st, body = _req_json("POST", "127.0.0.1", rs.port, "/replicas",
+                             {"replica": "no-port"})
+        assert st == 400
+        st, body = _req_json("DELETE", "127.0.0.1", rs.port,
+                             "/replicas/never-was")
+        assert st == 404
+        admin.deregister(name)
+        assert name not in rs.router.replicas
+        admin.deregister(name)  # 404 tolerated: already the goal state
+    finally:
+        rs.stop()

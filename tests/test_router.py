@@ -485,3 +485,114 @@ def test_router_chaos_smoke_acceptance():
     from picotron_tpu.tools import router as rt
 
     assert rt.main(["--smoke"]) == 0
+
+
+# --------------------------------------------------------------------------- #
+# dynamic replica set (ISSUE 17: the fleet controller's admin surface)
+# --------------------------------------------------------------------------- #
+
+
+def test_remove_replica_joins_prober_and_readd_starts_breaker_fresh():
+    """Deregistering mid-stream must not strand the prober thread or leak
+    breaker state: the prober is woken through ``gone`` (even out of a
+    breaker-open reprobe ladder) and joined; the in-flight route's
+    Replica OBJECT stays usable; and re-adding the same address builds a
+    fresh closed-breaker replica — the old fails/inflight died with the
+    old object."""
+    r = _router(3)
+    r.start()  # probers run against fake addrs and fail; that's the point
+    try:
+        name = sorted(r.replicas)[0]
+        rep = r.replicas[name]
+        # dirty the state exactly as a mid-stream death would
+        with rep._mu:
+            rep.breaker = "open"
+            rep.fails = 7
+            rep.inflight = 2
+        snap = r.remove_replica(name)
+        assert snap["breaker"] == "open" and snap["inflight"] == 2
+        assert name not in r.replicas
+        assert rep.gone.is_set()
+        assert rep._prober is not None and not rep._prober.is_alive()
+        assert r.stats()["replicas"].get(name) is None
+        # an in-flight route still holds a valid object: bookkeeping on
+        # it keeps working after deregistration (it just isn't placeable)
+        with rep._mu:
+            rep.inflight -= 1
+        assert rep.snapshot(r._clock())["inflight"] == 1
+        # same address re-registered: nothing carried over
+        rep2 = r.add_replica(f"{rep.host}:{rep.port}")
+        assert rep2 is not rep
+        with rep2._mu:
+            assert rep2.breaker == "closed"
+            assert rep2.fails == 0 and rep2.inflight == 0
+        assert rep2._prober is not None and rep2._prober.is_alive()
+        with pytest.raises(router_mod.DuplicateReplica):
+            r.add_replica(f"{rep.host}:{rep.port}")
+    finally:
+        r.stop()
+
+
+def test_affinity_rehash_on_owner_removal_promotes_hrw_runner_up():
+    """Rendezvous pin: removing a prefix's affinity owner re-homes ONLY
+    that prefix (to the HRW runner-up over the survivors); prefixes owned
+    elsewhere keep their owner — the minimal-disruption property the
+    fleet controller's scale-down leans on."""
+    r = _router(3)
+    page = r.cfg.affinity_page_len
+    prompts, before = {}, {}
+    for seed in range(12):
+        p = [seed * 1000 + j for j in range(page)]
+        key = prefix_key(p, page)
+        ranked = sorted(r.replicas.values(),
+                        key=lambda rep: router_mod._rendezvous(key, rep.name),
+                        reverse=True)
+        owner = r._affinity_owner(p)
+        assert owner is ranked[0]  # owner IS the HRW top, not load-dependent
+        prompts[seed], before[seed] = p, owner.name
+    victim = sorted(r.replicas)[0]
+    assert any(n == victim for n in before.values()), \
+        "fixture must exercise the rehash branch"
+    r.remove_replica(victim)
+    for seed, p in prompts.items():
+        key = prefix_key(p, page)
+        expect = max(r.replicas.values(),
+                     key=lambda rep: router_mod._rendezvous(key, rep.name))
+        after = r._affinity_owner(p)
+        assert after is expect
+        if before[seed] != victim:
+            assert after.name == before[seed]  # pinned: unaffected keys stay
+
+
+def test_replica_set_churn_is_safe_under_concurrent_candidate_scans():
+    """The COW contract: candidate scans, snapshots, and stats() racing
+    add/remove churn never see a mutating dict or a half-built replica."""
+    r = _router(2)
+    keep = set(r.replicas)
+    stop = threading.Event()
+    errs = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                for rep, _load in r._candidates():
+                    rep.snapshot(r._clock())
+                r.stats()
+            except Exception as e:  # pragma: no cover - the failure mode
+                errs.append(repr(e))
+                return
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers:
+        t.start()
+    try:
+        for i in range(60):
+            rep = r.add_replica(f"10.9.9.9:{8100 + i}")
+            _mark_up(r, rep)
+            r.remove_replica(rep.name)
+    finally:
+        stop.set()
+        for t in readers:
+            t.join(timeout=10)
+    assert errs == []
+    assert set(r.replicas) == keep

@@ -844,3 +844,154 @@ def test_chaos_run_accounts_everything_and_spares_the_unaffected():
         assert serve._get(port, "/healthz")[0] == 200
     finally:
         srv.drain_and_join(timeout=60)
+
+
+# --------------------------------------------------------------------------- #
+# the fleet controller's drain protocol (ISSUE 17, tools/fleet.py)
+# --------------------------------------------------------------------------- #
+
+
+def _post_path(port, path, body=None):
+    """POST an arbitrary path (serve._post is /generate-only)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", path, json.dumps(body or {}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get_text(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def test_http_drain_202_then_409_and_sigterm_races_one_drain():
+    """The fleet drain protocol's worker half: POST /drain starts exactly
+    one drain (202); a repeat is 409 "already draining"; a SIGTERM
+    arriving DURING the HTTP drain (the PreemptionGuard loop calling
+    begin_drain again — the controller sends both on purpose,
+    belt-and-braces) must not double-run the drain — ``drain_begins``
+    stays 1 and the loop exits clean, the serve CLI's exit-0 path."""
+    cfg, srv = _server(slots=1, inf={"decode_block_len": 1})
+    try:
+        port = srv.port
+        srv.front._on_drained = None  # keep the listener observable
+        results = {}
+
+        def bg():
+            results["a"] = serve._post(port, {"prompt": [1, 2, 3],
+                                              "max_new_tokens": 24})
+
+        t = threading.Thread(target=bg)
+        t.start()
+        _poll_statz(port, lambda s: s.get("active_slots", 0) > 0)
+        st, body = _post_path(port, "/drain")
+        assert st == 202 and body["ok"] and body["state"] == "draining"
+        st, body = _post_path(port, "/drain")
+        assert st == 409 and "already draining" in body["error"]
+        # the SIGTERM flavor of the same race, in-process: a second
+        # begin_drain is a no-op, never a second drain
+        assert srv.front.begin_drain() is False
+        assert srv.front.drain_begins == 1
+        t.join(60)
+        assert results["a"][0] == 200  # in-flight finished intact
+        srv.front.join(timeout=60)
+        assert not srv.front.dead  # exit-0, not the crash path
+        # the loop has exited: drain now reports the terminal state
+        st, body = _post_path(port, "/drain")
+        assert st == 409 and body["state"] in ("stopped", "dead")
+        assert srv.front.drain_begins == 1
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+def test_http_drain_on_dead_loop_is_409_dead():
+    cfg, srv = _server()
+    try:
+        srv.front._on_drained = None
+
+        def boom(*a, **k):
+            raise RuntimeError("dispatch died")
+
+        srv.front._batcher.step = boom
+        st, _ = serve._post(srv.port, {"prompt": [1], "max_new_tokens": 2})
+        assert st == 500
+        srv.front.join(timeout=60)
+        st, body = _post_path(srv.port, "/drain")
+        assert st == 409 and body["state"] == "dead"
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+def test_metrics_renders_during_drain_and_after_shutdown():
+    """The controller scrapes /metrics every tick, including while its
+    drain is in flight and after the batcher has exited — the render
+    must answer 200 (bounded work, no dead-batcher 500, no deadlock)."""
+    cfg, srv = _server(slots=1, inf={"decode_block_len": 1})
+    try:
+        port = srv.port
+        srv.front._on_drained = None
+        results = {}
+
+        def bg():
+            results["a"] = serve._post(port, {"prompt": [1, 2, 3],
+                                              "max_new_tokens": 30})
+
+        t = threading.Thread(target=bg)
+        t.start()
+        _poll_statz(port, lambda s: s.get("active_slots", 0) > 0)
+        srv.front.begin_drain()
+        st, text = _get_text(port, "/metrics")  # mid-drain
+        assert st == 200 and "picotron_queue_depth" in text
+        t.join(60)
+        srv.front.join(timeout=60)
+        st, text = _get_text(port, "/metrics")  # batcher loop exited
+        assert st == 200 and "picotron_active_slots" in text
+        assert results["a"][0] == 200
+    finally:
+        srv.drain_and_join(timeout=60)
+
+
+def test_kv_prefixes_enumerates_hot_paths_paged_only():
+    """GET /kv/prefixes: the drain-time cache handoff's enumeration
+    surface — hottest radix prefixes as root-path token runs (full-page
+    chunks plus a possibly-partial tail leaf), 400 on a bad limit, and
+    AdmissionError (not a crash) off the contiguous layout."""
+    cfg, srv = _server(slots=2, inf={"kv_layout": "paged",
+                                     "kv_page_len": 8,
+                                     "decode_block_len": 1})
+    try:
+        port = srv.port
+        shared = list(range(1, 17))  # two whole pages
+        for tail in ([21, 22], [31, 32]):
+            st, _ = serve._post(port, {"prompt": shared + tail,
+                                       "max_new_tokens": 4})
+            assert st == 200
+        st, body = serve._get(port, "/kv/prefixes?limit=4")
+        assert st == 200 and body["prefixes"]
+        ids = body["prefixes"][0]["ids"]
+        assert len(ids) >= len(shared) and ids[: len(shared)] == shared
+        assert body["prefixes"][0]["tenant"] is None
+        st, body = serve._get(port, "/kv/prefixes?limit=0")
+        assert st == 400
+    finally:
+        srv.drain_and_join(timeout=60)
+
+    cfg, srv = _server()  # contiguous layout: the kv-transport 503,
+    try:                  # same contract as /kv/export — never a crash
+        st, body = serve._get(srv.port, "/kv/prefixes")
+        assert st == 503 and "paged" in body["error"]
+    finally:
+        srv.drain_and_join(timeout=60)
