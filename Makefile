@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke tenant-smoke fleet-chaos-smoke fleet-bench obs-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke dp-smoke tenant-smoke fleet-chaos-smoke fleet-bench obs-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -23,6 +23,7 @@ test-all: native lint
 	$(MAKE) quant-smoke
 	$(MAKE) router-chaos-smoke
 	$(MAKE) disagg-smoke
+	$(MAKE) dp-smoke
 	$(MAKE) tenant-smoke
 	$(MAKE) fleet-chaos-smoke
 
@@ -200,6 +201,15 @@ router-chaos-smoke:
 # one. CPU proxy (subprocess replicas = one interpreter per role).
 disagg-smoke:
 	JAX_PLATFORMS=cpu python bench_decode.py --disagg
+
+# dp-sharded continuous batching smoke (ISSUE 18, inference/engine.py,
+# docs/INFERENCE.md "dp-sharded batching"): a REAL dp=2 batcher on the
+# forced multi-device CPU mesh vs the dp=1 baseline — gates bit-identical
+# greedy streams, slots_total = dp x slots_per_shard, a comm_trace-verified
+# collective-free decode hot path, and at least one cross-shard slot
+# migration driven by the occupancy-rebalance planner.
+dp-smoke:
+	JAX_PLATFORMS=cpu python bench_decode.py --dp 2
 
 # Multi-tenant serving smoke (ISSUE 16, inference/tenancy.py,
 # docs/SERVING.md "Multi-tenant serving"): the adapter-parity gate —

@@ -43,6 +43,22 @@ import subprocess
 import sys
 import time
 
+# --dp N shards ONE engine's slot axis over N dp shards; on the CPU proxy
+# that needs a forced multi-device host platform, and XLA fixes the device
+# count at backend init — so the flag must land BEFORE any jax import
+# (picotron_tpu's package import below touches jax via topology).
+if "--dp" in sys.argv:
+    try:
+        _dp = int(sys.argv[sys.argv.index("--dp") + 1])
+    except (IndexError, ValueError):
+        _dp = 1
+    if (_dp > 1 and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={max(8, _dp)}"
+        ).strip()
+
 from picotron_tpu.bench_record import BENCH_METRICS
 
 # verify-dispatch rounds absorbed before the spec mode's timed window —
@@ -1186,6 +1202,121 @@ def run_fleet() -> dict:
         os.unlink(cfg_path)
 
 
+def run_dp(dp: int) -> dict:
+    """dp-sharded continuous batching (CPU proxy): the SAME tiny-model
+    batcher workload at dp=1 and dp=N — one logical engine whose slot axis
+    spans the dp mesh axis, paged KV pool sharded with it, rebalance
+    planner armed. The workload is shaped to skew occupancy (long streams
+    land on shard 0, short ones on shard 1 finish early), so the planner
+    must migrate a slot's pages across shards mid-run through the
+    page-transport device path while streams keep decoding.
+
+    Gates (enforced by main's --dp branch / ``make dp-smoke``):
+    - greedy token streams at dp=N are BIT-IDENTICAL to dp=1;
+    - ``slots_total == dp * slots_per_shard`` (the global slot map);
+    - zero dp-axis collectives traced during the whole run — prompts fit
+      one prefill chunk, so even the chunked-prefill owner-reduce (the one
+      dp collective the engine owns) never appears, and the decode hot
+      path is verified shard-local via the comm_trace channel;
+    - the rebalance planner fired at least once (the workload is
+      deterministic, so this pins that migration happens OFF the jitted
+      dispatch path yet streams stay exact).
+    """
+    import contextlib
+    import io
+
+    import jax
+
+    from picotron_tpu.config import Config
+    from picotron_tpu.inference import (
+        ContinuousBatcher,
+        InferenceEngine,
+        Request,
+    )
+    from picotron_tpu.models import llama
+
+    model = dict(
+        name="tiny", num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, hidden_size=64, intermediate_size=128,
+        vocab_size=256, max_position_embeddings=96, dtype="float32",
+        attention_impl="sdpa")
+
+    def one(d: int) -> dict:
+        cfg = Config.from_dict({
+            "distributed": {"tp_size": 1, "use_cpu": True},
+            "model": dict(model),
+            "training": {"seq_length": 96},
+            "dataset": {"name": "synthetic"},
+            "inference": {"dp_size": d, "kv_layout": "paged",
+                          "kv_page_len": 8},
+        })
+        engine = InferenceEngine(cfg, slots=4, max_seq_len=96,
+                                 decode_block_len=4)
+        params = engine.shard_params(jax.jit(
+            lambda k: llama.init_params(k, cfg.model))(
+                jax.random.PRNGKey(0)))
+        b = ContinuousBatcher(engine, params)
+        skew = [0]
+
+        def on_token(uid, tok):
+            occ = b.shard_occupancy()
+            skew[0] = max(skew[0], max(occ) - min(occ))
+
+        b.on_token = on_token
+        reqs = [Request("l0", [1, 2, 3, 4, 5], max_new_tokens=28),
+                Request("l1", [9, 8, 7, 6], max_new_tokens=28),
+                Request("s0", [11, 12], max_new_tokens=4),
+                Request("s1", [13, 14, 15], max_new_tokens=4)]
+        # comm_trace capture: PICOTRON_VERBOSE=1 prints one stderr line
+        # per collective per trace — a dp-axis line during this window
+        # would mean the sharded hot path grew cross-shard traffic
+        old = os.environ.get("PICOTRON_VERBOSE")
+        os.environ["PICOTRON_VERBOSE"] = "1"
+        buf = io.StringIO()
+        t0 = time.perf_counter()
+        try:
+            with contextlib.redirect_stderr(buf):
+                res = b.run(reqs)
+        finally:
+            if old is None:
+                os.environ.pop("PICOTRON_VERBOSE", None)
+            else:
+                os.environ["PICOTRON_VERBOSE"] = old
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in res.values())
+        dp_comms = [ln for ln in buf.getvalue().splitlines()
+                    if ln.startswith("[comm]") and "axis=dp" in ln]
+        st = b.stats()
+        return {
+            "streams": {uid: r.tokens for uid, r in res.items()},
+            "tokens_per_s": toks / dt if dt > 0 else 0.0,
+            "stats": st,
+            "dispatch_latency_s": dispatch_latency_summary(engine),
+            "dp_comm_lines": dp_comms,
+            "occupancy_skew_peak": skew[0],
+            "slots_per_shard": engine.slots_per_shard,
+        }
+
+    base, sharded = one(1), one(dp)
+    st = sharded["stats"]
+    return {
+        "dp_size": st["dp_size"],
+        "slots_total": st["slots_total"],
+        "slots_per_shard": sharded["slots_per_shard"],
+        "shard_occupancy": st["shard_occupancy"],
+        "occupancy_skew_peak": sharded["occupancy_skew_peak"],
+        "rebalance_count": st["rebalance_count"],
+        "rebalance_bytes": st["rebalance_bytes"],
+        "tokens_per_s_dp1": round(base["tokens_per_s"], 1),
+        "tokens_per_s_dpN": round(sharded["tokens_per_s"], 1),
+        "dispatch_latency_s": {"dp1": base["dispatch_latency_s"],
+                               f"dp{dp}": sharded["dispatch_latency_s"]},
+        "dp_collectives_traced": len(sharded["dp_comm_lines"]),
+        "dp_comm_lines": sharded["dp_comm_lines"][:8],
+        "streams_match": base["streams"] == sharded["streams"],
+    }
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="decode throughput bench")
     ap.add_argument("--block-len", type=int, default=1,
@@ -1273,7 +1404,66 @@ def main(argv=None) -> None:
                          "--weight-dtype int8 and --spec-len)")
     ap.add_argument("--adapter-rank", type=int, default=8,
                     help="LoRA rank for --tenants adapters (default 8)")
+    ap.add_argument("--dp", type=int, default=1,
+                    help="dp-sharded batching smoke (CPU proxy): run the "
+                         "continuous batcher as ONE logical engine whose "
+                         "slot axis spans N dp shards, vs the dp=1 "
+                         "baseline — the JSON gains dp_size, slots_total, "
+                         "per-shard occupancy skew, rebalance_count/"
+                         "bytes, and dispatch-latency percentiles at "
+                         "both widths; gates bit-identical streams and a "
+                         "collective-free decode hot path")
     args = ap.parse_args(argv)
+    if args.dp > 1:
+        # the dp smoke is its own protocol (an A/B of one batcher workload
+        # at two mesh widths; stream-exactness gates, not tokens/s) — CPU
+        # proxy by design, over the forced multi-device host platform the
+        # module-top bootstrap set up before jax loaded
+        if args.disagg or args.fleet or args.tenants or args.spec_len:
+            ap.error("--dp is its own protocol; drop the other mode flags")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            res = run_dp(args.dp)
+        except Exception as e:  # noqa: BLE001 - the record IS the channel
+            print(json.dumps({
+                "metric": "dp_sharded_batching_cpu_smoke", "value": None,
+                "unit": "tokens/s", "vs_baseline": None,
+                "code_failure": True,
+                "error": f"{type(e).__name__}: {e}"[:800]}))
+            raise
+        print(f"# dp bench: dp={res['dp_size']} "
+              f"slots_total={res['slots_total']} "
+              f"occupancy_skew_peak={res['occupancy_skew_peak']} "
+              f"rebalances={res['rebalance_count']} "
+              f"({res['rebalance_bytes']}B) "
+              f"tokens/s dp1={res['tokens_per_s_dp1']} "
+              f"dp{args.dp}={res['tokens_per_s_dpN']} "
+              f"streams_match={res['streams_match']} "
+              f"dp_collectives={res['dp_collectives_traced']}",
+              file=sys.stderr)
+        record = {"metric": "dp_sharded_batching_cpu_smoke",
+                  "value": res["tokens_per_s_dpN"], "unit": "tokens/s",
+                  "vs_baseline": None, "validated": False, **res}
+        print(json.dumps(record))
+        # the gates: the sharded engine must be indistinguishable from
+        # the dp=1 one token-for-token, expose the global slot map, keep
+        # the hot path free of cross-shard collectives, and have actually
+        # exercised the migration planner (the workload forces the skew)
+        if not res["streams_match"]:
+            raise SystemExit("dp gate failed: dp-sharded streams diverge "
+                             "from the dp=1 baseline")
+        if res["slots_total"] != args.dp * res["slots_per_shard"]:
+            raise SystemExit(
+                f"dp gate failed: slots_total {res['slots_total']} != "
+                f"dp {args.dp} x slots_per_shard {res['slots_per_shard']}")
+        if res["dp_collectives_traced"]:
+            raise SystemExit(
+                "dp gate failed: dp-axis collectives on the serving path: "
+                + "; ".join(res["dp_comm_lines"]))
+        if not res["rebalance_count"]:
+            raise SystemExit("dp gate failed: the skewed workload never "
+                             "triggered a cross-shard slot migration")
+        return
     if args.disagg:
         # the disagg bench is its own protocol (subprocess fleet + the
         # router; TPOT percentiles, not tokens/s) — CPU proxy by design

@@ -413,6 +413,15 @@ class InferenceConfig:
     # classic per-token loop (one dispatch per token). Also bounds admission
     # latency: the batcher admits/retires only at block boundaries.
     decode_block_len: int = 8
+    # Data-parallel shards of one logical engine (docs/INFERENCE.md
+    # "dp-sharded batching"): the slot axis — tokens, sampling state,
+    # lengths, KV cache / paged pool — shards over a ('dp', 'tp') mesh
+    # while params stay replicated across dp, so ONE jitted dispatch
+    # advances dp x slots_per_shard slots with zero cross-shard traffic
+    # on the decode/verify hot path. 1 (default) = today's tp-only mesh,
+    # every existing smoke byte-identical. Requires slots % dp_size == 0
+    # and (paged) kv_num_pages % dp_size == 0.
+    dp_size: int = 1
     # Weight storage format for serving: "bf16" (the model's param dtype,
     # the bit-pinned default — every existing smoke is unchanged) or
     # "int8" = per-output-channel absmax quantization of every matmul
@@ -1054,6 +1063,11 @@ class Config:
         inf = self.inference
         if inf.decode_block_len < 1:
             raise ValueError("inference.decode_block_len must be >= 1")
+        if inf.dp_size < 1:
+            raise ValueError(
+                "inference.dp_size must be >= 1 (1 = tp-only serving "
+                "mesh; N shards one logical engine's slot axis over a "
+                "('dp', 'tp') mesh of N x tp_size devices)")
         if inf.prefill_chunk < 1:
             raise ValueError("inference.prefill_chunk must be >= 1")
         if inf.weight_dtype not in ("bf16", "int8"):

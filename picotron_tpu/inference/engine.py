@@ -62,6 +62,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from picotron_tpu import comm_trace
 from picotron_tpu.config import Config
 from picotron_tpu.inference import kv_cache, paged_kv, sampling
 from picotron_tpu.obs import Obs
@@ -80,12 +81,16 @@ _FLASH_BROKEN = False
 
 def inference_config(cfg: Config) -> Config:
     """Derive the serving config from a training config: same model, but a
-    tp-only topology (dp=pp=cp=1) with the training-only rewrites (sequence
+    ('dp','tp') topology (pp=cp=1) with the training-only rewrites (sequence
     parallelism, fsdp/zero1, vma checking) off — none of them make sense at
-    query length 1, and sequence parallelism cannot even shard it."""
+    query length 1, and sequence parallelism cannot even shard it. The
+    serving dp width comes from ``inference.dp_size`` (NOT the training
+    ``distributed.dp_size``, which shards gradients, not slots); 1 — the
+    default — is the historical tp-only mesh."""
     raw = cfg.to_dict()
+    dp = int((raw.get("inference") or {}).get("dp_size", 1) or 1)
     raw["distributed"].update(dict(
-        dp_size=1, pp_size=1, cp_size=1, pp_interleave=1,
+        dp_size=dp, pp_size=1, cp_size=1, pp_interleave=1,
         tp_sequence_parallel=False, fsdp=False, zero1=False,
         check_vma=False, cp_zigzag=False))
     return Config.from_dict(raw)
@@ -125,18 +130,35 @@ class InferenceEngine:
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
         inf = self.cfg.inference
+        self.dp_size = int(inf.dp_size or 1)
+        if self.dp_size < 1:
+            raise ValueError("inference.dp_size must be >= 1")
         if topo is None:
-            topo = build_topology(1, 1, 1, d.tp_size)
-        if (topo.dp_size, topo.pp_size, topo.cp_size) != (1, 1, 1):
+            topo = build_topology(self.dp_size, 1, 1, d.tp_size)
+        if (topo.pp_size, topo.cp_size) != (1, 1) \
+                or topo.dp_size != self.dp_size:
             raise ValueError(
-                "InferenceEngine serves a tp-only mesh (dp=pp=cp=1); got "
+                "InferenceEngine serves a ('dp','tp') mesh (pp=cp=1) whose "
+                f"dp width matches inference.dp_size={self.dp_size}; got "
                 f"dp={topo.dp_size} pp={topo.pp_size} cp={topo.cp_size}. "
-                "Data-parallel serving = one engine per replica.")
+                "Set inference.dp_size to shard ONE logical engine's slot "
+                "axis over dp shards (1 = the tp-only default; scale-out "
+                "beyond that is still one engine per replica behind the "
+                "router).")
         if topo.tp_size != d.tp_size:
             raise ValueError(
                 f"mesh tp={topo.tp_size} != config tp_size={d.tp_size}")
         self.topo = topo
         self.slots = int(slots)
+        if self.slots % self.dp_size:
+            raise ValueError(
+                f"slots ({self.slots}) must divide evenly over "
+                f"inference.dp_size ({self.dp_size}) — each dp shard "
+                "serves slots/dp of the batch")
+        self.slots_per_shard = self.slots // self.dp_size
+        # optional ClusterMonitor lease guard (attach_monitor): multi-host
+        # dp serving checks peer liveness before every dispatch collective
+        self.monitor = None
         self.max_seq_len = int(max_seq_len or m.max_position_embeddings)
         self.min_prefill_bucket = int(min_prefill_bucket)
         self.decode_block_len = int(decode_block_len
@@ -284,14 +306,27 @@ class InferenceEngine:
                     f"{self.page_len}")
             # logical window per slot, in pages (>= max_seq_len rows)
             self.max_pages = -(-self.max_seq_len // self.page_len)
-            self.num_pages = int(kv_num_pages or inf.kv_num_pages
-                                 or 1 + self.slots * self.max_pages)
+            self.num_pages = int(
+                kv_num_pages or inf.kv_num_pages
+                or self.dp_size * (1 + self.slots_per_shard
+                                   * self.max_pages))
             if self.num_pages < 2:
                 raise ValueError("kv_num_pages must be >= 2 "
                                  "(page 0 is the reserved NULL page)")
-            self.paged = paged_kv.PagedKV(
-                self.slots, self.page_len, self.max_pages, self.num_pages,
-                prefix_cache=inf.prefix_cache)
+            if self.dp_size > 1:
+                # dp-sharded pool: each shard runs its own PagedKV over a
+                # pages_per_shard strip (local page 0 = that shard's NULL
+                # page); the engine sees global slot/page ids through the
+                # ShardedPagedKV facade.
+                self.paged = paged_kv.ShardedPagedKV(
+                    self.dp_size, self.slots, self.page_len, self.max_pages,
+                    self.num_pages, prefix_cache=inf.prefix_cache)
+                self.pages_per_shard = self.paged.pages_per_shard
+            else:
+                self.paged = paged_kv.PagedKV(
+                    self.slots, self.page_len, self.max_pages,
+                    self.num_pages, prefix_cache=inf.prefix_cache)
+                self.pages_per_shard = self.num_pages
 
         # angle tables cover the whole cache window; decode gathers rows at
         # each slot's own offset
@@ -325,20 +360,42 @@ class InferenceEngine:
                 name: {"a": self._dispatch_pspecs["layers"][name]["a"],
                        "b": self._dispatch_pspecs["layers"][name]["b"]}
                 for name in llama.QUANT_WEIGHT_LEAVES})
+        # the decode-family dispatches shard their per-slot [B] operands
+        # over dp — the adapter ids bound into the params tree ([L, B],
+        # one row per GLOBAL slot) must shard with them, while one-shot
+        # prefill (B=1, fully replicated) keeps the plain form
+        self._decode_dispatch_pspecs = self._dispatch_pspecs
+        if adapters is not None and self.dp_size > 1:
+            layers = dict(self._dispatch_pspecs["layers"])
+            for name in llama.QUANT_WEIGHT_LEAVES:
+                layers[name] = {**layers[name], "ids": P("pp", "dp")}
+            self._decode_dispatch_pspecs = {**self._dispatch_pspecs,
+                                            "layers": layers}
         if self.paged is not None:
             self._cspecs = paged_kv.cache_pspecs(self.quantized,
-                                                 policy=self.page_policy)
+                                                 policy=self.page_policy,
+                                                 dp=self.dp_size)
         else:
-            self._cspecs = kv_cache.cache_pspecs(self.quantized)
+            self._cspecs = kv_cache.cache_pspecs(self.quantized,
+                                                 dp=self.dp_size)
         self._build_programs()
         # kv_cache.release works on both layouts (a paged release is the
         # same 1-element length write; the host manager frees the pages)
-        self._release_jit = jax.jit(kv_cache.release, donate_argnums=(0,))
+        # dp>1: pin cache-shaped outputs of the host-side helper jits to
+        # the dp-sharded cache layout so donation round-trips never leave
+        # a leaf gathered; dp=1 keeps them unconstrained (byte-identical
+        # programs to the tp-only engine).
+        cache_sh = (named_shardings(topo, self._cspecs)
+                    if self.dp_size > 1 else None)
+        self._release_jit = jax.jit(kv_cache.release, donate_argnums=(0,),
+                                    out_shardings=cache_sh)
         if self.paged is not None:
             self._insert_jit = jax.jit(paged_kv.insert_prefill,
-                                       donate_argnums=(0,))
+                                       donate_argnums=(0,),
+                                       out_shardings=cache_sh)
             self._copy_page_jit = jax.jit(paged_kv.copy_page,
-                                          donate_argnums=(0,))
+                                          donate_argnums=(0,),
+                                          out_shardings=cache_sh)
             # page-transport device ops (inference/page_transport.py):
             # built ONCE here — a per-page jit build would recompile every
             # import (picolint PICO-J004's exact hazard). Export reads and
@@ -349,9 +406,11 @@ class InferenceEngine:
             self._slice_page_jit = jax.jit(paged_kv.slice_page)
             self._gather_pages_jit = jax.jit(paged_kv.gather_pages)
             self._write_pages_jit = jax.jit(paged_kv.write_pages,
-                                            donate_argnums=(0,))
+                                            donate_argnums=(0,),
+                                            out_shardings=cache_sh)
             self._set_length_jit = jax.jit(paged_kv.set_length,
-                                           donate_argnums=(0,))
+                                           donate_argnums=(0,),
+                                           out_shardings=cache_sh)
             self._init_cache_jit = jax.jit(
                 partial(paged_kv.init_cache, m, self.slots, self.num_pages,
                         self.page_len, self.max_pages,
@@ -360,7 +419,8 @@ class InferenceEngine:
                 out_shardings=named_shardings(topo, self._cspecs))
         else:
             self._insert_jit = jax.jit(kv_cache.insert_prefill,
-                                       donate_argnums=(0,))
+                                       donate_argnums=(0,),
+                                       out_shardings=cache_sh)
             self._init_cache_jit = jax.jit(
                 partial(kv_cache.init_cache, m, self.slots,
                         self.max_seq_len, dtype=self.cache_dtype,
@@ -372,9 +432,22 @@ class InferenceEngine:
         again when the flash->dense degradation path flips ``attend_impl``:
         the kernel choice is a trace-time constant the jit wrappers close
         over, so changing it means new programs, not a runtime branch."""
-        kv_spec = {n: s for n, s in self._cspecs.items()
+        # one-shot prefill runs B=1 fully replicated across dp (every shard
+        # computes the same slice; only the owner's insert consumes it), so
+        # its kv output specs come from the dp-FREE base — identical to
+        # self._cspecs when dp == 1
+        base_cspecs = (paged_kv.cache_pspecs(self.quantized,
+                                             policy=self.page_policy)
+                       if self.paged is not None
+                       else kv_cache.cache_pspecs(self.quantized))
+        kv_spec = {n: s for n, s in base_cspecs.items()
                    if n not in paged_kv.META_LEAVES}
         mesh = self.topo.mesh
+        # per-slot [B, ...] operands/outputs shard over dp (slot-major:
+        # shard s owns global slots [s*spb, (s+1)*spb)); everything else
+        # stays replicated. dp == 1 collapses dpP to P() — byte-identical
+        # specs to the tp-only engine.
+        dpP = P("dp") if self.dp_size > 1 else P()
 
         chunk_impl = (self._prefill_chunk_impl_paged
                       if self.kv_layout == "paged"
@@ -392,6 +465,7 @@ class InferenceEngine:
         # sampling epilogue, so hidden-less engines compile byte-identical
         # programs
         hid = (P(),) if self.return_hidden else ()
+        hidB = (dpP,) if self.return_hidden else ()
         self._prefill_jit = jax.jit(shard_map(
             self._prefill_impl, mesh,
             in_specs=(self._dispatch_pspecs, P(), P()) + samp,
@@ -404,10 +478,10 @@ class InferenceEngine:
             donate_argnums=(1,))
         self._decode_jit = jax.jit(shard_map(
             self._decode_impl, mesh,
-            in_specs=(self._dispatch_pspecs, self._cspecs,
-                      P(), P(), P(), P(), P()),
-            out_specs=((self._cspecs, P()) if sod
-                       else (self._cspecs, P(), P())) + hid),
+            in_specs=(self._decode_dispatch_pspecs, self._cspecs,
+                      dpP, P(), dpP, dpP, dpP),
+            out_specs=((self._cspecs, dpP) if sod
+                       else (self._cspecs, dpP, dpP)) + hidB),
             donate_argnums=(1,))
         self._decode_block_jit = self._make_decode_block_jit()
         self._decode_block_poison_jit = None  # chaos-only; built on demand
@@ -417,12 +491,13 @@ class InferenceEngine:
             self._verify_jit = self._make_verify_jit()
 
     def _make_verify_jit(self, poison: bool = False):
-        hid = (P(),) if self.return_hidden else ()
+        dpP = P("dp") if self.dp_size > 1 else P()
+        hidB = (dpP,) if self.return_hidden else ()
         return jax.jit(shard_map(
             partial(self._verify_impl, poison=poison), self.topo.mesh,
-            in_specs=(self._dispatch_pspecs, self._cspecs,
-                      P(), P(), P(), P(), P(), P(), P(), P()),
-            out_specs=(self._cspecs, P(), P(), P()) + hid),
+            in_specs=(self._decode_dispatch_pspecs, self._cspecs,
+                      dpP, dpP, P(), dpP, dpP, dpP, dpP, dpP),
+            out_specs=(self._cspecs, dpP, dpP, dpP) + hidB),
             donate_argnums=(1,))
 
     def _verify_prog(self, poison: bool):
@@ -435,12 +510,13 @@ class InferenceEngine:
         return self._verify_poison_jit
 
     def _make_decode_block_jit(self, poison: bool = False):
-        hid = (P(),) if self.return_hidden else ()
+        dpP = P("dp") if self.dp_size > 1 else P()
+        hidB = (dpP,) if self.return_hidden else ()
         return jax.jit(shard_map(
             partial(self._decode_block_impl, poison=poison), self.topo.mesh,
-            in_specs=(self._dispatch_pspecs, self._cspecs,
-                      P(), P(), P(), P(), P(), P(), P()),
-            out_specs=(self._cspecs, P(), P()) + hid),
+            in_specs=(self._decode_dispatch_pspecs, self._cspecs,
+                      dpP, P(), dpP, dpP, dpP, dpP, dpP),
+            out_specs=(self._cspecs, dpP, dpP) + hidB),
             donate_argnums=(1,))
 
     def _decode_block_prog(self, poison: bool):
@@ -458,7 +534,13 @@ class InferenceEngine:
     def _hook(self, kind: str, budget=None) -> None:
         """Fire the before-dispatch hook with the active slot indices
         (``budget > 0`` rows; dispatches without a budget report none)
-        and count the dispatch in the metrics registry."""
+        and count the dispatch in the metrics registry. When a
+        ClusterMonitor is attached (``attach_monitor`` — multi-host dp
+        serving), every dispatch first checks peer leases: a dead dp peer
+        means the collective about to run would wedge forever, so the
+        monitor's exit path fires instead (exit 77 under the default
+        exit_fn — the supervisor's restart signal)."""
+        self._check_monitor()
         self.obs.registry.counter(
             "picotron_dispatch_total",
             "engine dispatches by kind", kind=kind).inc()
@@ -467,6 +549,20 @@ class InferenceEngine:
         slots = ([] if budget is None
                  else np.flatnonzero(np.asarray(budget) > 0).tolist())
         self.hooks.before_dispatch(kind, slots)
+
+    def attach_monitor(self, monitor) -> None:
+        """Attach a ``resilience.cluster.ClusterMonitor`` lease guard:
+        every subsequent dispatch (and every migration's donating write)
+        first checks peer leases, so a dead dp peer takes the monitor's
+        exit path — exit 77 under the default exit_fn — instead of
+        wedging this host inside the dispatch collective forever."""
+        self.monitor = monitor
+
+    def _check_monitor(self) -> None:
+        if self.monitor is not None:
+            dead = self.monitor.check_peers()
+            if dead is not None:
+                self.monitor._exit(*dead)
 
     def observe_dispatch(self, kind: str, seconds: float,
                          host_sync_s: Optional[float] = None) -> None:
@@ -593,6 +689,49 @@ class InferenceEngine:
         return {n: cache[n] for n in ("block_tables", "page_quant")
                 if n in cache}
 
+    def _local_meta(self, cache) -> dict:
+        """``_meta`` for use INSIDE a shard_map trace: with dp > 1 the page
+        pool arrives shard-local (pages_per_shard pages, local page 0 =
+        this shard's NULL page) while block tables carry GLOBAL page ids
+        (shard s owns [s*pps, (s+1)*pps)), so subtract this shard's base —
+        a slot's own entries localize into range, its NULL entries localize
+        to 0. ``_rebuild`` keeps the ORIGINAL global tables; this view is
+        read-only. dp == 1 is the identity."""
+        meta = self._meta(cache)
+        if self.dp_size > 1 and "block_tables" in meta:
+            base = (lax.axis_index("dp").astype(jnp.int32)
+                    * self.pages_per_shard)
+            meta = {**meta, "block_tables": meta["block_tables"] - base}
+        return meta
+
+    def _slot_owner(self, slot):
+        """Owner gating for single-slot programs under dp sharding: map a
+        GLOBAL slot id to (local slot, is_owner) on the executing shard.
+        Non-owner shards clip to a valid local index so slicing stays in
+        bounds; their compute is discarded (writes where'd out, logits
+        psum-masked). dp == 1 returns the slot unchanged with owner
+        None (no gating)."""
+        if self.dp_size <= 1:
+            return slot, None
+        shard = lax.axis_index("dp").astype(jnp.int32)
+        loc = jnp.asarray(slot, jnp.int32) - shard * self.slots_per_shard
+        is_owner = (loc >= 0) & (loc < self.slots_per_shard)
+        return jnp.clip(loc, 0, self.slots_per_shard - 1), is_owner
+
+    def _owner_reduce(self, x, owner):
+        """Make a single-slot program output replicated across dp shards:
+        the owner contributes its value, the rest contribute zeros, one
+        psum agrees everywhere (where-select, not multiply, so non-owner
+        garbage — even NaN — never reaches the sum). This is the ONLY dp
+        collective in the serving programs, and it lives on the chunked
+        prefill path alone; decode_block/verify stay collective-free.
+        dp == 1 (owner None) is the identity."""
+        if owner is None:
+            return x
+        return comm_trace.log(
+            "prefill_owner_reduce", "dp",
+            lax.psum(jnp.where(owner, x, jnp.zeros_like(x)), "dp"))
+
     def _layer_body(self, cos_b, sin_b, pos, meta):
         """Build the layer-scan body: decode one layer against its cache
         leaves. For paged caches the (layer-less) metadata leaves are
@@ -635,7 +774,7 @@ class InferenceEngine:
         cos_b, sin_b = rope_at_positions(self._cos, self._sin, rows)
         h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
         leaves, _ = self._split_cache(cache)
-        meta = self._meta(cache)
+        meta = self._local_meta(cache)
         if extra_meta:
             meta = {**meta, **extra_meta}
         body = self._layer_body(cos_b, sin_b, pos, meta)
@@ -828,16 +967,24 @@ class InferenceEngine:
         h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
         leaves, lengths = self._split_cache(cache)
         pos = jnp.full((1,), start, jnp.int32)
+        # dp > 1: every shard traces the same chunk, but only the slot's
+        # owner keeps its writes — non-owners slice a clipped local slot,
+        # discard the updated rows (write-back of the unchanged slice is a
+        # no-op), and contribute zeros to the logits psum below
+        loc, owner = self._slot_owner(slot)
 
         def body(hc, xs):
             lp, lc = xs
             # this slot's [1, T, ...] block rows, updated then scattered back
-            slot_c = {n: lax.dynamic_slice_in_dim(a, slot, 1, axis=0)
+            slot_c = {n: lax.dynamic_slice_in_dim(a, loc, 1, axis=0)
                       for n, a in lc.items()}
             hc, slot_new = llama.decoder_layer(lp, hc, cos_b, sin_b, cfg,
                                                cache=slot_c, pos=pos)
+            if owner is not None:
+                slot_new = {n: jnp.where(owner, slot_new[n], slot_c[n])
+                            for n in slot_new}
             lc = {n: lax.dynamic_update_slice_in_dim(lc[n], slot_new[n],
-                                                     slot, axis=0)
+                                                     loc, axis=0)
                   for n in lc}
             return hc, lc
 
@@ -846,13 +993,15 @@ class InferenceEngine:
         h_last = jnp.take_along_axis(
             h, jnp.full((1, 1, 1), idx, jnp.int32), axis=1)
         last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
-        last = last.astype(jnp.float32)
-        new_cache = {**new_leaves,
-                     "lengths": lengths.at[slot].set(start + valid)}
+        last = self._owner_reduce(last.astype(jnp.float32), owner)
+        new_lengths = lengths.at[loc].set(start + valid)
+        if owner is not None:
+            new_lengths = jnp.where(owner, new_lengths, lengths)
+        new_cache = {**new_leaves, "lengths": new_lengths}
         out = self._epilogue(last, *sample) if self.sample_on_device \
             else last
         if self.return_hidden:
-            return new_cache, out, h_last[:, 0]
+            return new_cache, out, self._owner_reduce(h_last[:, 0], owner)
         return new_cache, out
 
     def _prefill_chunk_impl_paged(self, params, cache, tokens, slot, start,
@@ -870,23 +1019,34 @@ class InferenceEngine:
         cos_b, sin_b = rope_at_positions(self._cos, self._sin, pos_rows)
         h = llama.embed_lookup(params["embed"], tokens).astype(self._dt)
         leaves, lengths = self._split_cache(cache)
-        row = lax.dynamic_slice_in_dim(cache["block_tables"], slot, 1,
+        # dp > 1: non-owner shards force their (clipped) table row to the
+        # local NULL page — their chunk writes scribble the shard's
+        # designated scratch page and their reads never feed the result
+        # (logits psum-masked below, write-back of pool pages goes through
+        # the row, and lengths stay untouched)
+        loc, owner = self._slot_owner(slot)
+        local_meta = self._local_meta(cache)
+        row = lax.dynamic_slice_in_dim(local_meta["block_tables"], loc, 1,
                                        axis=0)  # [1, max_pages]
+        if owner is not None:
+            row = jnp.where(owner, row, jnp.zeros_like(row))
         pos = jnp.full((1,), start, jnp.int32)
-        meta = {**self._meta(cache), "block_tables": row}
+        meta = {**local_meta, "block_tables": row}
         body = self._layer_body(cos_b, sin_b, pos, meta)
         h, new_leaves = lax.scan(body, h, (params["layers"], leaves))
         idx = jnp.clip(valid - 1, 0, C - 1)
         h_last = jnp.take_along_axis(
             h, jnp.full((1, 1, 1), idx, jnp.int32), axis=1)
         last = tp_gather(llama.head_logits(params, h_last, cfg))[:, 0]
-        last = last.astype(jnp.float32)
-        new_cache = self._rebuild(cache, new_leaves,
-                                  lengths.at[slot].set(start + valid))
+        last = self._owner_reduce(last.astype(jnp.float32), owner)
+        new_lengths = lengths.at[loc].set(start + valid)
+        if owner is not None:
+            new_lengths = jnp.where(owner, new_lengths, lengths)
+        new_cache = self._rebuild(cache, new_leaves, new_lengths)
         out = self._epilogue(last, *sample) if self.sample_on_device \
             else last
         if self.return_hidden:
-            return new_cache, out, h_last[:, 0]
+            return new_cache, out, self._owner_reduce(h_last[:, 0], owner)
         return new_cache, out
 
     # ---- host-facing API ---------------------------------------------------
@@ -1001,11 +1161,15 @@ class InferenceEngine:
         # base pspecs, NOT the adapter-wrapped dispatch specs: the draft
         # reads only embed/final_norm/lm_head, and its caller (the
         # LearnedDrafter) holds the UNBOUND base tree — adapters shape
-        # per-token logits through verify, never through the draft
+        # per-token logits through verify, never through the draft.
+        # Per-slot rows shard over dp like every batch family (the draft
+        # is embarrassingly parallel over slots — no cache, no cross-row
+        # reads).
+        dpP = P("dp") if self.dp_size > 1 else P()
         return jax.jit(shard_map(
             impl, self.topo.mesh,
-            in_specs=(self._pspecs,) + head_spec + (P(), P()),
-            out_specs=P()))
+            in_specs=(self._pspecs,) + head_spec + (dpP, dpP),
+            out_specs=dpP))
 
     # ---- paged-layout host plumbing ---------------------------------------
 
@@ -1284,6 +1448,101 @@ class InferenceEngine:
             raise ValueError("seat_slot needs kv_layout='paged'")
         self.paged.set_len(slot, length)
         return self._set_length_jit(self._sync_tables(cache), slot, length)
+
+    def _page_bytes(self) -> int:
+        """Raw bytes one pool page holds across every storage leaf (the
+        migration accounting unit — same figure the transport's
+        ``bytes_total`` reports per page)."""
+        spec = self.transport_spec()
+        return sum(np.dtype(l["dtype"]).itemsize * int(np.prod(l["shape"]))
+                   for l in spec["leaves"].values())
+
+    def migrate_slot(self, cache, src: int, dst: int, prompt_ids=None,
+                     cache_salt: str = "") -> tuple:
+        """Move a parked slot's KV pages from global slot ``src`` into
+        (empty) global slot ``dst`` through the page-transport device
+        path — ONE batched gather + ONE donating write, byte-exact —
+        then re-seat the slot's host/device state (consumes ``cache``).
+        The dp rebalance planner's primitive: with ``dst`` on a
+        different dp shard the pages land in THAT shard's pool strip, so
+        a skewed shard sheds a whole parked slot. Works under dp == 1
+        too (a plain slot move within one pool).
+
+        All-or-nothing: destination-pool exhaustion
+        (``PagePoolExhausted`` from the all-or-nothing allocation) or
+        any fault before the donating write completes releases every
+        destination page and leaves the source slot untouched —
+        refcounts conserved either way. ``host_len`` already reflects
+        only ACCEPTED tokens (a verify's advance ran before anyone could
+        park the slot), so draft rows a speculative round wrote past the
+        length pointer are rolled back by construction — never exported.
+
+        ``prompt_ids`` (+ ``cache_salt`` = tenant) re-grafts the slot's
+        prompt into the destination shard's radix domain, so prefix
+        sharing survives the move. Returns (cache, bytes_moved)."""
+        if self.paged is None:
+            raise ValueError("migrate_slot needs kv_layout='paged'")
+        p = self.paged
+        if not (0 <= src < self.slots and 0 <= dst < self.slots):
+            raise ValueError(
+                f"migrate_slot: slots out of range: {src} -> {dst} "
+                f"(engine has {self.slots})")
+        if src == dst:
+            return cache, 0
+        n_tok = int(p.host_len[src])
+        if n_tok <= 0:
+            raise ValueError(f"migrate_slot: source slot {src} is empty")
+        if int(p.host_len[dst]) > 0:
+            raise ValueError(
+                f"migrate_slot: destination slot {dst} is occupied")
+        npages = p.pages_for(n_tok)
+        src_pids = np.asarray(p.tables)[src, :npages].astype(np.int32)
+        # all-or-nothing allocation on the DESTINATION slot's shard:
+        # exhaustion raises here, before anything moved
+        if self.dp_size > 1:
+            dsh = p.shards[p.shard_of(dst)]
+            base = p.shard_of(dst) * p.pages_per_shard
+            new_pids = [base + q for q in dsh.alloc_import(npages)]
+        else:
+            dsh, base = p, 0
+            new_pids = p.alloc_import(npages)
+        bucket = 1
+        while bucket < npages:
+            bucket *= 2
+        src_arr = np.full(bucket, paged_kv.NULL_PAGE, np.int32)
+        src_arr[:npages] = src_pids
+        dst_arr = np.full(bucket, paged_kv.NULL_PAGE, np.int32)
+        dst_arr[:npages] = new_pids
+        try:
+            pages = self._gather_pages_jit(cache, src_arr)
+            # a dead dp peer discovered here exits 77 BEFORE the donating
+            # write; the except arm keeps restart leak-free regardless
+            self._check_monitor()
+            cache = self._write_pages_jit(cache, pages, dst_arr)
+        except BaseException:
+            # the fault struck before the donating dispatch consumed the
+            # cache: the fresh pages' only holder is this migration —
+            # release them and both pools are exactly as before
+            p.release_pages(new_pids)
+            raise
+        # seat the destination: its table row holds the fresh pages
+        # (refcount 1, already owed to the slot), master length/pricing
+        # move over, then the source's references drop — shared source
+        # pages live on under their other holders
+        if self.dp_size > 1:
+            dsh.tables[p.local_slot(dst), :npages] = \
+                [q - base for q in new_pids]
+        else:
+            p.tables[dst, :npages] = new_pids
+        p.priced[dst] = p.priced[src]
+        p.set_len(dst, n_tok)
+        p.free_slot(src)
+        if prompt_ids is not None:
+            p.register_prompt(dst, [int(t) for t in prompt_ids],
+                              salt=cache_salt)
+        cache = self._set_length_jit(self._sync_tables(cache), dst, n_tok)
+        cache = self._release_jit(cache, src)
+        return cache, npages * self._page_bytes()
 
     def insert(self, cache, kv, slot: int, length: int) -> dict:
         """Park a prefill's blocks into ``slot`` (consumes ``cache``).

@@ -51,16 +51,19 @@ INT8_MAX = 127.0
 SCALE_DTYPE = jnp.float32
 
 
-def cache_pspecs(quantized: bool = False) -> dict:
-    """PartitionSpecs of the cache pytree: K/V head axis over 'tp', the
-    rest replicated (slots could shard over 'dp' later; the engine serves
-    a tp-only mesh today). int8 caches add per-row scale tensors whose
-    trailing head axis shards over 'tp' alongside the K/V heads they
-    scale."""
-    kv = P(None, None, None, "tp", None)
-    specs = {"k": kv, "v": kv, "lengths": P()}
+def cache_pspecs(quantized: bool = False, dp: int = 1) -> dict:
+    """PartitionSpecs of the cache pytree: K/V head axis over 'tp', and —
+    on a dp-sharded serving mesh (``dp > 1``) — the slot axis over 'dp',
+    so each dp shard owns ``slots / dp`` contiguous slots of cache plus
+    their length rows. ``dp == 1`` keeps the historical tp-only specs
+    byte-identical. int8 caches add per-row scale tensors whose trailing
+    head axis shards over 'tp' alongside the K/V heads they scale."""
+    slot_ax = "dp" if dp > 1 else None
+    kv = P(None, slot_ax, None, "tp", None)
+    specs = {"k": kv, "v": kv,
+             "lengths": P(slot_ax) if dp > 1 else P()}
     if quantized:
-        scale = P(None, None, None, "tp")
+        scale = P(None, slot_ax, None, "tp")
         specs["k_scale"] = scale
         specs["v_scale"] = scale
     return specs

@@ -76,22 +76,27 @@ class PagePoolExhausted(RuntimeError):
 # --------------------------------------------------------------------------- #
 
 
-def cache_pspecs(quantized: bool = False, policy: bool = False) -> dict:
+def cache_pspecs(quantized: bool = False, policy: bool = False,
+                 dp: int = 1) -> dict:
     """PartitionSpecs of the paged cache pytree: identical to the
     contiguous layout's (the kv-head axis of the pool — and of the int8
-    scale tensors — shards over 'tp'; page axes are replicated), plus the
-    replicated ``block_tables``. The ``hot_bf16`` policy adds the int8
-    side pool (``k_q``/``v_q`` + scales, same head sharding) and the
-    replicated per-page ``page_quant`` flags."""
+    scale tensors — shards over 'tp'; page axes are replicated at dp=1),
+    plus ``block_tables``. On a dp-sharded serving mesh (``dp > 1``) the
+    POOL PAGE axis shards over 'dp' — each dp shard owns
+    ``num_pages / dp`` pages holding only its own slots' K/V — and the
+    per-slot ``block_tables``/``lengths`` rows shard with their slots.
+    The ``hot_bf16`` policy adds the int8 side pool (``k_q``/``v_q`` +
+    scales, same sharding) and the per-page ``page_quant`` flags."""
     from jax.sharding import PartitionSpec as P
 
-    specs = kv_cache.cache_pspecs(quantized)
-    specs["block_tables"] = P()
+    slot_ax = "dp" if dp > 1 else None
+    specs = kv_cache.cache_pspecs(quantized, dp=dp)
+    specs["block_tables"] = P(slot_ax, None) if dp > 1 else P()
     if policy:
-        kv = P(None, None, None, "tp", None)
-        scale = P(None, None, None, "tp")
+        kv = P(None, slot_ax, None, "tp", None)
+        scale = P(None, slot_ax, None, "tp")
         specs.update(k_q=kv, v_q=kv, k_scale=scale, v_scale=scale,
-                     page_quant=P())
+                     page_quant=P(slot_ax) if dp > 1 else P())
     return specs
 
 
@@ -830,7 +835,10 @@ class PagedKV:
         return (self.pool.free_count + self.radix.evictable_count()
                 - self.future_need())
 
-    def can_admit(self, need: int) -> bool:
+    def can_admit(self, need: int, slot: int = None) -> bool:
+        """Whether ``need`` pages are claimable right now. ``slot`` is
+        accepted (and ignored) for signature parity with the dp-sharded
+        manager, where admission capacity is per-shard."""
         return need <= self.available_pages()
 
     # ---- slot lifecycle ---------------------------------------------------
@@ -1025,3 +1033,346 @@ class PagedKV:
             # kv_bytes_per_token) weight their accounting with this
             "kv_pages_quant": int(np.sum(self.pool.refs[1:] == 1)),
         }
+
+
+# --------------------------------------------------------------------------- #
+# dp-sharded host allocator
+# --------------------------------------------------------------------------- #
+
+
+class _PoolAggregate:
+    """Read-only pool view summed over a ShardedPagedKV's shard pools —
+    the surface ``batcher.refresh_gauges`` / bench / tests consume.
+    ``refs`` concatenates the shard pools' refcount arrays in shard
+    order, so it is indexed by GLOBAL page id (a copy: mutate the shard
+    pools, never this)."""
+
+    def __init__(self, owner: "ShardedPagedKV"):
+        self._owner = owner
+        self.num_pages = owner.num_pages
+
+    @property
+    def usable_pages(self) -> int:
+        return sum(sh.pool.usable_pages for sh in self._owner.shards)
+
+    @property
+    def free_count(self) -> int:
+        return sum(sh.pool.free_count for sh in self._owner.shards)
+
+    @property
+    def live_count(self) -> int:
+        return sum(sh.pool.live_count for sh in self._owner.shards)
+
+    @property
+    def shared_count(self) -> int:
+        return sum(sh.pool.shared_count for sh in self._owner.shards)
+
+    @property
+    def refs(self) -> np.ndarray:
+        return np.concatenate([sh.pool.refs for sh in self._owner.shards])
+
+
+class _ShardedRadix:
+    """The slim radix surface external callers touch (page_transport's
+    ``plan_adopt``, serve's drain-time ``cached_prefixes``, tests'
+    ``match``), dispatched over per-shard tries. An import is planned and
+    landed on ONE shard — ``plan_adopt`` records the chosen shard so the
+    owner's ``alloc_import``/``finish_import`` land the pages there —
+    picked as the shard already caching the most of the prefix (fewest
+    missing chunks), free pages breaking ties."""
+
+    def __init__(self, owner: "ShardedPagedKV"):
+        self._owner = owner
+
+    @property
+    def evictions(self) -> int:
+        return sum(sh.radix.evictions for sh in self._owner.shards)
+
+    def match(self, ids, salt: str = "") -> tuple:
+        """Longest cached prefix across every shard's trie, page ids
+        GLOBAL. Ties go to the lowest shard (deterministic)."""
+        best_pages, best_matched = [], 0
+        for s, sh in enumerate(self._owner.shards):
+            pages, matched = sh.radix.match(ids, salt=salt)
+            if matched > best_matched:
+                base = s * self._owner.pages_per_shard
+                best_pages = [p + base for p in pages]
+                best_matched = matched
+        return best_pages, best_matched
+
+    def plan_adopt(self, ids, salt: str = "") -> list:
+        o = self._owner
+        best, best_key = 0, None
+        for s, sh in enumerate(o.shards):
+            missing = len(sh.radix.plan_adopt(ids, salt=salt))
+            key = (missing, -sh.pool.free_count, s)
+            if best_key is None or key < best_key:
+                best, best_key = s, key
+        o._import_shard = best
+        return o.shards[best].radix.plan_adopt(ids, salt=salt)
+
+    def cached_prefixes(self, limit: int = 4) -> list:
+        """Hottest cached prefixes across shards (per-shard LRU clocks
+        are independent; round-robin merge keeps every shard's hottest
+        represented)."""
+        per = [sh.radix.cached_prefixes(limit) for sh in self._owner.shards]
+        out: list = []
+        i = 0
+        while len(out) < max(0, limit) and any(per):
+            for entries in per:
+                if i < len(entries) and len(out) < limit:
+                    out.append(entries[i])
+            i += 1
+            if all(i >= len(entries) for entries in per):
+                break
+        return out
+
+
+class ShardedPagedKV:
+    """Host-side page manager for a dp-sharded engine: ``dp_size``
+    independent ``PagedKV`` allocators, one per dp shard, behind the
+    global-slot / global-page-id surface the engine and batcher already
+    speak.
+
+    Layout contract (mirrors ``cache_pspecs(dp=...)``): global slot
+    ``i`` lives on shard ``i // slots_per_shard``; shard ``s`` owns pool
+    pages ``[s * pages_per_shard, (s+1) * pages_per_shard)`` and page
+    ``s * pages_per_shard`` is that shard's NULL page (the reserved
+    scribble target — so a slot's table NEVER references a page outside
+    its own shard, and the jitted dispatch needs zero cross-shard
+    traffic to resolve any table entry). ``tables`` materializes the
+    global [slots, max_pages] int32 view with shard-local NULLs mapped
+    to the owning shard's null page. ``host_len``/``priced`` are master
+    numpy arrays whose per-shard slices are rewired INTO the shard
+    allocators as views, so in-place writes on either side stay
+    coherent.
+
+    Prefix sharing is per shard (each shard's radix trie only ever
+    references its own pages); cross-shard reuse happens by page
+    MIGRATION (engine.migrate_slot / the batcher's rebalance planner),
+    never by a table pointing across the dp axis."""
+
+    def __init__(self, dp_size: int, slots: int, page_len: int,
+                 max_pages: int, num_pages: int,
+                 prefix_cache: bool = True):
+        dp_size = int(dp_size)
+        slots = int(slots)
+        num_pages = int(num_pages)
+        if dp_size < 1:
+            raise ValueError("dp_size must be >= 1")
+        if slots % dp_size:
+            raise ValueError(
+                f"slots ({slots}) must divide evenly over dp_size "
+                f"({dp_size}) — each shard serves slots/dp slots")
+        if num_pages % dp_size:
+            raise ValueError(
+                f"kv_num_pages ({num_pages}) must divide evenly over "
+                f"dp_size ({dp_size}) — the pool page axis shards over "
+                "'dp'")
+        if num_pages // dp_size < 2:
+            raise ValueError(
+                "kv_num_pages must give every dp shard >= 2 pages "
+                "(page 0 of each shard is its reserved NULL page)")
+        self.dp_size = dp_size
+        self.slots = slots
+        self.slots_per_shard = slots // dp_size
+        self.page_len = int(page_len)
+        self.max_pages = int(max_pages)
+        self.num_pages = num_pages
+        self.pages_per_shard = num_pages // dp_size
+        self.prefix_cache = bool(prefix_cache)
+        self.shards = [
+            PagedKV(self.slots_per_shard, self.page_len, self.max_pages,
+                    self.pages_per_shard, prefix_cache=prefix_cache)
+            for _ in range(dp_size)
+        ]
+        self.radix = _ShardedRadix(self)
+        self.pool = _PoolAggregate(self)
+        self._import_shard = None
+        self.reset()
+
+    # ---- shard/global coordinate helpers ----------------------------------
+
+    def shard_of(self, slot: int) -> int:
+        return int(slot) // self.slots_per_shard
+
+    def local_slot(self, slot: int) -> int:
+        return int(slot) % self.slots_per_shard
+
+    def _shard_base(self, s: int) -> int:
+        return s * self.pages_per_shard
+
+    def reset(self) -> None:
+        for sh in self.shards:
+            sh.reset()
+        # master slot-state arrays; shard allocators hold slice VIEWS so
+        # their in-place writes (free_slot, match_prefix, set_len) land
+        # in the master the engine/batcher read
+        self.host_len = np.zeros(self.slots, np.int64)
+        self.priced = np.zeros(self.slots, np.int64)
+        spb = self.slots_per_shard
+        for s, sh in enumerate(self.shards):
+            sh.host_len = self.host_len[s * spb:(s + 1) * spb]
+            sh.priced = self.priced[s * spb:(s + 1) * spb]
+        self._import_shard = None
+
+    # ---- global table view -------------------------------------------------
+
+    @property
+    def tables(self) -> np.ndarray:
+        """Global [slots, max_pages] block tables with GLOBAL page ids:
+        shard s's local entries offset by its page base, so its local
+        NULL (0) becomes page ``s * pages_per_shard`` — exactly that
+        shard's reserved null page under the dp-sharded pool layout.
+        Recomputed per access (a copy: write through the shard
+        allocators, never this view)."""
+        return np.vstack([sh.tables + self._shard_base(s)
+                          for s, sh in enumerate(self.shards)])
+
+    # ---- pricing / admission ----------------------------------------------
+
+    def pages_for(self, tokens: int) -> int:
+        return self.shards[0].pages_for(tokens)
+
+    @property
+    def usable_pages(self) -> int:
+        """Admission ceiling: the most pages ONE slot can ever hold. A
+        slot's pages all live on its own shard, so this is a single
+        shard's capacity — a request needing more can never fit, however
+        empty the other shards are. (Aggregate capacity is
+        ``pool.usable_pages``.)"""
+        return self.pages_per_shard - 1
+
+    def available_pages(self) -> int:
+        return sum(sh.available_pages() for sh in self.shards)
+
+    def can_admit(self, need: int, slot: int = None) -> bool:
+        """Whether ``need`` pages are claimable — on ``slot``'s own shard
+        when a slot is named (admission targets a specific seat), on ANY
+        shard otherwise."""
+        if slot is not None:
+            return self.shards[self.shard_of(slot)].can_admit(need)
+        return any(sh.can_admit(need) for sh in self.shards)
+
+    # ---- slot lifecycle (global-slot delegation) --------------------------
+
+    def match_prefix(self, slot: int, ids, cap_last: bool = True,
+                     salt: str = "") -> int:
+        return self.shards[self.shard_of(slot)].match_prefix(
+            self.local_slot(slot), ids, cap_last=cap_last, salt=salt)
+
+    def ensure_writable(self, slot: int, from_pos: int,
+                        to_pos: int) -> list:
+        s = self.shard_of(slot)
+        base = self._shard_base(s)
+        return [(src + base, dst + base) for src, dst in
+                self.shards[s].ensure_writable(self.local_slot(slot),
+                                               from_pos, to_pos)]
+
+    def register_prompt(self, slot: int, ids, salt: str = "") -> None:
+        self.shards[self.shard_of(slot)].register_prompt(
+            self.local_slot(slot), ids, salt=salt)
+
+    def advance(self, slot_counts: np.ndarray) -> None:
+        self.host_len += np.asarray(slot_counts, np.int64)
+
+    def set_len(self, slot: int, n: int) -> None:
+        self.host_len[slot] = int(n)
+
+    def free_slot(self, slot: int) -> None:
+        self.shards[self.shard_of(slot)].free_slot(self.local_slot(slot))
+
+    def quant_flags(self) -> np.ndarray:
+        """Global per-page flags, shard-major — the device
+        ``page_quant``'s P('dp') layout."""
+        return np.concatenate([sh.quant_flags() for sh in self.shards])
+
+    # ---- page transport (global page ids) ---------------------------------
+
+    def acquire_prefix(self, ids, salt: str = "") -> tuple:
+        """Export pin against the shard caching the longest prefix of
+        ``ids``; returns GLOBAL page ids."""
+        if not self.prefix_cache:
+            return [], 0
+        best_s, best_matched = None, 0
+        for s, sh in enumerate(self.shards):
+            _, matched = sh.radix.match(ids, salt=salt)
+            if matched > best_matched:
+                best_s, best_matched = s, matched
+        if best_s is None:
+            # still counts as a query on shard 0 (the vanilla manager's
+            # acquire path never touches counters; neither does this)
+            return [], 0
+        held, matched = self.shards[best_s].acquire_prefix(ids, salt=salt)
+        base = self._shard_base(best_s)
+        return [pid + base for pid in held], matched
+
+    def release_pages(self, pids) -> None:
+        pps = self.pages_per_shard
+        for pid in pids:
+            pid = int(pid)
+            self.shards[pid // pps].pool.unref(pid % pps)
+
+    def alloc_import(self, n: int) -> list:
+        """Allocate ``n`` import pages on the shard ``radix.plan_adopt``
+        chose (falling back to the freest shard when no plan ran);
+        returns GLOBAL page ids. All-or-nothing like the vanilla path."""
+        s = self._import_shard
+        if s is None:
+            s = max(range(self.dp_size),
+                    key=lambda i: (self.shards[i].pool.free_count, -i))
+            self._import_shard = s
+        base = self._shard_base(s)
+        return [pid + base for pid in self.shards[s].alloc_import(n)]
+
+    def finish_import(self, ids, chunk_pids: dict, salt: str = "") -> int:
+        """Graft import pages (GLOBAL ids, on the planned shard) into
+        that shard's radix; clears the sticky import-shard choice."""
+        s = self._import_shard
+        if s is None and chunk_pids:
+            s = next(iter(chunk_pids.values())) // self.pages_per_shard
+        self._import_shard = None
+        if s is None:
+            return 0
+        base = self._shard_base(s)
+        local = {i: pid - base for i, pid in chunk_pids.items()}
+        return self.shards[s].finish_import(ids, local, salt=salt)
+
+    # ---- observability ----------------------------------------------------
+
+    def shard_occupancy(self) -> list:
+        """Occupied slots per shard (host_len > 0) — the rebalance
+        planner's input and the ``picotron_shard_occupancy`` gauge."""
+        spb = self.slots_per_shard
+        return [int(np.count_nonzero(
+            self.host_len[s * spb:(s + 1) * spb] > 0))
+            for s in range(self.dp_size)]
+
+    def stats(self) -> dict:
+        total = self.pool.usable_pages
+        live = self.pool.live_count
+        agg = {
+            "kv_layout": "paged",
+            "kv_page_len": self.page_len,
+            "kv_pages_total": total,
+            "kv_pages_free": self.pool.free_count,
+            "kv_pages_live": live,
+            "kv_pool_utilization": round(live / max(total, 1), 4),
+            "kv_pages_shared": self.pool.shared_count,
+            "prefix_queries": sum(sh.prefix_queries for sh in self.shards),
+            "prefix_hits": sum(sh.prefix_hits for sh in self.shards),
+            "cow_copies": sum(sh.cow_copies for sh in self.shards),
+            "radix_evictions": self.radix.evictions,
+            "kv_pages_quant": sum(
+                int(np.sum(sh.pool.refs[1:] == 1)) for sh in self.shards),
+            "dp_size": self.dp_size,
+            "kv_shard_pages_live": [sh.pool.live_count
+                                    for sh in self.shards],
+            "shard_occupancy": self.shard_occupancy(),
+        }
+        prompt = sum(sh.prompt_tokens for sh in self.shards)
+        cached = sum(sh.cached_tokens for sh in self.shards)
+        agg["prefix_hit_rate"] = (round(cached / prompt, 4)
+                                  if prompt else None)
+        agg["prefix_cached_tokens"] = cached
+        return agg

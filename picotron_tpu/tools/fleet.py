@@ -567,6 +567,10 @@ class FleetController:
             "ttft_p95": hist_quantile(prom, "picotron_ttft_seconds",
                                       0.95),
             "draining": draining,
+            # dp-sharded replicas: the controller sees one dp=N worker as
+            # ONE bigger replica (capacity math scales by dp_size), not N
+            # small ones; absent on old workers -> 1
+            "dp_size": prom.get("picotron_dp_size", 1.0),
         }
 
     # ---- one control tick -------------------------------------------------

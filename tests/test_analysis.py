@@ -188,6 +188,49 @@ def test_j001_hidden_state_hook_shape(tmp_path):
     assert len(bad) == 2
 
 
+def test_j001_dp_shard_occupancy_read_placement(tmp_path):
+    """The ISSUE-18 rebalance-planner shape: per-shard occupancy must be
+    computed HOST-SIDE from the batcher's slot list, OUTSIDE the jitted
+    dispatch (batcher.shard_occupancy) — a plain Python walk, silent.
+    The hazard variant reads a TRACED occupancy count inside the
+    dp-sharded dispatch (int()/bool-coercion host syncs on the decode
+    hot path): exactly J001."""
+    found = _scan(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def shard_occupancy(slots, slots_per_shard, dp_size):
+            # host-side planner input: a walk over the Python slot list,
+            # never a device value
+            occ = [0] * dp_size
+            for i, s in enumerate(slots):
+                if s is not None:
+                    occ[i // slots_per_shard] += 1
+            return occ
+
+        @jax.jit
+        def dispatch(params, tokens, budget):
+            # the dispatch only consumes traced arrays; occupancy never
+            # enters the program
+            active = (budget > 0).astype(jnp.int32)
+            return tokens * active
+        """)
+    assert found == []
+
+    bad = _scan(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def dispatch(tokens, budget, slots_per_shard: int = 2):
+            occ = jnp.sum((budget > 0).astype(jnp.int32))
+            if int(occ) > slots_per_shard:   # host sync mid-dispatch
+                return tokens * 0
+            return tokens
+        """, name="fix_bad.py")
+    assert _rules(bad) == ["PICO-J001"]
+
+
 # --------------------------------------------------------------------------- #
 # PICO-J002: host nondeterminism under trace
 # --------------------------------------------------------------------------- #

@@ -94,6 +94,45 @@ def test_hist_quantile_reads_cumulative_buckets():
         {'x_bucket{le="+Inf"}': 0.0}, "x", 0.95) == 0.0
 
 
+def test_scrape_tolerates_dp_sharded_worker_fields():
+    """ISSUE-18 regression: a dp-sharded worker's /metrics page carries
+    picotron_dp_size, per-shard picotron_shard_occupancy{shard} gauges,
+    and picotron_slot_migrations_total{outcome} counters next to the
+    classic scrape fields. The router's probe extraction (the exact dict
+    _probe builds from parse_prometheus) must keep reading the fields it
+    knows and stay undisturbed by the new families."""
+    from picotron_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+    from picotron_tpu.tools.router import tenant_scrape
+
+    reg = MetricsRegistry()
+    reg.gauge("picotron_queue_depth").set(3)
+    reg.gauge("picotron_active_slots").set(5)
+    reg.gauge("picotron_kv_pool_utilization").set(0.25)
+    # the new dp-sharded worker surface
+    reg.gauge("picotron_dp_size").set(2)
+    reg.gauge("picotron_shard_occupancy", shard="0").set(3)
+    reg.gauge("picotron_shard_occupancy", shard="1").set(2)
+    reg.counter("picotron_slot_migrations_total", outcome="ok").inc(4)
+    reg.counter("picotron_slot_migrations_total", outcome="aborted").inc()
+    prom = parse_prometheus(reg.prometheus())
+    # the new families parsed as labeled samples...
+    assert prom["picotron_dp_size"] == 2.0
+    assert prom['picotron_shard_occupancy{shard="0"}'] == 3.0
+    assert prom['picotron_shard_occupancy{shard="1"}'] == 2.0
+    assert prom['picotron_slot_migrations_total{outcome="ok"}'] == 4.0
+    # ...and the probe's scrape dict (router.py _probe) is unaffected
+    scrape = {
+        "queue_depth": prom.get("picotron_queue_depth", 0.0),
+        "active_slots": prom.get("picotron_active_slots", 0.0),
+        "pool_utilization": prom.get("picotron_kv_pool_utilization", 0.0),
+        "ttft_p95": hist_quantile(prom, "picotron_ttft_seconds", 0.95),
+        "tenants": tenant_scrape(prom),
+    }
+    assert scrape == {"queue_depth": 3.0, "active_slots": 5.0,
+                      "pool_utilization": 0.25, "ttft_p95": 0.0,
+                      "tenants": {}}
+
+
 def test_router_config_validation():
     RouterConfig().validate()  # defaults are valid
     with pytest.raises(ValueError, match="affinity_page_len"):
