@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke dp-smoke tenant-smoke fleet-chaos-smoke fleet-bench obs-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke dp-smoke tenant-smoke fleet-chaos-smoke fleet-bench obs-smoke overlap-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -26,6 +26,7 @@ test-all: native lint
 	$(MAKE) dp-smoke
 	$(MAKE) tenant-smoke
 	$(MAKE) fleet-chaos-smoke
+	$(MAKE) overlap-smoke
 
 # picolint static analysis (picotron_tpu/analysis/, docs/ANALYSIS.md):
 # JAX hot-path rules (host syncs on traced values, trace-time
@@ -167,11 +168,27 @@ serve-smoke:
 # every dispatch -> delivery). Runs inside `make test-all`.
 OBS_SMOKE_DIR := /tmp/picotron-obs-smoke
 obs-smoke:
-	rm -rf $(OBS_SMOKE_DIR)
+	rm -rf $(OBS_SMOKE_DIR) $(OBS_SMOKE_DIR)-overlap
 	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.serve --smoke \
 	  --obs-dump $(OBS_SMOKE_DIR)
 	python -m picotron_tpu.tools.trace_dump $(OBS_SMOKE_DIR)/trace.json \
 	  --require-request-chain
+	JAX_PLATFORMS=cpu python -m picotron_tpu.tools.serve --smoke \
+	  --overlap --obs-dump $(OBS_SMOKE_DIR)-overlap
+	python -m picotron_tpu.tools.trace_dump \
+	  $(OBS_SMOKE_DIR)-overlap/trace.json \
+	  --require-request-chain --require-overlap-chain
+
+# Zero-bubble overlapped-scheduling smoke (inference.overlap,
+# docs/INFERENCE.md "Overlapped scheduling"): the bench_decode
+# --overlap ab protocol — the SAME batcher workload with the pipeline
+# off then on, synthetic device windows + injected per-token host work.
+# Gates bit-identical token streams, overlap-on dispatch-gap p50
+# <= 0.5x overlap-off, and tokens/s >= 1.3x with host work and device
+# time comparable. Runs inside `make test-all`; the serving default
+# stays overlap OFF, so decode/spec-smoke output is unchanged.
+overlap-smoke:
+	JAX_PLATFORMS=cpu python bench_decode.py --overlap ab
 
 # Multi-replica router chaos drill (tools/router.py, docs/SERVING.md
 # "Multi-replica fabric"): 3 in-process serve.py replicas behind the

@@ -549,6 +549,24 @@ class InferenceConfig:
         default_factory=SpecControllerConfig)
     # Multi-tenant serving — see TenancyConfig.
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
+    # Zero-bubble overlapped scheduling (docs/INFERENCE.md "Overlapped
+    # scheduling"): the batcher issues dispatch N+1 BEFORE syncing
+    # dispatch N, so token delivery / drafting / admission run while the
+    # device executes the next round. Requires the per-slot key schedule
+    # (key_schedule resolves to "slot" under "auto") so sampled streams
+    # stay bit-identical to overlap-off. False (default) keeps the
+    # issue-then-sync loop byte-identical to today's smokes.
+    overlap: bool = False
+    # PRNG key schedule for sampled decode/verify tokens:
+    # "round" — one fresh key per dispatch round (the historical
+    #   schedule; streams depend on round structure, so it cannot
+    #   overlap);
+    # "slot"  — one base key per ADMITTED request, token at position p
+    #   keyed fold_in(base, p-1): streams depend only on (base key,
+    #   prompt, logits), independent of round boundaries, draft
+    #   contents, and controller decisions;
+    # "auto" (default) — "slot" when overlap is on, else "round".
+    key_schedule: str = "auto"
 
     def __post_init__(self):
         # from_dict hands nested blocks through as plain dicts; coerce so
@@ -1149,6 +1167,20 @@ class Config:
             raise ValueError(
                 "inference.spec_history_window must be >= 0 (0 = "
                 "unbounded match scan)")
+        if not isinstance(inf.overlap, bool):
+            raise ValueError(
+                f"inference.overlap must be a JSON boolean (true/false), "
+                f"got {inf.overlap!r}")
+        if inf.key_schedule not in ("auto", "round", "slot"):
+            raise ValueError(
+                f"unknown inference.key_schedule {inf.key_schedule!r} "
+                "(auto|round|slot)")
+        if inf.overlap and inf.key_schedule == "round":
+            raise ValueError(
+                "inference.overlap requires the per-slot key schedule — "
+                "round-keyed sampling ties token streams to round "
+                "boundaries, which the lookahead pipeline changes; set "
+                "inference.key_schedule: 'slot' (or leave it 'auto')")
         sc = inf.spec_controller
         if not isinstance(sc.enabled, bool):
             raise ValueError(

@@ -81,6 +81,7 @@ all of it for no-ops.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -339,6 +340,54 @@ class ContinuousBatcher:
             attempts=engine.cfg.resilience.dispatch_attempts,
             backoff=engine.cfg.resilience.dispatch_backoff,
             desc="serving dispatch")
+        # ---- overlapped (zero-bubble) scheduling state --------------------
+        # inference.overlap: issue dispatch N+1 BEFORE syncing dispatch N
+        # (_step_overlap). The engine resolved the knobs at construction;
+        # the batcher mirrors them so every branch below is one attribute
+        # read, and flips the engine to deferred page-table advance: under
+        # overlap the paged host_len bookkeeping lands at SYNC time (after
+        # the late-stop mask) via engine.apply_advance, never inside the
+        # dispatch wrapper.
+        self._overlap = bool(getattr(engine, "overlap", False))
+        self._sched = getattr(engine, "key_schedule", "round")
+        if self._overlap:
+            engine.defer_advance = True
+        # per-slot PRNG bases (key_schedule == "slot"): the token at
+        # 0-based sequence index p is keyed fold_in(base, p - 1) no matter
+        # how positions are grouped into rounds — the round-count-
+        # independent schedule the overlap bit-identity gate rests on
+        # (docs/INFERENCE.md "Overlapped scheduling"). One _split() per
+        # admit seeds the base: the same chain link the round schedule
+        # spends on its admit key, so admission order fixes the streams.
+        self._base_keys = np.zeros((n, 2), np.uint32)
+        # occupancy epoch per slot: bumped at finish/admit/migrate. The
+        # in-flight round snapshots it at issue; sync drops any row whose
+        # epoch moved (late stop, re-seat) — the exactly-once guarantee.
+        self._epoch = np.zeros(n, np.int64)
+        self._inflight = None   # issued-not-yet-synced round record
+        self._dev_last = None   # device-resident [slots] last-token row
+        self._round_seq = 0     # issued rounds (span labels)
+        # scheduling-gap instrumentation (BOTH modes): host time between
+        # one round's sync end and the next issue — what overlap exists
+        # to hide. 0.0 whenever a round is still in flight at issue.
+        self._t_last_sync_end = None
+        self._step_sync_wait = 0.0    # per-step blocked-on-device time
+        self._ov_device_s = 0.0       # summed issue -> sync-end windows
+        self._ov_t0 = None            # first issue (efficiency wall start)
+        self._ov_t1 = None            # last sync end (efficiency wall end)
+        self._synthetic_sync_s = 0.0  # bench knob: padded device window
+        self._gap_hist = reg.histogram(
+            "picotron_dispatch_gap_seconds",
+            "issue-to-issue scheduling gap net of device time")
+        self._host_work_hist = reg.histogram(
+            "picotron_host_work_seconds",
+            "per-round host scheduling work (step wall minus sync wait)")
+        # leaf lock for the scratch fields a stats() scrape may read from
+        # another thread while the dispatch loop mutates them
+        # (_host_sync_s, _last_prefill). Strictly a leaf: no other lock
+        # and no blocking call is ever taken inside it (picolint
+        # PICO-C002/C003 pin this in tests/test_analysis.py).
+        self._scratch_mu = threading.Lock()
 
     @property
     def accept_rate(self) -> Optional[float]:
@@ -387,7 +436,9 @@ class ContinuousBatcher:
 
     @property
     def busy(self) -> bool:
-        return bool(self._pending) or any(s is not None for s in self._slots)
+        return (bool(self._pending)
+                or any(s is not None for s in self._slots)
+                or self._inflight is not None)
 
     @property
     def queue_depth(self) -> int:
@@ -703,6 +754,24 @@ class ContinuousBatcher:
         d["shard_occupancy"] = self.shard_occupancy()
         d["rebalance_count"] = self.rebalance_count
         d["rebalance_bytes"] = self.rebalance_bytes
+        # scratch the dispatch/admission loop overwrites mid-round: a
+        # stats() scrape from another thread (the serve /statz handler)
+        # snapshots them under the same leaf lock every writer holds
+        with self._scratch_mu:
+            d["last_host_sync_s"] = self._host_sync_s
+            d["last_prefill"] = dict(self._last_prefill)
+        # the overlap A/B payload (bench_decode --overlap, obs-smoke):
+        # issue-to-issue gap and per-round host work percentiles from the
+        # histograms' retained samples, plus the device-busy fraction
+        ov = dict(enabled=self._overlap,
+                  dispatch_gap_s=self._gap_hist.percentiles(),
+                  host_work_s=self._host_work_hist.percentiles())
+        if self._ov_t0 is not None and self._ov_t1 is not None:
+            wall = max(self._ov_t1 - self._ov_t0, 1e-9)
+            ov["device_busy_s"] = self._ov_device_s
+            ov["wall_s"] = wall
+            ov["overlap_efficiency"] = min(1.0, self._ov_device_s / wall)
+        d["overlap"] = ov
         return d
 
     # ---- one scheduler round ----------------------------------------------
@@ -710,6 +779,17 @@ class ContinuousBatcher:
     def _split(self):
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def _dev_tok(self):
+        """The device-resident [slots] last-token row (overlap only): the
+        next round's input tokens come from here, so issuing round N+1
+        never waits on a host materialization of round N. The slot
+        programs' ``next_tok`` output replaces it wholesale at issue;
+        admissions and rebalance patch individual rows in lockstep with
+        ``_last_tok``."""
+        if self._dev_last is None:
+            self._dev_last = jnp.asarray(self._last_tok)
+        return self._dev_last
 
     _REASON_COUNTER = {"eos": "completed", "length": "completed",
                        "timeout": "expired", "error": "errored",
@@ -754,6 +834,9 @@ class ContinuousBatcher:
         for d in self._drafters.values():
             d.forget(s.req.uid)
         self._slots[i] = None
+        # retire bumps the seat's epoch: any in-flight round that was
+        # issued against this occupant drops the row at sync
+        self._epoch[i] += 1
         self._cache = self.engine.release(self._cache, i)
         self._last_tok[i] = 0
         self._temp[i] = 0.0
@@ -838,7 +921,9 @@ class ContinuousBatcher:
             self._cache, logits, n, cached = out[:4]
             hidden = out[4] if rh else None
             self.prefill_dispatches += n
-            self._last_prefill = {"dispatches": n, "cached_tokens": cached}
+            with self._scratch_mu:
+                self._last_prefill = {"dispatches": n,
+                                      "cached_tokens": cached}
         elif len(req.prompt) > self.engine.prefill_chunk:
             # long prompt: fixed-width chunks straight into the slot —
             # O(1) compiled shapes in prompt length
@@ -849,7 +934,8 @@ class ContinuousBatcher:
             self._cache, logits = out[:2]
             hidden = out[2] if rh else None
             self.prefill_dispatches += n_chunks
-            self._last_prefill = {"dispatches": n_chunks}
+            with self._scratch_mu:
+                self._last_prefill = {"dispatches": n_chunks}
         else:
             out = self.engine.prefill(self.params, req.prompt,
                                       sample=sample, adapter_id=adapter)
@@ -858,7 +944,8 @@ class ContinuousBatcher:
             self._cache = self.engine.insert(
                 self._cache, kv, i, len(req.prompt))
             self.prefill_dispatches += 1
-            self._last_prefill = {"dispatches": 1}
+            with self._scratch_mu:
+                self._last_prefill = {"dispatches": 1}
         if hidden is not None:
             # the prompt's last hidden state seeds the slot's drafting row
             self._hidden = self._hidden.at[i].set(jnp.asarray(hidden)[0])
@@ -915,8 +1002,9 @@ class ContinuousBatcher:
             # verify re-seeds it; a garbage first draft is rejected by
             # verify either way — correctness never depends on this)
             self._hidden = self._hidden.at[i].set(0)
-        self._last_prefill = {"dispatches": 0, "cached_tokens": cached,
-                              "imported_pages": info["pages_imported"]}
+        with self._scratch_mu:
+            self._last_prefill = {"dispatches": 0, "cached_tokens": cached,
+                                  "imported_pages": info["pages_imported"]}
         self.handoff_seated += 1
         return ("handoff", int(first))
 
@@ -1038,7 +1126,19 @@ class ContinuousBatcher:
             # split per admit — so the two modes emit seeded-identical
             # streams (tests/test_sampling_epilogue.py pins this through
             # a full batcher run).
-            key = self._split() if self.engine.sample_on_device else None
+            fold = None
+            if self._sched == "slot":
+                # slot schedule: the one per-admit split seeds the slot's
+                # BASE key; the first generated token sits at sequence
+                # index len(prompt) and is keyed fold_in(base, index - 1)
+                # like every later position (see _base_keys in __init__)
+                self._base_keys[i] = np.asarray(self._split())
+                fold = jax.random.fold_in(
+                    jnp.asarray(self._base_keys[i]), len(req.prompt) - 1)
+                key = fold if self.engine.sample_on_device else None
+            else:
+                key = (self._split() if self.engine.sample_on_device
+                       else None)
             try:
                 pf_span = self.obs.tracer.begin(
                     "prefill", parent=root, uid=req.uid,
@@ -1085,6 +1185,9 @@ class ContinuousBatcher:
                 slot.queue_wait_s = now - submit_t
                 self._queue_wait_hist.observe(slot.queue_wait_s)
             self._slots[i] = slot
+            # new occupant: bump the seat's epoch so an in-flight round
+            # issued against the PREVIOUS occupant drops this row at sync
+            self._epoch[i] += 1
             self._adapter[i] = (req.adapter_slot
                                 if self.engine.adapters is not None else 0)
             # fresh request: the controller restarts the slot's policy
@@ -1108,11 +1211,21 @@ class ContinuousBatcher:
                 # the one int crossing here is the whole logits payload
                 first = int(np.asarray(logits).reshape(-1)[0])
             else:
-                first = int(sampling.sample(
-                    logits, self._split(),
+                # slot schedule host-side: the folded per-position key
+                # (categorical over the [1, V] row draws the same token
+                # the device epilogue's [V] draw would — element count,
+                # not shape, fixes the Gumbel draw)
+                skey = fold if self._sched == "slot" else self._split()
+                first = int(sampling.sample_jit(
+                    logits, skey,
                     np.float32([req.temperature]),
                     np.int32([req.top_k]),
                     np.float32([req.top_p]))[0])
+            if self._overlap:
+                # seed the device-carried last-token row for the seat
+                # (round N+1's input): an in-flight round only reads it
+                # through its snapshotted operand, so this patch is safe
+                self._dev_last = self._dev_tok().at[i].set(first)
             self._token_done(i, first)
 
     # dp rebalance discipline (the fleet controller's hysteresis/cooloff
@@ -1190,6 +1303,17 @@ class ContinuousBatcher:
         self._eos[src] = -1
         self._budget[src] = 0
         self._adapter[src] = 0
+        # the slot-schedule base follows the request (its key stream is
+        # placement-independent), and both seats change occupant — any
+        # in-flight rows for either drop at sync (the overlap path drains
+        # before planning a move, so this is belt and braces)
+        self._base_keys[dst] = self._base_keys[src]
+        self._base_keys[src] = 0
+        self._epoch[src] += 1
+        self._epoch[dst] += 1
+        if self._dev_last is not None:
+            self._dev_last = (self._dev_last.at[dst]
+                              .set(self._dev_last[src]).at[src].set(0))
         if self._hidden is not None:
             self._hidden = (self._hidden.at[dst].set(self._hidden[src])
                             .at[src].set(0))
@@ -1261,7 +1385,15 @@ class ContinuousBatcher:
         decode fallback once every slot's speculation is off). A dispatch
         failure that survives the retry budget is isolated to the slots
         that fail alone (see module docstring) — step() itself never
-        raises for an engine-side fault."""
+        raises for an engine-side fault.
+
+        With ``inference.overlap`` the round runs PIPELINED instead: see
+        ``_step_overlap`` (issue round N+1, then drain round N)."""
+        if self._overlap:
+            self._step_overlap()
+            return
+        t_step0 = self._clock()
+        self._step_sync_wait = 0.0
         self._expire_deadlines()
         self._rebalance()
         self._admit()
@@ -1279,33 +1411,53 @@ class ContinuousBatcher:
                                                     spec_kinds)
         else:
             block = self.engine.decode_block_len
-            keys = np.stack([np.asarray(self._split())
-                             for _ in range(block)])
+            if self._sched == "slot":
+                # per-slot bases: the program folds each row's position
+                # in-trace, so the operand is round-count-independent
+                keys = self._base_keys
+            else:
+                keys = np.stack([np.asarray(self._split())
+                                 for _ in range(block)])
 
             def dispatch(b):
                 t0 = self._clock()
+                self._note_issue(t0)
                 out = self.engine.decode_block(
                     self.params, self._cache, self._last_tok, keys,
                     self._eos, b, self._temp, self._top_k, self._top_p,
                     adapter_ids=(self._adapter if self.engine.adapters
                                  is not None else None))
-                if self.engine.return_hidden:
+                if self._sched == "slot":
+                    # the slot program's extra next-token output feeds the
+                    # overlap pipeline; the synchronous path ignores it
+                    # (_last_tok, updated by the walk, stays authoritative)
+                    if self.engine.return_hidden:
+                        self._cache, toks, counts, _ntok, hid = out
+                    else:
+                        self._cache, toks, counts, _ntok = out
+                        hid = None
+                elif self.engine.return_hidden:
                     self._cache, toks, counts, hid = out
                 else:
                     self._cache, toks, counts = out
                     hid = None
                 self.decode_dispatches += 1
                 t_sync = self._clock()
+                self._synthetic_wait(t0)
                 out = np.asarray(toks), np.asarray(counts), None
                 self._merge_hidden(hid, out[1])
                 t1 = self._clock()
-                self._host_sync_s = t1 - t_sync
+                dt_sync = t1 - t_sync
+                with self._scratch_mu:
+                    self._host_sync_s = dt_sync
+                self._step_sync_wait += dt_sync
+                self._note_sync_end(t0, t1)
                 self.engine.observe_dispatch("decode", t1 - t0,
-                                             host_sync_s=self._host_sync_s)
+                                             host_sync_s=dt_sync)
                 self.obs.tracer.record(
                     "dispatch/decode", t0, t1,
                     slots=int(np.count_nonzero(np.asarray(b) > 0)),
-                    host_sync_s=round(self._host_sync_s, 6))
+                    host_sync_s=round(dt_sync, 6))
                 return out
 
             toks, counts, _, failed = self._guarded_round(dispatch, budget)
@@ -1330,6 +1482,341 @@ class ContinuousBatcher:
                 if self._slots[i] is None:  # device/host rule mismatch guard
                     break
                 self._token_done(i, int(t))
+        self._host_work_hist.observe(
+            max(0.0, self._clock() - t_step0 - self._step_sync_wait))
+
+    # ---- overlapped (zero-bubble) scheduling ------------------------------
+
+    def _note_issue(self, t0: float) -> None:
+        """Record the issue-to-issue scheduling gap: host time between
+        the previous round's sync end and this issue — the bubble overlap
+        exists to close. While a round is still in flight at issue the
+        pipeline is gapless by construction (0.0). Feeds the
+        picotron_dispatch_gap_seconds histogram and /statz ``overlap``."""
+        if self._ov_t0 is None:
+            self._ov_t0 = t0
+        if self._inflight is not None:
+            gap = 0.0
+        elif self._t_last_sync_end is None:
+            return  # first round: nothing to gap against
+        else:
+            gap = max(0.0, t0 - self._t_last_sync_end)
+        self._gap_hist.observe(gap)
+
+    def _synthetic_wait(self, t_issue: float) -> None:
+        """Bench knob: pad the round's device window to at least
+        ``_synthetic_sync_s`` by sleeping the RESIDUAL at the sync point.
+        Models hideable device time on hosts whose model is too small to
+        produce any (chaos latency fires host-side at issue, so it can
+        never be overlapped; this can — bench_decode's --overlap A/B and
+        make overlap-smoke drive it). 0.0 (the default) is a no-op."""
+        if self._synthetic_sync_s > 0.0:
+            wait = t_issue + self._synthetic_sync_s - self._clock()
+            if wait > 0:
+                time.sleep(wait)
+
+    def _note_sync_end(self, t_issue: float, t_end: float) -> None:
+        self._t_last_sync_end = t_end
+        self._ov_device_s += max(0.0, t_end - t_issue)
+        self._ov_t1 = t_end
+
+    def _step_overlap(self) -> None:
+        """One PIPELINED scheduler round (``inference.overlap``): issue
+        round N's dispatch before draining round N-1, so token delivery,
+        finish detection, drafting, and admission all run while the
+        device executes.
+
+            expire -> rebalance -> admit -> issue N -> drain N-1
+
+        Everything host-side sees state that is one round stale — budgets
+        may overshoot (the device stops at EOS on its own and the walk
+        truncates at the host rules), drafts guess from the previous
+        round's tokens (sample-and-match acceptance makes the emitted
+        stream independent of the guesses), and controller/admission
+        decisions land one round late. A slot that finishes while a round
+        is in flight bumps its seat epoch, so the drain drops its rows —
+        exactly-once delivery; its KV overshoot dies with the released
+        pages under the same length-pointer discipline verify overshoot
+        always used. With no occupied slots the in-flight round drains
+        and the pipeline empties (serve.py's shutdown loop relies on
+        ``busy`` covering the in-flight record)."""
+        t_step0 = self._clock()
+        self._step_sync_wait = 0.0
+        self._expire_deadlines()
+        self._rebalance_overlap()
+        self._admit()
+        if not any(s is not None for s in self._slots):
+            self._sync_inflight()
+            return
+        for i, s in enumerate(self._slots):
+            self._budget[i] = self._remaining(i) if s is not None else 0
+        budget = self._budget.copy()
+        rec = self._issue_round(budget)
+        self._sync_inflight(next_t0=None if rec is None else rec["t0"])
+        self._inflight = rec
+        self._host_work_hist.observe(
+            max(0.0, self._clock() - t_step0 - self._step_sync_wait))
+
+    def _issue_round(self, budget):
+        """Build and ISSUE one decode/verify dispatch without touching its
+        results: every output stays an async future in the returned
+        in-flight record (drained by ``_sync_inflight``). The input tokens
+        come from the device-carried last-token row and the keys from the
+        per-slot bases, so nothing here waits on the round before it. An
+        issue-time failure (trace error, chaos hook) drains the pipeline
+        and re-runs the SAME built inputs through the legacy guarded path
+        (retry, then per-slot isolation) — returns None after delivering
+        synchronously."""
+        t_round = self._clock()
+        lead = (None if self._inflight is None
+                else self._inflight.get("lead"))
+        spec_lens = spec_kinds = None
+        if self.engine.spec_len > 0:
+            spec_lens, spec_kinds = self._plan_spec()
+        adapter = (self._adapter if self.engine.adapters is not None
+                   else None)
+        if spec_lens is None:
+            kind = "decode"
+            nwrite = self.engine.decode_block_len
+
+            def issue(b, toks_in):
+                return self.engine.decode_block(
+                    self.params, self._cache, toks_in, self._base_keys,
+                    self._eos, b, self._temp, self._top_k, self._top_p,
+                    adapter_ids=adapter, lead=lead)
+        else:
+            kind = "verify"
+            nwrite = self.engine.spec_len + 1
+            # drafting INSIDE the device-busy window, from one-round-stale
+            # host state; column 0 is overridden by the device token row
+            tokens = self._draft(spec_lens, spec_kinds)
+            drafts = jnp.asarray(tokens[:, 1:])
+
+            def issue(b, toks_in):
+                dev_tokens = jnp.concatenate(
+                    [toks_in[:, None].astype(jnp.int32), drafts], axis=1)
+                return self.engine.verify(
+                    self.params, self._cache, dev_tokens, self._base_keys,
+                    self._eos, b, self._temp, self._top_k, self._top_p,
+                    draft_len=spec_lens, adapter_ids=adapter, lead=lead)
+        t0 = self._clock()
+        self._note_issue(t0)
+        epochs = self._epoch.copy()
+        try:
+            out = issue(budget, self._dev_tok())
+        except Exception as e:  # noqa: BLE001 - recovered synchronously
+            _log_dispatch_failure("issue", "active slots", e)
+            self._sync_inflight()
+            self._round_fallback(kind, t_round, budget, spec_lens,
+                                 spec_kinds, issue)
+            return None
+        if spec_lens is None:
+            accepted = None
+            if self.engine.return_hidden:
+                self._cache, toks, counts, ntok, hid = out
+            else:
+                self._cache, toks, counts, ntok = out
+                hid = None
+        elif self.engine.return_hidden:
+            self._cache, toks, counts, accepted, ntok, hid = out
+        else:
+            self._cache, toks, counts, accepted, ntok = out
+            hid = None
+        self._dev_last = ntok
+        self.decode_dispatches += 1
+        self._round_seq += 1
+        return dict(kind=kind, t_round=t_round, t0=t0,
+                    budget=budget, epochs=epochs, toks=toks,
+                    counts=counts, accepted=accepted, hid=hid,
+                    spec_lens=spec_lens, spec_kinds=spec_kinds,
+                    # the NEXT issue's _pre_write reach: this round may
+                    # advance each slot by up to lead rows before the
+                    # stale host_len catches up at sync
+                    lead=np.minimum(np.maximum(budget, 0), nwrite),
+                    seq=self._round_seq)
+
+    def _round_fallback(self, kind, t_round, budget, spec_lens,
+                        spec_kinds, issue) -> None:
+        """Issue-time failure recovery: the pipeline is already drained
+        (host state is current again), so re-run the round's built inputs
+        through ``_guarded_round`` — the legacy retry/isolation semantics,
+        transient chaos faults absorbed identically — and deliver
+        synchronously like a non-overlapped step. Budget rows of seats
+        freed by the drain are masked (their occupants are gone; a stale
+        row would generate into a released seat)."""
+        occ = np.array([s is not None for s in self._slots])
+        budget = np.where(occ, budget, 0).astype(budget.dtype)
+        g = self.engine.spec_len
+
+        def dispatch(b):
+            t0 = self._clock()
+            self._note_issue(t0)
+            out = issue(b, self._dev_tok())
+            if kind == "decode":
+                accepted = None
+                if self.engine.return_hidden:
+                    self._cache, toks, counts, ntok, hid = out
+                else:
+                    self._cache, toks, counts, ntok = out
+                    hid = None
+            elif self.engine.return_hidden:
+                self._cache, toks, counts, accepted, ntok, hid = out
+            else:
+                self._cache, toks, counts, accepted, ntok = out
+                hid = None
+            self._dev_last = ntok
+            self.decode_dispatches += 1
+            t_sync = self._clock()
+            self._synthetic_wait(t0)
+            outs = (np.asarray(toks), np.asarray(counts),
+                    None if accepted is None else np.asarray(accepted))
+            # deferred page-table advance (engine.defer_advance): lands
+            # here per successful dispatch, so isolation re-dispatches
+            # compose exactly like the legacy per-dispatch advance
+            self.engine.apply_advance(outs[1])
+            self._merge_hidden(hid, outs[1])
+            t1 = self._clock()
+            dt_sync = t1 - t_sync
+            with self._scratch_mu:
+                self._host_sync_s = dt_sync
+            self._step_sync_wait += dt_sync
+            self._note_sync_end(t0, t1)
+            self.engine.observe_dispatch(kind, t1 - t0,
+                                         host_sync_s=dt_sync)
+            args = dict(slots=int(np.count_nonzero(np.asarray(b) > 0)),
+                        host_sync_s=round(dt_sync, 6))
+            if kind == "verify":
+                args["draft_len"] = g
+            self.obs.tracer.record("dispatch/" + kind, t0, t1, **args)
+            return outs
+
+        toks, counts, accepted, failed = self._guarded_round(dispatch,
+                                                             budget)
+        extra = None
+        if kind == "verify":
+            self._spec_account(spec_lens, spec_kinds, accepted, budget,
+                               failed)
+            extra = (lambda i: {
+                "draft_len": int(spec_lens[i]),
+                "accepted": (int(accepted[i])
+                             if accepted is not None else 0)})
+        self._slot_spans(kind, t_round, budget, counts, failed,
+                         extra=extra)
+        for i, s in enumerate(self._slots):
+            if s is not None and budget[i] > 0 and i not in failed:
+                s.dispatches += 1
+                if self.controller is not None:
+                    self.controller.after_round(i)
+        for i in failed:
+            if self._slots[i] is not None:
+                self._finish(i, "error")
+        for i in range(len(self._slots)):
+            if self._slots[i] is None:
+                continue
+            for t in toks[i, : counts[i]]:
+                if self._slots[i] is None:
+                    break
+                self._token_done(i, int(t))
+
+    def _sync_inflight(self, next_t0=None) -> None:
+        """Drain the in-flight round: materialize its device outputs (the
+        ONLY blocking sync on the overlap hot path), drop every row whose
+        seat epoch moved since issue (late stop, re-seat — the
+        exactly-once guarantee), apply the deferred page-table advance
+        for the surviving rows, then deliver exactly like the legacy
+        tail. ``next_t0`` is the just-issued round's issue time: when the
+        drain ends after it, the window in between is recorded as an
+        ``overlap`` span parented to this round's dispatch span (the
+        chain tools/trace_dump.py validates)."""
+        rec, self._inflight = self._inflight, None
+        if rec is None:
+            return
+        kind = rec["kind"]
+        t_sync = self._clock()
+        try:
+            toks = np.asarray(rec["toks"])
+            counts = np.asarray(rec["counts"])
+            accepted = (None if rec["accepted"] is None
+                        else np.asarray(rec["accepted"]))
+        except Exception as e:  # noqa: BLE001 - device-side round failure
+            _log_dispatch_failure("sync", "in-flight round", e)
+            if not self._cache_ok():
+                self._cache_lost()
+                return
+            # outputs unrecoverable but the cache survived: the round's
+            # slots retire like a failed dispatch's would
+            for i in range(len(self._slots)):
+                if (self._slots[i] is not None and rec["budget"][i] > 0
+                        and rec["epochs"][i] == self._epoch[i]):
+                    self._finish(i, "error")
+            return
+        self._synthetic_wait(rec["t0"])
+        t1 = self._clock()
+        dt_sync = t1 - t_sync
+        with self._scratch_mu:
+            self._host_sync_s = dt_sync
+        self._step_sync_wait += dt_sync
+        self._note_sync_end(rec["t0"], t1)
+        live = ((rec["epochs"] == self._epoch)
+                & np.array([s is not None for s in self._slots]))
+        counts = np.where(live, counts, 0)
+        mbud = np.where(live, rec["budget"], 0)
+        self.engine.apply_advance(counts)
+        self._merge_hidden(rec["hid"], counts)
+        self.engine.observe_dispatch(kind, t1 - rec["t0"],
+                                     host_sync_s=dt_sync)
+        args = dict(round=rec["seq"],
+                    slots=int(np.count_nonzero(
+                        np.asarray(rec["budget"]) > 0)),
+                    host_sync_s=round(dt_sync, 6))
+        if kind == "verify":
+            args["draft_len"] = self.engine.spec_len
+        span = self.obs.tracer.record("dispatch/" + kind,
+                                      rec["t0"], t1, **args)
+        if next_t0 is not None and next_t0 < t1:
+            # the zero-bubble witness: round seq's sync/deliver stage ran
+            # while round seq+1 executed on device
+            self.obs.tracer.record("overlap", next_t0, t1, parent=span,
+                                   round=rec["seq"], over=rec["seq"] + 1)
+        extra = None
+        if kind == "verify":
+            self._spec_account(rec["spec_lens"], rec["spec_kinds"],
+                               accepted, mbud, ())
+            extra = (lambda i: {
+                "draft_len": int(rec["spec_lens"][i]),
+                "accepted": (int(accepted[i])
+                             if accepted is not None else 0)})
+        self._slot_spans(kind, rec["t_round"], mbud, counts, (),
+                         extra=extra)
+        for i, s in enumerate(self._slots):
+            if s is not None and mbud[i] > 0:
+                s.dispatches += 1
+                if self.controller is not None:
+                    self.controller.after_round(i)
+        for i in range(len(self._slots)):
+            if self._slots[i] is None or counts[i] <= 0:
+                continue
+            for t in toks[i, : counts[i]]:
+                if self._slots[i] is None:
+                    break
+                self._token_done(i, int(t))
+
+    def _rebalance_overlap(self) -> None:
+        """dp rebalance under overlap: the migration planner reads the
+        allocator's HOST view (host_len, page tables), which lags the
+        in-flight round — so the pipeline drains before a move is
+        planned, and only when the cheap host-side skew checks say one
+        would actually happen."""
+        if self.engine.dp_size <= 1 or self.paged is None:
+            return
+        if self._rebalance_cooloff > 0:
+            self._rebalance()  # just the cooloff decrement — no drain
+            return
+        occ = self.shard_occupancy()
+        if max(occ) - min(occ) < self.REBALANCE_WATERMARK:
+            return
+        self._sync_inflight()
+        self._rebalance()
 
     def _slot_spans(self, kind: str, t0: float, budget, counts,
                     failed, extra=None) -> None:
@@ -1366,6 +1853,12 @@ class ContinuousBatcher:
         ``"error"`` and a fresh cache is built — the batcher (and its
         queue) outlives the fault even when isolation is impossible."""
         self._cache = self.engine.init_cache()
+        # any in-flight round consumed the same dead buffers; its record
+        # and the device-carried token row die with them (the _finish
+        # epoch bumps below already mask its rows, this just drops the
+        # references so the drain is a no-op)
+        self._inflight = None
+        self._dev_last = None
         for i, s in enumerate(self._slots):
             if s is not None:
                 self._finish(i, "error")
@@ -1428,26 +1921,17 @@ class ContinuousBatcher:
             toks_out = np.zeros((n, 1), np.int32)
         return toks_out, counts_out, aux_out, failed
 
-    def _spec_round(self, budget, lens, kinds) -> tuple:
-        """One draft-verify round: propose ``lens[i]`` tokens per occupied
-        slot (per-slot RAGGED under the controller; the full
-        ``engine.spec_len`` otherwise), dispatch ONE ``engine.verify``
-        pass (fault-isolated like the decode round), and return its
-        (emitted tokens, per-slot counts, failed slots).
-
-        Drafting is per kind: "learned" slots draft TOGETHER in one small
-        jitted dispatch from the device-resident hidden states
-        (LearnedDrafter.propose_batch — timed into the "draft" latency
-        histogram the controller's cost model reads); host drafters
-        (n-gram, scripted) propose per slot from the slot's own history
-        while the device is free. Acceptance stats accumulate here — the
-        lifetime totals, the per-slot and per-drafter registry counter
-        families the controller and the bench read, and the controller's
-        obs-off shadow; the shared step() tail walks the emitted prefixes
-        through ``_token_done`` exactly like a decode block's."""
+    def _draft(self, lens, kinds):
+        """Propose draft tokens for every occupied slot — ``_spec_round``'s
+        drafting stage, shared with the overlap issue path (where it runs
+        INSIDE the device-busy window, from host state that is one round
+        stale; a stale guess only costs acceptance, never correctness —
+        the slot verify program's sample-and-match emission is independent
+        of the draft values). Returns the [slots, spec_len + 1] token
+        block; column 0 is the host's last-token view (the overlap path
+        overrides it with the device-carried row at dispatch)."""
         g = self.engine.spec_len
         n = len(self._slots)
-        t_round = self._clock()
         reg = self.obs.registry
         tokens = np.zeros((n, g + 1), np.int32)
         with self.obs.tracer.span("draft", spec_len=g):
@@ -1482,37 +1966,14 @@ class ContinuousBatcher:
                                                      ctx=s.req.uid)
                 else:
                     tokens[i, 1: 1 + gi] = d.propose(hist, gi)
-        key = self._split()
+        return tokens
 
-        def dispatch(b):
-            t0 = self._clock()
-            out = self.engine.verify(
-                self.params, self._cache, tokens, key, self._eos,
-                b, self._temp, self._top_k, self._top_p, draft_len=lens,
-                adapter_ids=(self._adapter if self.engine.adapters
-                             is not None else None))
-            if self.engine.return_hidden:
-                self._cache, emitted, counts, accepted, hid = out
-            else:
-                self._cache, emitted, counts, accepted = out
-                hid = None
-            self.decode_dispatches += 1
-            t_sync = self._clock()
-            out = (np.asarray(emitted), np.asarray(counts),
-                   np.asarray(accepted))
-            self._merge_hidden(hid, out[1])
-            t1 = self._clock()
-            self._host_sync_s = t1 - t_sync
-            self.engine.observe_dispatch("verify", t1 - t0,
-                                         host_sync_s=self._host_sync_s)
-            self.obs.tracer.record(
-                "dispatch/verify", t0, t1,
-                slots=int(np.count_nonzero(np.asarray(b) > 0)),
-                draft_len=g, host_sync_s=round(self._host_sync_s, 6))
-            return out
-
-        emitted, counts, accepted, failed = self._guarded_round(
-            dispatch, budget)
+    def _spec_account(self, lens, kinds, accepted, budget, failed) -> None:
+        """Accumulate one verify round's acceptance stats: the lifetime
+        totals, the per-slot and per-drafter registry counter families the
+        controller and the bench read, and the controller's own record —
+        shared by the synchronous round and the overlap sync stage."""
+        reg = self.obs.registry
         for i, s in enumerate(self._slots):
             if s is None or i in failed or budget[i] <= 0:
                 continue
@@ -1541,6 +2002,74 @@ class ContinuousBatcher:
                         drafter=kind).inc(acc)
             if self.controller is not None:
                 self.controller.record(i, gi, acc)
+
+    def _spec_round(self, budget, lens, kinds) -> tuple:
+        """One draft-verify round: propose ``lens[i]`` tokens per occupied
+        slot (per-slot RAGGED under the controller; the full
+        ``engine.spec_len`` otherwise), dispatch ONE ``engine.verify``
+        pass (fault-isolated like the decode round), and return its
+        (emitted tokens, per-slot counts, failed slots).
+
+        Drafting is per kind: "learned" slots draft TOGETHER in one small
+        jitted dispatch from the device-resident hidden states
+        (LearnedDrafter.propose_batch — timed into the "draft" latency
+        histogram the controller's cost model reads); host drafters
+        (n-gram, scripted) propose per slot from the slot's own history
+        while the device is free. Acceptance stats accumulate here — the
+        lifetime totals, the per-slot and per-drafter registry counter
+        families the controller and the bench read, and the controller's
+        obs-off shadow; the shared step() tail walks the emitted prefixes
+        through ``_token_done`` exactly like a decode block's."""
+        g = self.engine.spec_len
+        t_round = self._clock()
+        tokens = self._draft(lens, kinds)
+        key = (self._base_keys if self._sched == "slot"
+               else self._split())
+
+        def dispatch(b):
+            t0 = self._clock()
+            self._note_issue(t0)
+            out = self.engine.verify(
+                self.params, self._cache, tokens, key, self._eos,
+                b, self._temp, self._top_k, self._top_p, draft_len=lens,
+                adapter_ids=(self._adapter if self.engine.adapters
+                             is not None else None))
+            if self._sched == "slot":
+                # extra next-token output (overlap feed) — ignored here
+                if self.engine.return_hidden:
+                    (self._cache, emitted, counts, accepted, _ntok,
+                     hid) = out
+                else:
+                    self._cache, emitted, counts, accepted, _ntok = out
+                    hid = None
+            elif self.engine.return_hidden:
+                self._cache, emitted, counts, accepted, hid = out
+            else:
+                self._cache, emitted, counts, accepted = out
+                hid = None
+            self.decode_dispatches += 1
+            t_sync = self._clock()
+            self._synthetic_wait(t0)
+            out = (np.asarray(emitted), np.asarray(counts),
+                   np.asarray(accepted))
+            self._merge_hidden(hid, out[1])
+            t1 = self._clock()
+            dt_sync = t1 - t_sync
+            with self._scratch_mu:
+                self._host_sync_s = dt_sync
+            self._step_sync_wait += dt_sync
+            self._note_sync_end(t0, t1)
+            self.engine.observe_dispatch("verify", t1 - t0,
+                                         host_sync_s=dt_sync)
+            self.obs.tracer.record(
+                "dispatch/verify", t0, t1,
+                slots=int(np.count_nonzero(np.asarray(b) > 0)),
+                draft_len=g, host_sync_s=round(dt_sync, 6))
+            return out
+
+        emitted, counts, accepted, failed = self._guarded_round(
+            dispatch, budget)
+        self._spec_account(lens, kinds, accepted, budget, failed)
         self._slot_spans(
             "verify", t_round, budget, counts, failed,
             extra=lambda i: {"draft_len": int(lens[i]),

@@ -126,6 +126,8 @@ class InferenceEngine:
                  weight_dtype: Optional[str] = None,
                  drafter: Optional[str] = None,
                  return_hidden: Optional[bool] = None,
+                 overlap: Optional[bool] = None,
+                 key_schedule: Optional[str] = None,
                  hooks=None, adapters=None):
         self.cfg = inference_config(cfg)
         m, d = self.cfg.model, self.cfg.distributed
@@ -219,6 +221,39 @@ class InferenceEngine:
         if sample_on_device is not None:
             inf.sample_on_device = bool(sample_on_device)
         self.sample_on_device = inf.sample_on_device
+        # Zero-bubble overlapped scheduling + PRNG key schedule
+        # (docs/INFERENCE.md "Overlapped scheduling"). overlap is the
+        # BATCHER's pipeline switch; the engine carries it so the batcher,
+        # serve front end, and bench all read one resolved source of
+        # truth. key_schedule decides how sampled tokens are keyed:
+        # "round" (one fresh key per dispatch — the historical schedule)
+        # or "slot" (token at position p keyed fold_in(base_slot, p-1) —
+        # round-structure-independent, which is what lets the pipeline
+        # reorder rounds without moving a single sampled token). "auto"
+        # resolves to "slot" iff overlap is on, so the default-off path
+        # keeps today's programs byte-identical.
+        if overlap is not None:
+            inf.overlap = bool(overlap)
+        if key_schedule is not None:
+            inf.key_schedule = key_schedule
+        self.overlap = bool(inf.overlap)
+        ks = inf.key_schedule
+        if ks not in ("auto", "round", "slot"):
+            raise ValueError(
+                f"unknown key_schedule {ks!r} (auto|round|slot)")
+        if ks == "auto":
+            ks = "slot" if self.overlap else "round"
+        elif ks == "round" and self.overlap:
+            raise ValueError(
+                "overlap requires the per-slot key schedule — round-keyed "
+                "sampling ties streams to round boundaries; use "
+                "key_schedule='slot' (or 'auto')")
+        self.key_schedule = ks
+        # Deferred paged length advance: the overlapped batcher's sync
+        # stage owns host_len bookkeeping (apply_advance) because at issue
+        # time the previous round's counts are still on device. Off by
+        # default; ContinuousBatcher flips it when it runs the pipeline.
+        self.defer_advance = False
         # Weight storage format (inference.weight_dtype): "bf16" keeps the
         # dense params tree; "int8" expects the per-channel quantized tree
         # (checkpoint.load_* with weight_dtype="int8", or
@@ -489,6 +524,19 @@ class InferenceEngine:
         self._verify_poison_jit = None  # chaos-only; built on demand
         if self.spec_len > 0:
             self._verify_jit = self._make_verify_jit()
+        # per-slot key schedule variants (key_schedule="slot"): same
+        # programs with [B, 2] base keys folded per position IN-TRACE and
+        # an extra next-token output the overlap pipeline carries on
+        # device. jax.jit is lazy, but only the active schedule's
+        # variants are referenced at all.
+        self._decode_block_slot_jit = None
+        self._decode_block_slot_poison_jit = None
+        self._verify_slot_jit = None
+        self._verify_slot_poison_jit = None
+        if self.key_schedule == "slot":
+            self._decode_block_slot_jit = self._make_decode_block_slot_jit()
+            if self.spec_len > 0:
+                self._verify_slot_jit = self._make_verify_slot_jit()
 
     def _make_verify_jit(self, poison: bool = False):
         dpP = P("dp") if self.dp_size > 1 else P()
@@ -503,11 +551,31 @@ class InferenceEngine:
     def _verify_prog(self, poison: bool):
         """The verify executable to run (lazily builds the chaos
         NaN-poisoned variant)."""
+        if self.key_schedule == "slot":
+            if not poison:
+                return self._verify_slot_jit
+            if self._verify_slot_poison_jit is None:
+                self._verify_slot_poison_jit = self._make_verify_slot_jit(
+                    poison=True)
+            return self._verify_slot_poison_jit
         if not poison:
             return self._verify_jit
         if self._verify_poison_jit is None:
             self._verify_poison_jit = self._make_verify_jit(poison=True)
         return self._verify_poison_jit
+
+    def _make_verify_slot_jit(self, poison: bool = False):
+        """Per-slot-key verify: base keys [B, 2] shard with their slots,
+        and the program returns each row's post-round last token so the
+        overlap pipeline can feed the next dispatch without a sync."""
+        dpP = P("dp") if self.dp_size > 1 else P()
+        hidB = (dpP,) if self.return_hidden else ()
+        return jax.jit(shard_map(
+            partial(self._verify_slot_impl, poison=poison), self.topo.mesh,
+            in_specs=(self._decode_dispatch_pspecs, self._cspecs,
+                      dpP, dpP, dpP, dpP, dpP, dpP, dpP, dpP),
+            out_specs=(self._cspecs, dpP, dpP, dpP, dpP) + hidB),
+            donate_argnums=(1,))
 
     def _make_decode_block_jit(self, poison: bool = False):
         dpP = P("dp") if self.dp_size > 1 else P()
@@ -522,12 +590,34 @@ class InferenceEngine:
     def _decode_block_prog(self, poison: bool):
         """The decode-block executable to run (lazily builds the chaos
         NaN-poisoned variant)."""
+        if self.key_schedule == "slot":
+            if not poison:
+                return self._decode_block_slot_jit
+            if self._decode_block_slot_poison_jit is None:
+                self._decode_block_slot_poison_jit = \
+                    self._make_decode_block_slot_jit(poison=True)
+            return self._decode_block_slot_poison_jit
         if not poison:
             return self._decode_block_jit
         if self._decode_block_poison_jit is None:
             self._decode_block_poison_jit = self._make_decode_block_jit(
                 poison=True)
         return self._decode_block_poison_jit
+
+    def _make_decode_block_slot_jit(self, poison: bool = False):
+        """Per-slot-key decode block: [B, 2] base keys (sharded with
+        their slots) replace the [block, 2] round keys; each scan step
+        folds the live length in-trace, and the final carry token comes
+        back as an extra output for the overlap pipeline."""
+        dpP = P("dp") if self.dp_size > 1 else P()
+        hidB = (dpP,) if self.return_hidden else ()
+        return jax.jit(shard_map(
+            partial(self._decode_block_slot_impl, poison=poison),
+            self.topo.mesh,
+            in_specs=(self._decode_dispatch_pspecs, self._cspecs,
+                      dpP, dpP, dpP, dpP, dpP, dpP, dpP),
+            out_specs=(self._cspecs, dpP, dpP, dpP) + hidB),
+            donate_argnums=(1,))
 
     # ---- dispatch hooks + graceful degradation ----------------------------
 
@@ -944,6 +1034,97 @@ class InferenceEngine:
         idx = jnp.clip(counts - 1, 0, S - 1)[:, None, None]
         return out + (jnp.take_along_axis(h, idx, axis=1)[:, 0],)
 
+    def _decode_block_slot_impl(self, params, cache, tokens, base_keys,
+                                eos_id, budget, temperature, top_k, top_p,
+                                poison=False):
+        """``_decode_block_impl`` under the per-slot key schedule: instead
+        of one shared key per in-block step, every row's draw at pre-step
+        length ℓ uses ``fold_in(base_keys[b], ℓ)`` — the key that position
+        owns no matter how steps are grouped into rounds, which is the
+        invariant the overlap pipeline's bit-identity rests on
+        (docs/INFERENCE.md "Overlapped scheduling"). Also returns the
+        final carry token [B] (each slot's post-block last token, the
+        input token where a slot never ran) so the lookahead dispatch can
+        consume it without a host sync."""
+        rh = self.return_hidden
+        hid0 = jnp.zeros((tokens.shape[0], self.cfg.model.hidden_size),
+                         self._dt)
+
+        def step(carry, _):
+            cache, tok, budget, hid = carry
+            pos = cache["lengths"]
+            active = (pos > 0) & (budget > 0)
+            keys = jax.vmap(jax.random.fold_in)(base_keys, pos)
+            new_leaves, logits, h = self._decode_core(params, cache, tok)
+            if poison:
+                logits = jnp.full_like(logits, jnp.nan)
+            sampled = sampling.sample_rowkeys(logits, keys, temperature,
+                                              top_k, top_p)
+            emit = jnp.where(active, sampled, 0)
+            new_budget = jnp.where(active, budget - 1, budget)
+            hit_eos = active & (eos_id >= 0) & (sampled == eos_id)
+            new_budget = jnp.where(hit_eos, 0, new_budget)
+            new_cache = self._rebuild(cache, new_leaves,
+                                      jnp.where(active, pos + 1, pos))
+            next_tok = jnp.where(active, sampled, tok)
+            new_hid = jnp.where(active[:, None], h, hid) if rh else hid
+            return (new_cache, next_tok, new_budget, new_hid), (emit, active)
+
+        (cache, tok, _, hid), (toks, actives) = lax.scan(
+            step, (cache, tokens, budget, hid0), None,
+            length=self.decode_block_len)
+        out = (cache, jnp.swapaxes(toks, 0, 1),
+               jnp.sum(actives.astype(jnp.int32), axis=0), tok)
+        return out + (hid,) if rh else out
+
+    def _verify_slot_impl(self, params, cache, tokens, valid, base_keys,
+                          eos_id, budget, temperature, top_k, top_p,
+                          poison=False):
+        """``_verify_impl`` under the per-slot key schedule: acceptance is
+        sample-and-match (sampling.speculative_match) — the program draws
+        the target chain's own token at every fed position with that
+        position's folded key and accepts the matching draft prefix, so
+        the emitted stream never depends on the draft VALUES and equals
+        the per-position decode chain bit for bit (the property that lets
+        the overlap pipeline verify against one-round-stale drafts).
+        Returns an extra next-token output [B]: the last emitted token
+        where the row ran, else the fed last token."""
+        B, S = tokens.shape
+        pos0 = cache["lengths"]
+        rows = pos0[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+        new_leaves, logits, h = self._model_block(
+            params, cache, tokens, rows, pos0,
+            extra_meta={"draft_valid": valid})  # logits [B, S, V]
+        if poison:
+            logits = jnp.full_like(logits, jnp.nan)
+        # rows[b, i] is exactly the fold_in data the non-speculative chain
+        # uses for the token following fed token i (its pre-step length)
+        emitted, counts = sampling.speculative_match(
+            logits, tokens[:, 1:], base_keys, rows, temperature,
+            top_k, top_p, draft_len=valid - 1)
+        raw = counts
+        active = (pos0 > 0) & (budget > 0)
+        counts = jnp.where(active, jnp.minimum(counts, budget), 0)
+        cols = jnp.arange(S, dtype=jnp.int32)[None, :]
+        is_eos = ((eos_id >= 0)[:, None] & (emitted == eos_id[:, None])
+                  & (cols < counts[:, None]))
+        counts = jnp.where(jnp.any(is_eos, axis=1),
+                           jnp.argmax(is_eos, axis=1) + 1, counts)
+        emitted = jnp.where(cols < counts[:, None], emitted, 0)
+        accepted = jnp.minimum(raw - 1, counts)
+        new_cache = self._rebuild(cache, new_leaves,
+                                  jnp.where(active, pos0 + counts, pos0))
+        last_idx = jnp.clip(counts - 1, 0, S - 1)[:, None]
+        next_tok = jnp.where(
+            counts > 0,
+            jnp.take_along_axis(emitted, last_idx, axis=1)[:, 0],
+            tokens[:, 0])
+        out = (new_cache, emitted, counts, accepted, next_tok)
+        if not self.return_hidden:
+            return out
+        idx = jnp.clip(counts - 1, 0, S - 1)[:, None, None]
+        return out + (jnp.take_along_axis(h, idx, axis=1)[:, 0],)
+
     def _prefill_chunk_impl(self, params, cache, tokens, slot, start, valid,
                             *sample):
         """One fixed-width prefill chunk for one slot: tokens [1, C] (pad
@@ -1195,7 +1376,8 @@ class InferenceEngine:
             cache = self._copy_page_jit(cache, src, dst)
         return cache
 
-    def _pre_write(self, cache, nwrite: int, budget=None) -> dict:
+    def _pre_write(self, cache, nwrite: int, budget=None,
+                   lead=None) -> dict:
         """Before a decode/verify dispatch: every PARKED slot (length > 0)
         writes up to ``nwrite`` rows from its current length — including
         inactive slots' recomputed ghost rows, which the mask hides but
@@ -1204,15 +1386,41 @@ class InferenceEngine:
         slot's reach at ``budget[s] + 1`` rows — the emitted run plus the
         one ghost row a stopped slot keeps rewriting — so page demand
         tracks what the dispatch can actually produce, which is what the
-        batcher's admission pricing reserves."""
+        batcher's admission pricing reserves.
+
+        ``lead`` [slots] (overlap pipeline, defer_advance) is the extra
+        reach the IN-FLIGHT round may still add to each slot:
+        ``host_len`` is one round stale at issue time, so the true device
+        length sits anywhere in [host_len, host_len + lead[s]] — the
+        ensure window stretches by lead[s] to cover every row the stacked
+        rounds can touch. Re-ensuring rows the previous round already
+        owns is a no-op (exclusive pages stay exclusive), so the stretch
+        costs nothing in steady state."""
         p = self.paged
         window = p.max_pages * p.page_len
+        if budget is not None:
+            budget = np.asarray(budget)
+        if lead is not None:
+            lead = np.asarray(lead)
         for s in np.flatnonzero(p.host_len > 0):
             n = nwrite if budget is None else min(
-                nwrite, int(np.asarray(budget)[s]) + 1)
+                nwrite, int(budget[s]) + 1)
+            if lead is not None:
+                n += int(lead[s])
             cache = self._ensure(cache, int(s), int(p.host_len[s]),
                                  min(int(p.host_len[s]) + n, window))
         return self._sync_tables(cache)
+
+    def apply_advance(self, counts) -> None:
+        """Deferred paged length advance (overlap pipeline): when
+        ``defer_advance`` is set, decode_block/verify skip their host_len
+        bookkeeping at issue time — the per-slot counts are still futures
+        — and the batcher's sync stage calls this with the materialized
+        (and late-finish-masked) counts instead. No-op on contiguous
+        engines, whose device-side length pointers are the only length
+        state."""
+        if self.paged is not None:
+            self.paged.advance(np.asarray(counts, np.int64))
 
     def prefill_bucket(self, prompt_len: int) -> int:
         """Power-of-two padding bucket for a prompt (one compile each)."""
@@ -1574,6 +1782,12 @@ class InferenceEngine:
         ``return_hidden`` engine appends hidden [slots, H] (the step's
         pre-final-norm hidden states — the learned drafter's input).
         Consumes ``cache``."""
+        if self.key_schedule == "slot":
+            raise ValueError(
+                "decode_step is round-keyed (one shared key per step) and "
+                "a key_schedule='slot' engine samples with per-slot "
+                "position-folded keys — use decode_block, whose slot "
+                "variant owns the schedule")
         self._hook("decode")
         if self.adapters is not None or adapter_ids is not None:
             params = self.bind_adapter_ids(params, adapter_ids, self.slots)
@@ -1597,16 +1811,32 @@ class InferenceEngine:
         return out
 
     def decode_block(self, params, cache, tokens, keys, eos_id, budget,
-                     temperature, top_k, top_p, adapter_ids=None) -> tuple:
+                     temperature, top_k, top_p, adapter_ids=None,
+                     lead=None) -> tuple:
         """``decode_block_len`` tokens for every slot in one dispatch.
-        ``keys`` is [decode_block_len, 2] (one PRNG key per in-block step);
+        ``keys`` is [decode_block_len, 2] (one PRNG key per in-block step)
+        on a round-keyed engine, or the per-slot BASE keys [slots, 2] on a
+        ``key_schedule='slot'`` engine (positions fold in-trace);
         ``eos_id`` [slots] int32 (−1 = none), ``budget`` [slots] int32
-        remaining tokens (0 for free slots). Returns (cache,
-        tokens [slots, decode_block_len], produced counts [slots]); a
-        ``return_hidden`` engine appends hidden [slots, H] — each slot's
-        hidden state at its last active step. Consumes ``cache``."""
+        remaining tokens (0 for free slots). ``tokens`` may be a device
+        array — it stays lazy (the overlap pipeline feeds the previous
+        round's on-device next-token output straight back in). Returns
+        (cache, tokens [slots, decode_block_len], produced counts
+        [slots]); a slot-keyed engine appends next_tok [slots] (each
+        slot's post-block last token, on device) and a ``return_hidden``
+        engine appends hidden [slots, H] — each slot's hidden state at
+        its last active step. Consumes ``cache``. ``lead`` forwards to
+        ``_pre_write`` (overlap's stale-host_len reach allowance); with
+        ``defer_advance`` set the paged length bookkeeping is skipped
+        here — the caller's sync stage applies it (``apply_advance``)."""
         keys = jnp.asarray(keys)
-        if keys.shape[0] != self.decode_block_len:
+        if self.key_schedule == "slot":
+            if keys.shape != (self.slots, 2):
+                raise ValueError(
+                    f"key_schedule='slot' takes per-slot base keys "
+                    f"[slots, 2] = [{self.slots}, 2]; got "
+                    f"{tuple(keys.shape)}")
+        elif keys.shape[0] != self.decode_block_len:
             raise ValueError(
                 f"keys has {keys.shape[0]} rows; decode_block_len is "
                 f"{self.decode_block_len} (one key per in-block step)")
@@ -1616,18 +1846,21 @@ class InferenceEngine:
         poison = self._poison("decode")
         if self.paged is not None:
             cache = self._pre_write(cache, self.decode_block_len,
-                                    budget=budget)
+                                    budget=budget, lead=lead)
+        # a device tokens array must NOT round-trip through np.asarray —
+        # that sync is exactly what the overlap pipeline exists to avoid
+        tok_in = (tokens if isinstance(tokens, jax.Array)
+                  else jnp.asarray(np.asarray(tokens, np.int32)))
         # the program is resolved INSIDE the lambda so the flash->dense
         # fallback's rebuilt jits are what a re-dispatch runs
         out = self._dispatch(lambda: self._decode_block_prog(poison)(
-            params, cache,
-            jnp.asarray(np.asarray(tokens, np.int32)), keys,
+            params, cache, tok_in, keys,
             jnp.asarray(np.asarray(eos_id, np.int32)),
             jnp.asarray(np.asarray(budget, np.int32)),
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
             jnp.asarray(np.asarray(top_p, np.float32))))
-        if self.paged is not None:
+        if self.paged is not None and not self.defer_advance:
             # mirror device length advancement (counts per slot). The
             # host sync this forces is the block's ONE sync, just moved
             # ahead of the batcher's own np.asarray on the same buffers.
@@ -1636,7 +1869,7 @@ class InferenceEngine:
 
     def verify(self, params, cache, tokens, key, eos_id, budget,
                temperature, top_k, top_p, draft_len=None,
-               adapter_ids=None) -> tuple:
+               adapter_ids=None, lead=None) -> tuple:
         """One speculative draft-verify dispatch for every slot
         (``spec_len > 0`` engines only). ``tokens`` is
         [slots, spec_len + 1] int32 — column 0 is each slot's current last
@@ -1651,19 +1884,26 @@ class InferenceEngine:
         Returns (cache, emitted [slots, spec_len + 1], counts
         [slots], accepted-draft counts [slots]) — ``counts[b]`` leading
         entries of emitted row b are the tokens slot b produced this
-        dispatch (1..spec_len + 1 per active slot); a ``return_hidden``
-        engine appends hidden [slots, H]. Consumes ``cache``."""
-        if self._verify_jit is None:
+        dispatch (1..spec_len + 1 per active slot); a slot-keyed engine
+        (``key_schedule='slot'``, where ``key`` is the per-slot base keys
+        [slots, 2] and ``tokens`` may be a device array) appends next_tok
+        [slots] — each row's on-device last emitted token — and a
+        ``return_hidden`` engine appends hidden [slots, H]. Consumes
+        ``cache``. ``lead``/``defer_advance``: see ``decode_block``."""
+        if self._verify_jit is None and self._verify_slot_jit is None:
             raise ValueError(
                 "speculative decoding is off for this engine (spec_len == "
                 "0); construct it with spec_len > 0 or set "
                 "inference.spec_len")
-        tokens = np.asarray(tokens, np.int32)
-        if tokens.shape != (self.slots, self.spec_len + 1):
+        # device tokens stay lazy (overlap feeds column 0 straight from
+        # the previous round's on-device next-token output)
+        if not isinstance(tokens, jax.Array):
+            tokens = np.asarray(tokens, np.int32)
+        if tuple(tokens.shape) != (self.slots, self.spec_len + 1):
             raise ValueError(
                 f"verify tokens must be [slots, spec_len + 1] = "
                 f"[{self.slots}, {self.spec_len + 1}]; got "
-                f"{tokens.shape}")
+                f"{tuple(tokens.shape)}")
         if draft_len is None:
             valid = np.full(self.slots, self.spec_len + 1, np.int32)
         else:
@@ -1677,6 +1917,14 @@ class InferenceEngine:
                     f"draft_len entries must be in [0, spec_len = "
                     f"{self.spec_len}]; got {draft_len.tolist()}")
             valid = draft_len + 1
+        if self.key_schedule == "slot":
+            # per-slot base keys [slots, 2]; positions fold in-trace
+            key = jnp.asarray(key)
+            if key.shape != (self.slots, 2):
+                raise ValueError(
+                    f"key_schedule='slot' takes per-slot base keys "
+                    f"[slots, 2] = [{self.slots}, 2]; got "
+                    f"{tuple(key.shape)}")
         self._hook("verify", budget)
         if self.adapters is not None or adapter_ids is not None:
             params = self.bind_adapter_ids(params, adapter_ids, self.slots)
@@ -1686,7 +1934,7 @@ class InferenceEngine:
             # parked slot; ensuring them all exclusive BEFORE the dispatch
             # is what makes the rollback free — rejected rows strand in
             # pages only this slot holds, never in a shared one
-            cache = self._pre_write(cache, self.spec_len + 1)
+            cache = self._pre_write(cache, self.spec_len + 1, lead=lead)
         # resolved inside the lambda, exactly like decode_block's program
         out = self._dispatch(lambda: self._verify_prog(poison)(
             params, cache, jnp.asarray(tokens), jnp.asarray(valid), key,
@@ -1695,7 +1943,7 @@ class InferenceEngine:
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
             jnp.asarray(np.asarray(top_p, np.float32))))
-        if self.paged is not None:
+        if self.paged is not None and not self.defer_advance:
             # device lengths advanced by the ACCEPTED counts (the length
             # pointer is the rollback) — mirror exactly that
             self.paged.advance(np.asarray(out[2], np.int64))

@@ -148,6 +148,51 @@ def sample(logits: jnp.ndarray, key, temperature: jnp.ndarray,
                         lambda: greedy_tok, stochastic)
 
 
+# The host-side (eager-call) entry for ``sample``. Called eagerly, the
+# ``lax.cond`` above traces and XLA-compiles a FRESH program on every
+# invocation — its branch closures are new objects each call, so nothing
+# caches and every admit-time first-token draw pays ~quarter-second of
+# compile (measured on the CPU backend; bench_decode --overlap surfaced
+# it as a fixed per-request cost swamping the pipeline A/B). Under jit
+# the cond traces once per argument shape and the executable is cached,
+# so admissions after the first are microseconds. Same computation,
+# same key discipline — jit only changes where the compile cache lives.
+sample_jit = jax.jit(sample)
+
+
+def sample_rowkeys(logits: jnp.ndarray, keys: jnp.ndarray,
+                   temperature: jnp.ndarray, top_k: jnp.ndarray,
+                   top_p: jnp.ndarray) -> jnp.ndarray:
+    """``sample`` with a PER-ROW key: row b draws with ``keys[b]`` ([B, 2]
+    raw uint32 PRNG keys) instead of every row sharing one key. This is
+    the per-slot key schedule's sampler (``inference.key_schedule:
+    "slot"``, docs/INFERENCE.md "Overlapped scheduling"): the batcher
+    derives ``keys[b] = fold_in(base_b, position)`` so a slot's stream
+    depends only on its own base key and token position — independent of
+    which other slots share the round, of round boundaries, and of
+    speculative grouping. Greedy rows, the all-greedy short-circuit, and
+    the non-finite fallback behave exactly like ``sample``; a single row
+    drawn here is bit-identical to ``sample`` on that row alone with the
+    same key (the categorical's noise depends only on the key and the
+    row's element count)."""
+    bad = nonfinite_rows(logits)
+    logits = sanitize_logits(logits)
+    greedy_tok = greedy(logits)
+
+    def stochastic():
+        t = jnp.maximum(temperature, 1e-6)[:, None]
+        filtered = filter_top_k_top_p(
+            logits.astype(jnp.float32) / t, top_k, top_p)
+        drawn = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row))(
+                keys, filtered).astype(jnp.int32)
+        return jnp.where((temperature <= 0.0) | bad, greedy_tok, drawn)
+
+    # no collectives in either branch, so the cond is shard_map-safe
+    return jax.lax.cond(jnp.all(temperature <= 0.0),
+                        lambda: greedy_tok, stochastic)
+
+
 def filtered_probs(logits: jnp.ndarray, temperature: jnp.ndarray,
                    top_k: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
     """The distribution ``sample`` draws its stochastic rows from:
@@ -269,4 +314,56 @@ def speculative_accept(logits: jnp.ndarray, draft: jnp.ndarray, key,
     emitted = jnp.where(cols < acc[:, None],
                         jnp.pad(draft, ((0, 0), (0, 1))), 0)
     emitted = jnp.where(cols == acc[:, None], last[:, None], emitted)
+    return emitted, acc + 1
+
+
+def speculative_match(logits: jnp.ndarray, draft: jnp.ndarray,
+                      base_keys: jnp.ndarray, positions: jnp.ndarray,
+                      temperature: jnp.ndarray, top_k: jnp.ndarray,
+                      top_p: jnp.ndarray,
+                      draft_len: Optional[jnp.ndarray] = None) -> tuple:
+    """Draft acceptance for the per-slot key schedule: sample-and-match.
+
+    Under ``key_schedule: "slot"`` every token position has ONE
+    predetermined key (``fold_in(base, position)``), so the verify pass
+    can simply draw the target chain's own token at every fed position —
+    ``s[b, i] = sample_rowkeys(logits[b, i], fold_in(base_b,
+    positions[b, i]))`` — and accept the draft prefix that MATCHES it:
+    where draft == s the draft saved a dispatch, where it first diverges
+    the emitted token is s itself (the correction), and the bonus
+    position's s rides free when everything matched. The emitted stream
+    is therefore a pure function of (base key, positions, logits): it
+    never depends on the draft VALUES, which is what makes speculative
+    output — greedy and stochastic alike — bit-identical to token-by-token
+    decode under the same schedule, through any drafter/controller
+    trajectory and any round structure (including the overlap pipeline's
+    one-round-stale drafts). For a deterministic (point-mass) drafter
+    this is exactly rejection sampling: accept-with-p(draft) reduces to
+    "accepted iff the chain's own draw equals the draft".
+
+    Arguments mirror ``speculative_accept``; ``base_keys`` [B, 2] raw
+    uint32 per-slot keys, ``positions`` [B, S] int32 — the KV row index
+    each fed token was written at (``pos0 + i``), i.e. the fold_in data
+    the non-speculative chain would use for the same draw. Returns
+    ``(emitted [B, S], counts [B])`` with identical conventions."""
+    B, S, V = logits.shape
+    G = S - 1
+    keys = jax.vmap(jax.vmap(jax.random.fold_in, in_axes=(None, 0)))(
+        base_keys, positions)  # [B, S, 2]
+    s = sample_rowkeys(
+        logits.reshape(B * S, V), keys.reshape(B * S, 2),
+        jnp.repeat(temperature, S), jnp.repeat(top_k, S),
+        jnp.repeat(top_p, S)).reshape(B, S)
+    ok = draft == s[:, :G]
+    if draft_len is not None:
+        # ragged rows: pad columns are forced mismatches, so acceptance
+        # ends at the row's own draft_len and the correction draws from
+        # that position — same contract as speculative_accept
+        cols_g = jnp.arange(G, dtype=jnp.int32)[None, :]
+        ok &= cols_g < draft_len[:, None]
+    acc = _leading_true(ok)
+    cols = jnp.arange(S, dtype=jnp.int32)[None, :]
+    # for i < acc, s == draft by construction: emitting s everywhere up
+    # to and including the correction/bonus column IS the target chain
+    emitted = jnp.where(cols <= acc[:, None], s, 0)
     return emitted, acc + 1

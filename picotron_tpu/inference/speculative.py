@@ -48,6 +48,17 @@ average dispatch emitted ~1 + r*gamma tokens. Rates near 0 mean the
 drafter is guessing blind (speculation costs nothing but the wider verify
 dispatch); rates near 1 mean dispatches-per-token approaches
 1/(gamma+1).
+
+Overlapped scheduling staleness contract (``inference.overlap``): under
+the zero-bubble pipeline the batcher drafts round N+1 WHILE round N still
+executes, so every drafter input — slot histories, ``_last_tok``, the
+device hidden rows, the controller's per-slot lens/kinds — is one round
+stale. That is safe by construction: the slot-schedule verify program's
+sample-and-match acceptance (sampling.speculative_match) makes the
+EMITTED stream independent of the draft values, so a stale guess can only
+lower the accept rate, never change a token. Controller decisions land at
+round boundaries one round late for the same reason (its counters update
+at sync). See docs/INFERENCE.md "Overlapped scheduling".
 """
 
 from __future__ import annotations
@@ -248,7 +259,13 @@ class LearnedDrafter(Drafter):
         [B, H] (the engine-returned device hidden states). ``n`` must be
         the engine's ``spec_len`` — the program's compiled length; ragged
         per-slot lengths are the verify mask's job, so callers slice the
-        prefix they need. Returns host int32 [B, n]."""
+        prefix they need. Returns host int32 [B, n].
+
+        The overlap pipeline passes the HOST ``_last_tok`` view here even
+        though it is one round stale (passing the device-carried token
+        row would host-sync on the in-flight round — the bubble the
+        pipeline exists to remove); a stale conditioning token only costs
+        acceptance, never correctness (module docstring)."""
         import jax.numpy as jnp
 
         if n != self.engine.spec_len:

@@ -1214,6 +1214,11 @@ def _build_engine_and_params(args):
         cfg.inference.kv_layout = args.kv_layout
     if getattr(args, "role", None):
         cfg.inference.role = args.role
+    if getattr(args, "overlap", False):
+        # zero-bubble pipelined scheduling (docs/INFERENCE.md
+        # "Overlapped scheduling"): forces the per-slot key schedule,
+        # token streams stay bit-identical to the default
+        cfg.inference.overlap = True
     if getattr(args, "kv_layout", None) or getattr(args, "role", None):
         # either override can break the role/layout invariant (e.g.
         # --kv-layout contiguous on a config whose role is prefill)
@@ -1361,6 +1366,19 @@ def _smoke(server: Server, obs_dump: str = "") -> int:
     check("tracez_request_chain",
           tst == 200 and not trace_dump.validate(trace)
           and any(c["complete"] for c in chains.values()))
+    if stats.get("overlap", {}).get("enabled"):
+        # zero-bubble gates (--overlap): the issue-to-issue gap collapses
+        # under a full pipeline — strictly below the per-round host sync
+        # it used to serialize behind — and every overlap span links
+        # round N's sync stage inside round N+1's dispatch window
+        ov = stats["overlap"]
+        gap = (ov.get("dispatch_gap_s") or {}).get("p50")
+        check("overlap_gap_lt_host_sync",
+              gap is not None
+              and gap < max(stats.get("last_host_sync_s", 0.0), 1e-6))
+        oc = trace_dump.overlap_chain(trace)
+        check("overlap_span_chain",
+              oc["linked"] >= 1 and not oc["errors"])
     if obs_dump:
         os.makedirs(obs_dump, exist_ok=True)
         with open(os.path.join(obs_dump, "trace.json"), "w") as f:
@@ -1439,6 +1457,10 @@ def main(argv=None) -> int:
                     default=None,
                     help="KV cache layout override (paged is required "
                          "for any role but 'both')")
+    ap.add_argument("--overlap", action="store_true",
+                    help="zero-bubble scheduling: issue dispatch N+1 "
+                         "before syncing dispatch N (sets "
+                         "inference.overlap; bit-identical streams)")
     ap.add_argument("--max-queue", type=int, default=64,
                     help="bounded wait queue: excess submissions get 503")
     ap.add_argument("--token-budget", type=int, default=None,

@@ -128,6 +128,49 @@ def request_chains(trace: dict) -> dict:
     return chains
 
 
+def overlap_chain(trace: dict) -> dict:
+    """Validate the overlapped-scheduling span chain (inference.overlap;
+    docs/INFERENCE.md "Overlapped scheduling"): every ``overlap`` event
+    must parent to a ``dispatch/*`` span and sit inside its parent's
+    window — the witness that round N's sync/deliver stage ran while
+    round N+1 executed on device. Returns {"overlaps", "linked",
+    "errors"}; the obs-smoke overlap leg requires >= 1 linked and no
+    errors (``--require-overlap-chain``)."""
+    events = [e for e in trace.get("traceEvents", ())
+              if isinstance(e, dict)]
+    by_id = {}
+    for e in events:
+        sid = (e.get("args") or {}).get("id")
+        if sid is not None:
+            by_id[sid] = e
+    out = {"overlaps": 0, "linked": 0, "errors": []}
+    for i, ev in enumerate(events):
+        if ev.get("name") != "overlap":
+            continue
+        out["overlaps"] += 1
+        parent = by_id.get((ev.get("args") or {}).get("parent"))
+        if parent is None:
+            out["errors"].append(
+                f"event {i}: overlap span has no resolvable parent")
+            continue
+        if not str(parent.get("name", "")).startswith("dispatch/"):
+            out["errors"].append(
+                f"event {i}: overlap parent is {parent.get('name')!r}, "
+                f"expected a dispatch/* span")
+            continue
+        p0 = parent.get("ts", 0)
+        p1 = p0 + parent.get("dur", 0)
+        t0 = ev.get("ts", 0)
+        t1 = t0 + ev.get("dur", 0)
+        if t0 < p0 - 2 or t1 > p1 + 2:  # 2us slack: ts quantization
+            out["errors"].append(
+                f"event {i}: overlap window [{t0}, {t1}] escapes its "
+                f"dispatch parent's [{p0}, {p1}]")
+            continue
+        out["linked"] += 1
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="validate/query Chrome-trace JSON from the span "
@@ -142,6 +185,10 @@ def main(argv=None) -> int:
                     help="fail unless a COMPLETE request chain exists "
                          "(prefill -> >=1 dispatch -> delivery); pass a "
                          "UID to require that specific request's")
+    ap.add_argument("--require-overlap-chain", action="store_true",
+                    help="fail unless >= 1 'overlap' span links to a "
+                         "dispatch/* parent within its window (the "
+                         "inference.overlap pipeline's obs-smoke gate)")
     args = ap.parse_args(argv)
     if not args.path and not args.url:
         ap.error("pass a trace file path or --url")
@@ -175,6 +222,18 @@ def main(argv=None) -> int:
             print(f"FAILED: no complete request chain"
                   f"{'' if want == 'any' else f' for uid {want!r}'}",
                   file=sys.stderr)
+            return 1
+    if args.require_overlap_chain:
+        ov = overlap_chain(trace)
+        print(f"overlap chain: {ov['overlaps']} spans, "
+              f"{ov['linked']} linked")
+        for e in ov["errors"]:
+            print(f"FAILED: {e}", file=sys.stderr)
+        if ov["errors"] or not ov["linked"]:
+            if not ov["overlaps"]:
+                print("FAILED: no overlap spans in trace "
+                      "(was the server run with --overlap?)",
+                      file=sys.stderr)
             return 1
     return 0
 

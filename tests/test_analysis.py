@@ -927,6 +927,97 @@ def test_c003_negatives_init_and_consistent_guarding(tmp_path):
     assert found == []
 
 
+def test_c003_stats_scrape_scratch_fields_regression(tmp_path):
+    """The batcher stats() race this repo shipped (and fixed alongside
+    the overlap pipeline): the dispatch loop wrote ``_host_sync_s`` /
+    ``_last_prefill`` bare while a server thread's stats() scrape read
+    them — once the scrape takes a leaf lock, the loop's bare writes are
+    exactly C003's mutated-outside-the-guarding-lock shape. The fixture
+    mirrors inference/batcher.py's fields so a relapse trips here."""
+    found = _scan(tmp_path, """
+        import threading
+
+        class Batcher:
+            def __init__(self):
+                self._scratch_mu = threading.Lock()
+                self._host_sync_s = 0.0
+                self._last_prefill = {}
+
+            def _sync_round(self, dt):
+                with self._scratch_mu:
+                    self._host_sync_s = dt
+
+            def _fallback_round(self, dt):
+                self._host_sync_s = dt  # one path missed: the relapse
+
+            def stats(self):
+                with self._scratch_mu:
+                    return {"last_host_sync_s": self._host_sync_s,
+                            "last_prefill": dict(self._last_prefill)}
+        """)
+    assert _rules(found) == ["PICO-C003"]
+    assert found[0].context == "Batcher._fallback_round"
+
+
+def test_c003_negative_scratch_snapshots_under_leaf_lock(tmp_path):
+    """The FIXED batcher shape stays clean: every write of the scratch
+    fields and the scrape's snapshot sit under the same leaf lock, and
+    the blocking device sync (C002's concern) happens OUTSIDE it — the
+    lock wraps only the dict copy and float store."""
+    found = _scan(tmp_path, """
+        import threading
+        import time
+
+        class Batcher:
+            def __init__(self):
+                self._scratch_mu = threading.Lock()
+                self._host_sync_s = 0.0
+                self._last_prefill = {}
+
+            def _sync_round(self, materialize, t0):
+                materialize()       # device sync: blocks, lock-free
+                time.sleep(0.001)   # synthetic device window: lock-free
+                with self._scratch_mu:
+                    self._host_sync_s = time.monotonic() - t0
+
+            def _prefill(self, info):
+                with self._scratch_mu:
+                    self._last_prefill = dict(info)
+
+            def stats(self):
+                with self._scratch_mu:
+                    return {"last_host_sync_s": self._host_sync_s,
+                            "last_prefill": dict(self._last_prefill)}
+        """)
+    assert found == []
+
+
+def test_c002_positive_device_sync_under_scratch_lock(tmp_path):
+    """The tempting wrong fix for the stats() race — wrap the whole sync
+    stage, blocking wait included, in the scratch lock — trades a race
+    for a stalled scrape plane: C002 flags the sleep held under the
+    lock, which is why the leaf lock wraps only the snapshot."""
+    found = _scan(tmp_path, """
+        import threading
+        import time
+
+        class Batcher:
+            def __init__(self):
+                self._scratch_mu = threading.Lock()
+                self._host_sync_s = 0.0
+
+            def _sync_round(self, t0):
+                with self._scratch_mu:
+                    time.sleep(0.001)  # blocking under the leaf lock
+                    self._host_sync_s = time.monotonic() - t0
+
+            def stats(self):
+                with self._scratch_mu:
+                    return {"last_host_sync_s": self._host_sync_s}
+        """)
+    assert "PICO-C002" in _rules(found)
+
+
 def test_c003_negative_thread_starting_method_is_exempt(tmp_path):
     # regression: writes in the method that STARTS the worker thread
     # happen-before Thread.start, same as __init__ (module docstring
