@@ -2,7 +2,7 @@
 NATIVE_SO := picotron_tpu/native/_build/libpicotron_data.so
 NATIVE_SRC := picotron_tpu/native/dataloader.cc
 
-.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke dp-smoke tenant-smoke fleet-chaos-smoke fleet-bench obs-smoke overlap-smoke clean
+.PHONY: native test test-all test-isolated bench lint decode-smoke spec-smoke kernel-smoke quant-smoke paged-smoke chaos-smoke chaos-pod-smoke serve-smoke serve-chaos-smoke router-chaos-smoke disagg-smoke dp-smoke tenant-smoke fleet-chaos-smoke fleet-bench obs-smoke overlap-smoke mixed-smoke clean
 
 native: $(NATIVE_SO)
 
@@ -27,6 +27,7 @@ test-all: native lint
 	$(MAKE) tenant-smoke
 	$(MAKE) fleet-chaos-smoke
 	$(MAKE) overlap-smoke
+	$(MAKE) mixed-smoke
 
 # picolint static analysis (picotron_tpu/analysis/, docs/ANALYSIS.md):
 # JAX hot-path rules (host syncs on traced values, trace-time
@@ -189,6 +190,20 @@ obs-smoke:
 # stays overlap OFF, so decode/spec-smoke output is unchanged.
 overlap-smoke:
 	JAX_PLATFORMS=cpu python bench_decode.py --overlap ab
+
+# Mixed prefill-decode dispatch smoke (inference.mixed_dispatch,
+# docs/INFERENCE.md "Mixed prefill-decode dispatch"): the bench_decode
+# --mixed ab protocol — long prompts arriving mid-decode with the fused
+# lane off then on, plus a decoders-only TPOT floor leg. Gates
+# bit-identical token streams, decode TPOT p95 under concurrent prefill
+# <= 3x the no-prefill floor, TTFT p95 <= 3x the serial+gate baseline
+# (a CPU-proxy allowance: a solo B=1 chunk dispatch here is ~3x cheaper
+# than a fused round), and prompt tokens actually moved through the lane
+# (picotron_prefill_lane_tokens_total). Runs inside `make test-all`;
+# the serving default stays mixed_dispatch OFF, so every other smoke's
+# output is unchanged.
+mixed-smoke:
+	JAX_PLATFORMS=cpu python bench_decode.py --mixed ab
 
 # Multi-replica router chaos drill (tools/router.py, docs/SERVING.md
 # "Multi-replica fabric"): 3 in-process serve.py replicas behind the

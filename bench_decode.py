@@ -1419,6 +1419,177 @@ def run_overlap(synthetic_s: float) -> dict:
     }
 
 
+def run_mixed() -> dict:
+    """Mixed prefill–decode dispatch A/B (CPU proxy): long prompts keep
+    arriving while a batch of decoders is mid-stream, with
+    ``inference.mixed_dispatch`` off (serial admission prefill: every
+    chunk is a solo dispatch the seated decoders wait out) and on (the
+    chunk rides the fused lane of the decode dispatch itself). Three
+    legs, same seed, per-slot key schedule pinned on both sides:
+
+    - ``floor``: decoders only, mixed on — the no-prefill TPOT floor;
+    - ``on``:    decoders + arriving long prompts, mixed on;
+    - ``off``:   the identical workload, mixed off (serial + gate).
+
+    TPOT is the pooled inter-token gap of the DECODER streams (their
+    own first token excluded); TTFT is submit-to-first-token of the
+    long prompts. Gates (enforced by main's --mixed branch /
+    ``make mixed-smoke``):
+
+    - token streams BIT-IDENTICAL on vs off (the tentpole invariant);
+    - decode TPOT p95 under concurrent prefill (on) <= 3x the
+      no-prefill floor — prompts land without stalling decode;
+    - TTFT p95 on <= 3x off — admission through the lane stays at its
+      feed rate, ceil(prompt/chunk) rounds to first token. The bound
+      is a CPU-proxy allowance, not a target: here a solo B=1 chunk
+      dispatch costs ~1/3 of a full fused round (per-dispatch python
+      overhead dominates), so the serial leg's TTFT is structurally
+      understated relative to an accelerator, where a C-token chunk
+      and a slots*block decode round do comparable work;
+    - the on leg actually moved prompt tokens through the lane
+      (``picotron_prefill_lane_tokens_total`` > 0).
+    """
+    import jax
+
+    from picotron_tpu.config import Config
+    from picotron_tpu.inference import (
+        ContinuousBatcher,
+        InferenceEngine,
+        Request,
+    )
+    from picotron_tpu.models import llama
+
+    model = dict(
+        name="tiny", num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, hidden_size=64, intermediate_size=128,
+        vocab_size=256, max_position_embeddings=160, dtype="float32",
+        attention_impl="sdpa")
+    slots, block, chunk = 4, 4, 8
+    decoders = 3          # long-running decode streams (the TPOT probes)
+    long_prompt = 24      # 3 lane chunks at chunk=8; > chunk so it lanes
+    # arrivals land mid-decode, triggered by d0's token count — spaced
+    # wider than the 3 rounds a 24-token prompt occupies the lane, so
+    # TTFT measures the prefill path itself, not queue backlog behind a
+    # saturated lane (arrival-rate <= lane-feed-rate is the regime the
+    # fused lane serves; past saturation every scheme queues)
+    arrive_at_tok = {8: 0, 24: 1, 40: 2}
+
+    def one(mixed: bool, with_prefill: bool) -> dict:
+        cfg = Config.from_dict({
+            "distributed": {"tp_size": 1, "use_cpu": True},
+            "model": dict(model),
+            "training": {"seq_length": 160},
+            "dataset": {"name": "synthetic"},
+            "inference": {"mixed_dispatch": mixed, "prefill_chunk": chunk,
+                          "key_schedule": "slot"},
+        })
+        engine = InferenceEngine(cfg, slots=slots, max_seq_len=160,
+                                 decode_block_len=block)
+        params = engine.shard_params(jax.jit(
+            lambda k: llama.init_params(k, cfg.model))(
+                jax.random.PRNGKey(0)))
+        b = ContinuousBatcher(engine, params, seed=7)
+        # warm every program the measured run needs OUTSIDE the timed
+        # window: the (fused, when mixed) decode family at full batch,
+        # the short-prompt prefill bucket, and the long-prompt path
+        # (lane chunks when mixed, bucketed serial prefill when not)
+        b.run([Request(f"warm{i}", [3, 1, 4, 1, 5], max_new_tokens=block)
+               for i in range(slots - 1)]
+              + [Request("warmL", [2 * j % 199 + 1
+                                   for j in range(long_prompt)],
+                         max_new_tokens=block)])
+        # two measured repeats on the SAME warmed batcher (no
+        # recompiles): the gates use the per-leg MIN p95, which
+        # de-noises scheduler hiccups on both sides of every ratio —
+        # with 3 TTFT samples per repeat a p95 is effectively a max,
+        # and one preempted leg would otherwise fail a sound gate
+        streams: dict = {}
+        tpots, ttfts = [], []
+        for rep in range(2):
+            t_tok: dict = {}
+            sub_t: dict = {}
+            fired: set = set()
+            d0 = f"d{rep}.0"
+
+            def on_token(uid, tok, t_tok=t_tok, sub_t=sub_t,
+                         fired=fired, d0=d0, rep=rep):
+                t_tok.setdefault(uid, []).append(time.perf_counter())
+                k = (arrive_at_tok.get(len(t_tok[uid]))
+                     if uid == d0 else None)
+                if with_prefill and k is not None and k not in fired:
+                    fired.add(k)
+                    r = Request(f"L{rep}.{k}",
+                                [(5 * k + 3 * j) % 199 + 1
+                                 for j in range(long_prompt)],
+                                max_new_tokens=4)
+                    sub_t[r.uid] = time.perf_counter()
+                    b.submit(r)
+
+            b.on_token = on_token
+            # the decoders: short (sub-chunk) prompts, long streams,
+            # and a TPOT SLO so the off leg's admissions run through
+            # the ARMED prefill gate — serial+gate, not bare serial
+            res = b.run([Request(f"d{rep}.{i}",
+                                 [(7 * i + j) % 199 + 1
+                                  for j in range(5)],
+                                 max_new_tokens=60, tpot_slo_ms=50.0)
+                         for i in range(decoders)])
+            tpots.append(_p(
+                [dt for uid, ts in t_tok.items() if uid.startswith("d")
+                 for dt in (t1 - t0 for t0, t1 in zip(ts, ts[1:]))], 95))
+            ttft = [t_tok[uid][0] - t for uid, t in sub_t.items()
+                    if uid in t_tok]
+            ttfts.append(_p(ttft, 95) if ttft else None)
+            # the key chain advances one split per admission — the same
+            # count in both modes — so repeat r's streams match across
+            # legs (and only across the same r); uids carry the repeat
+            streams.update({uid: r.tokens for uid, r in res.items()
+                            if uid.startswith(("d", "L"))})
+        snap = b.obs.registry.snapshot()
+
+        def total(name, field=None):
+            fam = snap.get(name)
+            if not fam:
+                return 0
+            vals = fam["values"].values()
+            return sum(v[field] for v in vals) if field else sum(vals)
+
+        toks = sum(len(t) for t in streams.values())
+        return {
+            "streams": streams,
+            "tpot_p95_s": min(tpots),
+            "ttft_p95_s": (min(t for t in ttfts if t is not None)
+                           if any(t is not None for t in ttfts)
+                           else None),
+            "lane_tokens": total("picotron_prefill_lane_tokens_total"),
+            "decode_stalls": total("picotron_decode_stall_seconds",
+                                   "count"),
+            "dispatches_per_token": round(
+                (b.decode_dispatches + b.prefill_dispatches)
+                / max(toks, 1), 3),
+        }
+
+    floor = one(True, False)
+    on = one(True, True)
+    off = one(False, True)
+    return {
+        "tpot_floor_p95_s": floor["tpot_p95_s"],
+        "tpot_on_p95_s": on["tpot_p95_s"],
+        "tpot_off_p95_s": off["tpot_p95_s"],
+        "tpot_vs_floor": round(on["tpot_p95_s"]
+                               / max(floor["tpot_p95_s"], 1e-9), 3),
+        "ttft_on_p95_s": on["ttft_p95_s"],
+        "ttft_off_p95_s": off["ttft_p95_s"],
+        "lane_tokens_on": on["lane_tokens"],
+        "lane_tokens_off": off["lane_tokens"],
+        "decode_stalls_on": on["decode_stalls"],
+        "decode_stalls_off": off["decode_stalls"],
+        "dispatches_per_token": {"on": on["dispatches_per_token"],
+                                 "off": off["dispatches_per_token"]},
+        "streams_match": on["streams"] == off["streams"],
+    }
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="decode throughput bench")
     ap.add_argument("--block-len", type=int, default=1,
@@ -1529,7 +1700,74 @@ def main(argv=None) -> None:
                          "to this many seconds via the batcher's "
                          "synthetic-sync knob (models hideable device "
                          "time the tiny CPU model lacks; default 20ms)")
+    ap.add_argument("--mixed", choices=("ab",), default=None,
+                    help="mixed prefill-decode dispatch A/B (CPU proxy): "
+                         "long prompts arriving mid-decode with "
+                         "inference.mixed_dispatch off then on, plus a "
+                         "decoders-only TPOT floor leg — the JSON gains "
+                         "decode TPOT p95 / TTFT p95 / lane-token / "
+                         "stall-count comparisons; gates bit-identical "
+                         "streams, TPOT p95 under concurrent prefill "
+                         "<= 3x the floor, TTFT p95 <= 3x serial")
     args = ap.parse_args(argv)
+    if args.mixed:
+        # the mixed smoke is its own protocol (three batcher legs,
+        # fused lane off vs on vs no-prefill floor; stream-exactness +
+        # stall-closure gates, not absolute tokens/s) — CPU proxy
+        if args.disagg or args.fleet or args.tenants or args.spec_len \
+                or args.dp > 1 or args.overlap:
+            ap.error("--mixed is its own protocol; drop the other "
+                     "mode flags")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            res = run_mixed()
+        except Exception as e:  # noqa: BLE001 - the record IS the channel
+            print(json.dumps({
+                "metric": "mixed_dispatch_cpu_smoke", "value": None,
+                "unit": "s", "vs_baseline": None,
+                "code_failure": True,
+                "error": f"{type(e).__name__}: {e}"[:800]}))
+            raise
+        print(f"# mixed bench: tpot_p95 floor={res['tpot_floor_p95_s']} "
+              f"on={res['tpot_on_p95_s']} off={res['tpot_off_p95_s']} "
+              f"(on/floor {res['tpot_vs_floor']}x) "
+              f"ttft_p95 on={res['ttft_on_p95_s']} "
+              f"off={res['ttft_off_p95_s']} "
+              f"lane_tokens={res['lane_tokens_on']} "
+              f"stalls off={res['decode_stalls_off']} "
+              f"on={res['decode_stalls_on']} "
+              f"streams_match={res['streams_match']}",
+              file=sys.stderr)
+        record = {"metric": "mixed_dispatch_cpu_smoke",
+                  "value": res["tpot_on_p95_s"], "unit": "s",
+                  "vs_baseline": None, "validated": False, **res}
+        print(json.dumps(record))
+        # the gates: the fused lane must change NOTHING about the
+        # emitted streams, keep decode within 3x its no-prefill floor
+        # while prompts land, actually carry the prompts (lane tokens),
+        # and not starve admission relative to the serial path
+        if not res["streams_match"]:
+            raise SystemExit("mixed gate failed: mixed-on streams "
+                             "diverge from mixed-off")
+        if not res["lane_tokens_on"]:
+            raise SystemExit("mixed gate failed: no prompt tokens moved "
+                             "through the lane in the on leg")
+        if res["lane_tokens_off"]:
+            raise SystemExit("mixed gate failed: the mixed-off leg "
+                             "moved tokens through the lane")
+        if res["tpot_vs_floor"] > 3.0:
+            raise SystemExit(
+                f"mixed gate failed: decode TPOT p95 under concurrent "
+                f"prefill {res['tpot_on_p95_s']:.6f}s > 3x no-prefill "
+                f"floor {res['tpot_floor_p95_s']:.6f}s")
+        if res["ttft_on_p95_s"] is None or res["ttft_off_p95_s"] is None:
+            raise SystemExit("mixed gate failed: missing TTFT "
+                             "percentiles")
+        if res["ttft_on_p95_s"] > 3.0 * res["ttft_off_p95_s"]:
+            raise SystemExit(
+                f"mixed gate failed: TTFT p95 on {res['ttft_on_p95_s']:.6f}s "
+                f"> 3x serial {res['ttft_off_p95_s']:.6f}s")
+        return
     if args.overlap:
         # the overlap smoke is its own protocol (one batcher workload,
         # pipeline off vs on; stream-exactness + bubble-closure gates,

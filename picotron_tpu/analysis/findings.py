@@ -71,6 +71,14 @@ RULES = {
             "pipelining introduces",
         ),
         Rule(
+            "PICO-J006",
+            "model program dispatched outside _dispatch",
+            "a compiled model program (a self._*_jit/_prog attribute "
+            "called with params as its first operand) invoked outside "
+            "self._dispatch(lambda: ...) skips the retry / flash-fallback "
+            "fault wrapper every engine program family must inherit",
+        ),
+        Rule(
             "PICO-C001",
             "lock-order inversion",
             "two locks acquired in opposite orders on different code paths "

@@ -35,6 +35,14 @@ outside jit).  From each entry the intra-project call graph is walked
   outside the loop: the DMA is still in flight when its buffer is read
   (or the semaphore imbalances) — the exact hazard the double-buffered
   decode kernel (``ops/pallas/decode_attention.py``) must discipline.
+- **PICO-J006** — a compiled model program called around ``_dispatch``.
+  In any class defining ``_dispatch`` (the retry / flash-fallback fault
+  wrapper), a call to a ``self._*_jit`` / ``self._*_prog`` attribute
+  whose first operand is ``params`` (the model-program signature —
+  housekeeping programs take the cache or nothing first) must sit inside
+  a ``self._dispatch(...)`` argument; a direct call silently opts the
+  program family out of the engine's fault semantics.  Builder calls
+  (``self._make_*``) construct rather than dispatch and are exempt.
 """
 
 from __future__ import annotations
@@ -751,6 +759,76 @@ def _check_dma_waits(mod: ModuleInfo, findings: list) -> None:
                         f"discharge N per-iteration starts")
 
 
+_PROGRAM_ATTR_SUFFIXES = ("_jit", "_prog")
+
+
+def _is_program_call(call: ast.Call) -> bool:
+    """``self._<family>_jit(params, ...)`` / ``self._<family>_prog(
+    params, ...)`` — a compiled MODEL program dispatch.  The ``params``
+    first operand is the discriminator: housekeeping programs
+    (``_set_length_jit``, ``_release_jit``, ...) take the cache (or
+    nothing) first and may run outside the fault wrapper.  ``_make_*``
+    builders construct programs rather than dispatch them."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "self"):
+        return False
+    name = f.attr
+    if not name.startswith("_") or name.startswith("_make_"):
+        return False
+    if not name.endswith(_PROGRAM_ATTR_SUFFIXES):
+        return False
+    if not call.args:
+        return False
+    first = call.args[0]
+    return (isinstance(first, ast.Name)
+            and (first.id == "params" or first.id.endswith("_params")))
+
+
+def _check_dispatch_routing(mod: ModuleInfo, findings: list) -> None:
+    """PICO-J006: in a class that defines ``_dispatch`` (the retry /
+    flash-fallback fault wrapper), every compiled model-program call
+    (``self._*_jit(params, ...)``) must occur inside an argument of a
+    ``self._dispatch(...)`` call — usually ``self._dispatch(lambda:
+    self._x_jit(params, ...))``.  A direct call opts that program family
+    out of the engine's fault semantics; nothing else re-dispatches it
+    after a flash->dense rebuild."""
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = [n for n in cls.body if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        if not any(m.name == "_dispatch" for m in methods):
+            continue
+        routed: set = set()
+        for node in ast.walk(cls):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_dispatch"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords]:
+                    routed.update(id(n) for n in ast.walk(arg))
+        for m in methods:
+            if m.name == "_dispatch":
+                continue  # the wrapper itself runs the routed callable
+            for node in ast.walk(m):
+                if (isinstance(node, ast.Call) and _is_program_call(node)
+                        and id(node) not in routed):
+                    findings.append(Finding(
+                        rule="PICO-J006", path=mod.rel, line=node.lineno,
+                        context=enclosing_qualname(mod, node),
+                        snippet=mod.snippet(node.lineno),
+                        message=f"compiled model program "
+                                f"self.{node.func.attr}(params, ...) "
+                                f"called outside self._dispatch — wrap "
+                                f"it as self._dispatch(lambda: ...) so "
+                                f"the family inherits retry/fallback "
+                                f"fault semantics "
+                                f"(docs/ANALYSIS.md#pico-j006)"))
+
+
 # --------------------------------------------------------------------------- #
 # driver
 # --------------------------------------------------------------------------- #
@@ -779,4 +857,5 @@ def analyze(project: Project) -> list:
         _check_program_id(project, mod, findings)
         _check_jit_in_loop(mod, findings)
         _check_dma_waits(mod, findings)
+        _check_dispatch_routing(mod, findings)
     return findings

@@ -565,8 +565,19 @@ class InferenceConfig:
     #   keyed fold_in(base, p-1): streams depend only on (base key,
     #   prompt, logits), independent of round boundaries, draft
     #   contents, and controller decisions;
-    # "auto" (default) — "slot" when overlap is on, else "round".
+    # "auto" (default) — "slot" when overlap or mixed_dispatch is on,
+    #   else "round".
     key_schedule: str = "auto"
+    # Stall-free mixed prefill–decode dispatch (docs/INFERENCE.md "Mixed
+    # prefill–decode dispatch"): every decode/verify dispatch also
+    # carries one fixed-width prefill LANE (prefill_chunk tokens, padded
+    # and masked when idle so the compiled shape never changes), so
+    # admissions stream in without stalling active decode slots on solo
+    # prefill dispatches. Requires the per-slot key schedule
+    # (key_schedule resolves to "slot" under "auto") so sampled streams
+    # stay bit-identical to mixed-off. False (default) keeps the serial
+    # prefill path byte-identical to today's scheduler.
+    mixed_dispatch: bool = False
 
     def __post_init__(self):
         # from_dict hands nested blocks through as plain dicts; coerce so
@@ -1181,6 +1192,17 @@ class Config:
                 "round-keyed sampling ties token streams to round "
                 "boundaries, which the lookahead pipeline changes; set "
                 "inference.key_schedule: 'slot' (or leave it 'auto')")
+        if not isinstance(inf.mixed_dispatch, bool):
+            raise ValueError(
+                f"inference.mixed_dispatch must be a JSON boolean "
+                f"(true/false), got {inf.mixed_dispatch!r}")
+        if inf.mixed_dispatch and inf.key_schedule == "round":
+            raise ValueError(
+                "inference.mixed_dispatch requires the per-slot key "
+                "schedule — round-keyed sampling ties token streams to "
+                "round boundaries, which fusing the prefill lane into "
+                "decode rounds changes; set inference.key_schedule: "
+                "'slot' (or leave it 'auto')")
         sc = inf.spec_controller
         if not isinstance(sc.enabled, bool):
             raise ValueError(

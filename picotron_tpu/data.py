@@ -71,11 +71,26 @@ class _ArrowSamples:
 
     def __init__(self, ds):
         self._ds = ds.with_format("numpy", columns=["ids"])
+        # the packed "ids" column as one arrow ChunkedArray of fixed-width
+        # list rows — gather() runs a single `take` over it instead of a
+        # per-row python fetch (bitwise-pinned against the per-row path by
+        # tests/test_hf_data.py)
+        self._ids = self._ds.data.column("ids")
 
     def __len__(self) -> int:
         return len(self._ds)
 
     def gather(self, idx: np.ndarray) -> np.ndarray:
+        import pyarrow as pa
+
+        idx = np.ascontiguousarray(np.asarray(idx, dtype=np.int64))
+        rows = self._ids.take(pa.array(idx)).combine_chunks()
+        flat = rows.flatten().to_numpy(zero_copy_only=False)
+        return np.asarray(flat, dtype=np.int32).reshape(len(idx), -1)
+
+    def _gather_per_row(self, idx: np.ndarray) -> np.ndarray:
+        """Reference per-row fetch; kept as the equality oracle for the
+        batched arrow `take` above."""
         rows = self._ds[[int(i) for i in idx]]["ids"]
         return np.asarray(rows, dtype=np.int32)
 

@@ -183,6 +183,10 @@ class _Slot:
     queue_wait_s: Optional[float] = None
     ttft_s: Optional[float] = None
     dispatches: int = 0  # rounds this slot was active in
+    # mixed_dispatch: the slot is being prefilled chunk-by-chunk through
+    # its shard's fused lane — it rides every decode/verify dispatch
+    # INACTIVE (budget 0) until the final chunk lands its first token
+    prefilling: bool = False
 
 
 class ContinuousBatcher:
@@ -382,6 +386,21 @@ class ContinuousBatcher:
         self._host_work_hist = reg.histogram(
             "picotron_host_work_seconds",
             "per-round host scheduling work (step wall minus sync wait)")
+        # ---- mixed prefill–decode dispatch (inference.mixed_dispatch) -----
+        # one prefill LANE per dp shard rides every decode/verify
+        # dispatch (engine._lane_chunk): a long-prompt admission is
+        # seated immediately (prefilling=True, budget 0) and its prompt
+        # is fed through the lane one fixed-width chunk per round — no
+        # solo prefill dispatch ever stalls the decoders behind it. Each
+        # lane record tracks one such admission: its slot/epoch/request,
+        # the full prompt ids, the radix-cached prefix it resumed past,
+        # done_end (rows CONFIRMED landed), fed_end (rows fed — one
+        # chunk ahead of done_end while a round is in flight under
+        # overlap), the admit-time fold key the final chunk's
+        # first-token draw consumes, and the open prefill span.
+        self._mixed = bool(getattr(engine, "mixed", False))
+        self._lanes: list = [None] * engine.dp_size
+        self._lane_scratch = None  # last dispatch's (lane_out, lane_hid)
         # leaf lock for the scratch fields a stats() scrape may read from
         # another thread while the dispatch loop mutates them
         # (_host_sync_s, _last_prefill). Strictly a leaf: no other lock
@@ -772,6 +791,11 @@ class ContinuousBatcher:
             ov["wall_s"] = wall
             ov["overlap_efficiency"] = min(1.0, self._ov_device_s / wall)
         d["overlap"] = ov
+        # mixed prefill–decode dispatch: whether the fused lane family is
+        # compiled in, and how many shard lanes are mid-prompt right now
+        d["mixed"] = dict(
+            enabled=self._mixed,
+            lanes_active=sum(ln is not None for ln in self._lanes))
         return d
 
     # ---- one scheduler round ----------------------------------------------
@@ -837,6 +861,14 @@ class ContinuousBatcher:
         # retire bumps the seat's epoch: any in-flight round that was
         # issued against this occupant drops the row at sync
         self._epoch[i] += 1
+        if self._mixed:
+            # a lane occupant retiring mid-prompt (timeout, dispatch
+            # error) abandons its lane; a chunk still in flight is
+            # isolated by the epoch bump above
+            sh = i // self.engine.slots_per_shard
+            if (self._lanes[sh] is not None
+                    and self._lanes[sh]["slot"] == i):
+                self._lane_drop(sh, reason)
         self._cache = self.engine.release(self._cache, i)
         self._last_tok[i] = 0
         self._temp[i] = 0.0
@@ -1045,7 +1077,8 @@ class ContinuousBatcher:
                 best = j
         return best
 
-    def _prefill_gate(self, req: Request) -> bool:
+    def _prefill_gate(self, req: Request, tokens: Optional[int] = None,
+                      submit_t: Optional[float] = None) -> bool:
         """SLO-aware chunked-prefill interleaving: when an ACTIVE slot
         carries a TPOT SLO, admission stops after one ``prefill_chunk``'s
         worth of prompt tokens per scheduler round — prefill work
@@ -1055,14 +1088,24 @@ class ContinuousBatcher:
         (progress guarantee). A waiting request whose TTFT budget is
         half spent PREEMPTS the cap — its own SLO outranks the decoders'
         smoothness — with both decisions visible in the
-        ``picotron_tenant_prefill_*`` counters."""
+        ``picotron_tenant_prefill_*`` counters.
+
+        ``tokens`` prices the decision (default: the whole prompt — a
+        serial admission prefills it all this round); the mixed lane
+        feed prices ONE chunk, so the same gate budget becomes the lane
+        feed rate. ``submit_t`` overrides the pending-queue clock lookup
+        for the TTFT preempt (the lane's request left ``_submit_t`` at
+        lane admission; its slot record carries the time instead)."""
+        if tokens is None:
+            tokens = len(req.prompt)
         if self._round_prefill_tokens == 0:
             return True
         if not any(s is not None and s.req.tpot_slo_ms is not None
                    for s in self._slots):
             return True
         if req.ttft_slo_ms is not None:
-            t0 = self._submit_t.get(req.uid)
+            t0 = (submit_t if submit_t is not None
+                  else self._submit_t.get(req.uid))
             if (t0 is not None and (self._clock() - t0) * 1000.0
                     >= req.ttft_slo_ms / 2.0):
                 self._tstat(req)["prefill_preempts"] += 1
@@ -1072,7 +1115,7 @@ class ContinuousBatcher:
                     "interleave cap, by tenant",
                     tenant=self._tname(req)).inc()
                 return True
-        if (self._round_prefill_tokens + len(req.prompt)
+        if (self._round_prefill_tokens + tokens
                 <= self.engine.prefill_chunk):
             return True
         self._tstat(req)["prefill_deferred"] += 1
@@ -1083,11 +1126,49 @@ class ContinuousBatcher:
             tenant=self._tname(req)).inc()
         return False
 
+    def _lane_wants(self, req: Request, i: int) -> bool:
+        """Whether ``req`` should prefill through slot ``i``'s shard lane
+        instead of a blocking serial dispatch. Lane-worthy: a prompt
+        longer than one chunk (the serial path would run the exact same
+        chunk programs, just as solo stalls), or a paged prompt with a
+        radix-cached prefix (the serial path resumes CHUNKED past it —
+        again the lane's exact computation). A cold prompt at or under
+        one chunk stays serial: its one-shot bucketed prefill is a
+        different program family, and admitting it serially keeps the
+        mixed-off bit-identity contract chunk-free paths rest on. A
+        handoff payload (``kv_import``) stays serial too — its import
+        path may seat the slot with zero prefill work."""
+        if not self._mixed or req.kv_import is not None:
+            return False
+        if len(req.prompt) > self.engine.prefill_chunk:
+            return True
+        if self.paged is None:
+            return False
+        ids = [int(t) for t in req.prompt]
+        if self.engine.dp_size > 1:
+            return self.paged.peek_prefix(
+                ids, salt=req.tenant,
+                shard=i // self.engine.slots_per_shard) > 0
+        return self.paged.peek_prefix(ids, salt=req.tenant) > 0
+
     def _admit(self) -> None:
         self._round_prefill_tokens = 0
-        for i in range(len(self._slots)):
+        spb = self.engine.slots_per_shard
+        order = range(len(self._slots))
+        if self._mixed and self.engine.dp_size > 1:
+            # feed lanes by the rebalance planner's occupancy view: free
+            # slots on the least-occupied shard seat (and lane) first, so
+            # the global queue drains toward the shard with headroom.
+            # Request ADMISSION order is untouched (_pick per free slot),
+            # so the per-admission key chain — and with it every stream —
+            # is placement-independent.
+            occ = self.shard_occupancy()
+            order = sorted(range(len(self._slots)),
+                           key=lambda x: (occ[x // spb], x))
+        for i in order:
             if self._slots[i] is not None:
                 continue
+            skip_slot = False
             while True:
                 if not self._pending:
                     return
@@ -1102,15 +1183,29 @@ class ContinuousBatcher:
                         self.counters["shed"] += 1
                         self._results[req.uid] = self._shed_result(req)
                         continue
+                lane = self._lane_wants(req, i)
+                if lane and self._lanes[i // spb] is not None:
+                    # this shard's lane is mid-prompt: the candidate
+                    # stays queued (FIFO head-of-line, like a gate
+                    # deferral) — but a free slot on ANOTHER shard may
+                    # still take it, so only this seat is skipped
+                    skip_slot = True
+                    break
+                if self.paged is not None:
                     if not self.paged.can_admit(need, slot=i):
                         # transient pressure: wait — slots finishing
                         # return pages; admitting now could strand a
                         # live slot mid-decode
                         return
-                if not self._prefill_gate(req):
+                if not lane and not self._prefill_gate(req):
                     return  # deferred to the next round's admission
                 del self._pending[j]
                 break
+            if skip_slot:
+                continue
+            if lane:
+                self._lane_start(req, i)
+                continue
             submit_t = self._submit_t.pop(req.uid, None)
             root = self._req_spans.get(req.uid)
             t_admit = self._clock()
@@ -1139,6 +1234,14 @@ class ContinuousBatcher:
             else:
                 key = (self._split() if self.engine.sample_on_device
                        else None)
+            # every second this SOLO prefill dispatch runs is a second no
+            # active decode slot advances — the interference the mixed
+            # lane exists to remove. Timed whenever a decoder is parked
+            # behind it (in both modes: the mixed-off baseline's stall
+            # and the mixed-on residual are the A/B story).
+            stall0 = (self._clock()
+                      if any(s is not None and not s.prefilling
+                             for s in self._slots) else None)
             try:
                 pf_span = self.obs.tracer.begin(
                     "prefill", parent=root, uid=req.uid,
@@ -1168,6 +1271,14 @@ class ContinuousBatcher:
                 else:
                     self._cache_lost()
                 continue
+            finally:
+                if stall0 is not None:
+                    self.obs.registry.histogram(
+                        "picotron_decode_stall_seconds",
+                        "decode time lost to a blocking solo prefill "
+                        "dispatch, by tenant",
+                        tenant=self._tname(req)).observe(
+                            self._clock() - stall0)
             self.counters["admitted"] += 1
             self._tenant_count(req, "admitted")
             if self._last_prefill.get("dispatches", 1) > 0:
@@ -1228,6 +1339,222 @@ class ContinuousBatcher:
                 self._dev_last = self._dev_tok().at[i].set(first)
             self._token_done(i, first)
 
+    # ---- mixed prefill–decode dispatch (the fused lane) -------------------
+
+    def _lane_start(self, req: Request, i: int) -> None:
+        """Seat ``req`` in free slot ``i`` as a PREFILLING occupant and
+        open its shard's lane: the prompt will flow through the fused
+        dispatches one ``prefill_chunk`` at a time (``_lane_feed``), no
+        solo prefill dispatch ever issued. Admission accounting (counters,
+        queue-wait, epoch bump, sampling rows, controller/drafter resets)
+        mirrors the serial seat; the first token — and with it TTFT and
+        ``_token_done`` — arrives when the final chunk lands."""
+        sh = i // self.engine.slots_per_shard
+        submit_t = self._submit_t.pop(req.uid, None)
+        root = self._req_spans.get(req.uid)
+        t_admit = self._clock()
+        if submit_t is not None:
+            self.obs.tracer.record("queue_wait", submit_t, t_admit,
+                                   parent=root)
+        # the one per-admit split seeds the slot's base key exactly like
+        # a serial admission (admission ORDER fixes the streams); the
+        # final chunk's first-token draw folds at len(prompt) - 1 — the
+        # same key every serial chunk's unconsumed epilogue uses
+        self._base_keys[i] = np.asarray(self._split())
+        fold = jax.random.fold_in(
+            jnp.asarray(self._base_keys[i]), len(req.prompt) - 1)
+        ids = [int(t) for t in req.prompt]
+        cached = 0
+        if self.paged is not None:
+            self.paged.priced[i] = self.page_commitment(req)
+            cached = self.paged.match_prefix(i, ids, salt=req.tenant)
+            if cached > 0:
+                # park the shared prefix ready to resume — the serial
+                # path's radix-hit admission, minus its chunk dispatches
+                self._cache = self.engine.seat_slot(self._cache, i,
+                                                    cached)
+        self.counters["admitted"] += 1
+        self._tenant_count(req, "admitted")
+        now = self._clock()
+        deadline = (now + req.timeout_s
+                    if req.timeout_s is not None else None)
+        slot = _Slot(req, deadline=deadline, submit_t=submit_t,
+                     prefilling=True)
+        if submit_t is not None:
+            slot.queue_wait_s = now - submit_t
+            self._queue_wait_hist.observe(slot.queue_wait_s)
+        self._slots[i] = slot
+        self._epoch[i] += 1
+        self._adapter[i] = (req.adapter_slot
+                            if self.engine.adapters is not None else 0)
+        if self.controller is not None:
+            self.controller.reset(i, tpot_slo_s=(
+                req.tpot_slo_ms / 1000.0
+                if req.tpot_slo_ms is not None else None))
+        for d in self._drafters.values():
+            d.begin(req.uid)
+        self._temp[i] = req.temperature
+        self._top_k[i] = req.top_k
+        self._top_p[i] = req.top_p
+        self._eos[i] = req.eos_id if req.eos_id is not None else -1
+        pf_span = self.obs.tracer.begin(
+            "prefill", parent=root, uid=req.uid,
+            prompt_tokens=len(req.prompt), lane=True)
+        self._lanes[sh] = dict(
+            slot=i, epoch=int(self._epoch[i]), req=req, ids=ids,
+            cached=cached, done_end=cached, fed_end=cached, key=fold,
+            chunks=0, span=pf_span, root=root)
+
+    def _lane_drop(self, sh: int, reason: str) -> None:
+        """Abandon shard ``sh``'s lane mid-prompt (occupant retired —
+        timeout/error/cache loss): close its prefill span; the seat's
+        epoch bump already isolates any chunk still in flight."""
+        ln = self._lanes[sh]
+        if ln is None:
+            return
+        self._lanes[sh] = None
+        self.obs.tracer.end(ln["span"], error=reason,
+                            dispatches=ln["chunks"],
+                            cached_tokens=ln["cached"])
+
+    def _lane_feed(self) -> tuple:
+        """Build this round's engine lane operands from the per-shard
+        lane records: one next chunk per live lane, gated by the SAME
+        per-round token budget serial admissions pay (``_prefill_gate``
+        with the chunk's size — the gate budget IS the lane feed rate,
+        deferred chunks count ``prefill_deferred`` exactly like deferred
+        admissions). Returns (lanes-or-None for ``engine.decode_block``
+        / ``verify``, feed records for ``_lane_land``). Under overlap a
+        lane feeds one chunk ahead of its last CONFIRMED row
+        (``fed_end`` > ``done_end``): the in-flight round's chunk is
+        sequenced on device by the cache donation chain, so the next
+        chunk's rows are already parked when this one executes."""
+        if not self._mixed:
+            return None, ()
+        C = self.engine.prefill_chunk
+        lanes: list = [None] * self.engine.dp_size
+        feeds: list = []
+        for sh in range(self.engine.dp_size):
+            ln = self._lanes[sh]
+            if ln is None:
+                continue
+            i = ln["slot"]
+            s = self._slots[i]
+            if (s is None or s.req is not ln["req"]
+                    or self._epoch[i] != ln["epoch"]):
+                self._lane_drop(sh, "occupant_retired")
+                continue
+            ids = ln["ids"]
+            s0 = ln["fed_end"]
+            if s0 >= len(ids):
+                continue  # final chunk in flight, waiting to land
+            end = min(s0 + C, len(ids))
+            if not self._prefill_gate(s.req, tokens=end - s0,
+                                      submit_t=s.submit_t):
+                continue  # deferred a round; gate counters already bumped
+            if self.paged is not None:
+                # absolute chunk start (the paged scatter has no clamp
+                # hazard; a slid window would pointlessly COW a shared
+                # prefix) — prefill_chunked's exact convention
+                w0 = s0
+            else:
+                # contiguous window slide: past max_seq_len - C the
+                # window backs up and re-feeds overlap tokens whose rows
+                # recompute to the values already parked there
+                w0 = min(s0, self.engine.max_seq_len - C)
+            entry = dict(slot=i, tokens=ids[w0:end], start=w0)
+            if self.engine.sample_on_device:
+                entry.update(key=np.asarray(ln["key"]),
+                             temperature=s.req.temperature,
+                             top_k=s.req.top_k, top_p=s.req.top_p)
+            if self.engine.adapters is not None:
+                entry["adapter"] = int(s.req.adapter_slot)
+            lanes[sh] = entry
+            self._round_prefill_tokens += end - s0
+            self.obs.registry.counter(
+                "picotron_prefill_lane_tokens_total",
+                "prompt tokens prefilled through the fused lane, "
+                "by tenant",
+                tenant=self._tname(s.req)).inc(end - s0)
+            ln["fed_end"] = end
+            feeds.append(dict(shard=sh, lane=ln, s0=s0, end=end,
+                              t0=self._clock()))
+        if not any(e is not None for e in lanes):
+            return None, feeds
+        return lanes, feeds
+
+    def _lane_land(self, feeds) -> None:
+        """Deliver one round's lane results: confirm each fed chunk
+        (paged host length, ``lane`` span, dispatch accounting) and, on
+        a prompt's FINAL chunk, draw/record the first token — the
+        ``_token_done`` seat flip that turns the prefilling occupant
+        into a decoder next round. ``_lane_scratch`` holds the round's
+        (lane_out, lane_hid); a round that never delivered (all-failed
+        isolation) rewinds ``fed_end`` so the chunk re-feeds — its
+        rewrite is byte-identical, so a retried chunk costs nothing but
+        the dispatch."""
+        scratch, self._lane_scratch = self._lane_scratch, None
+        if not feeds:
+            return
+        if scratch is None:
+            for f in feeds:
+                ln = f["lane"]
+                if self._lanes[f["shard"]] is ln:
+                    ln["fed_end"] = ln["done_end"]
+            return
+        lane_out, lane_hid = scratch
+        for f in feeds:
+            sh, ln = f["shard"], f["lane"]
+            if self._lanes[sh] is not ln:
+                continue  # dropped while the chunk flew
+            i = ln["slot"]
+            s = self._slots[i]
+            if s is None or self._epoch[i] != ln["epoch"]:
+                self._lane_drop(sh, "occupant_retired")
+                continue
+            self.prefill_dispatches += 1
+            ln["chunks"] += 1
+            ln["done_end"] = f["end"]
+            if self.paged is not None:
+                self.paged.set_len(i, f["end"])
+            t1 = self._clock()
+            self.obs.tracer.record(
+                "lane", f["t0"], t1, parent=ln["root"],
+                chunk=ln["chunks"], start=f["s0"], end=f["end"],
+                slot=i)
+            if f["end"] < len(ln["ids"]):
+                continue  # mid-prompt: more chunks to feed
+            # final chunk: the fused epilogue's draw (or logits row) is
+            # this prompt's first token — the serial _prefill_into tail
+            req = s.req
+            if self.engine.sample_on_device:
+                first = int(np.asarray(lane_out)[sh])
+            else:
+                row = np.asarray(lane_out)[sh]
+                first = int(sampling.sample_jit(
+                    row[None, :], ln["key"],
+                    np.float32([req.temperature]),
+                    np.int32([req.top_k]),
+                    np.float32([req.top_p]))[0])
+            if self._hidden is not None and lane_hid is not None:
+                self._hidden = self._hidden.at[i].set(
+                    jnp.asarray(lane_hid)[sh])
+            if self.paged is not None:
+                self.paged.register_prompt(i, ln["ids"], salt=req.tenant)
+            with self._scratch_mu:
+                self._last_prefill = {"dispatches": ln["chunks"],
+                                      "cached_tokens": ln["cached"],
+                                      "lane": True}
+            self.obs.tracer.end(ln["span"], dispatches=ln["chunks"],
+                                cached_tokens=ln["cached"], lane=True)
+            self._lanes[sh] = None
+            s.prefilling = False
+            if self._overlap:
+                # seed the device-carried last-token row (round N+1's
+                # input) exactly like a serial admission's seat patch
+                self._dev_last = self._dev_tok().at[i].set(first)
+            self._token_done(i, first)
+
     # dp rebalance discipline (the fleet controller's hysteresis/cooloff
     # shape, applied to slot placement): act only past a real skew, then
     # sit out a few rounds so admission/retirement churn settles before
@@ -1268,8 +1595,11 @@ class ContinuousBatcher:
         if occ[hi] - occ[lo] < self.REBALANCE_WATERMARK:
             return
         spb = self.engine.slots_per_shard
+        # a prefilling occupant never migrates: its lane record pins the
+        # slot to its shard and its host length trails the fed chunks
         src = next((i for i in range(hi * spb, (hi + 1) * spb)
-                    if self._slots[i] is not None), None)
+                    if self._slots[i] is not None
+                    and not self._slots[i].prefilling), None)
         dst = next((i for i in range(lo * spb, (lo + 1) * spb)
                     if self._slots[i] is None), None)
         if src is None or dst is None:
@@ -1400,15 +1730,23 @@ class ContinuousBatcher:
         if not any(s is not None for s in self._slots):
             return
         for i, s in enumerate(self._slots):
-            self._budget[i] = self._remaining(i) if s is not None else 0
+            # a lane occupant rides the dispatch INACTIVE until its
+            # final chunk lands (budget 0 — its ghost row is overwritten
+            # by the lane chunk inside the same trace)
+            self._budget[i] = (self._remaining(i)
+                               if s is not None and not s.prefilling
+                               else 0)
         budget = self._budget.copy()
+        lanes, feeds = self._lane_feed()
+        self._lane_scratch = None
         t_round = self._clock()
         spec_lens = spec_kinds = None
         if self.engine.spec_len > 0:
             spec_lens, spec_kinds = self._plan_spec()
         if spec_lens is not None:
             toks, counts, failed = self._spec_round(budget, spec_lens,
-                                                    spec_kinds)
+                                                    spec_kinds,
+                                                    lanes=lanes)
         else:
             block = self.engine.decode_block_len
             if self._sched == "slot":
@@ -1426,7 +1764,19 @@ class ContinuousBatcher:
                     self.params, self._cache, self._last_tok, keys,
                     self._eos, b, self._temp, self._top_k, self._top_p,
                     adapter_ids=(self._adapter if self.engine.adapters
-                                 is not None else None))
+                                 is not None else None), lanes=lanes)
+                if self._mixed:
+                    # strip the fused lane tail (token/logits row
+                    # [+ lane hidden]) — _lane_land consumes it after
+                    # the round delivers. An isolation re-dispatch
+                    # re-runs the lane chunk too: same rows, same bytes,
+                    # so restashing is idempotent.
+                    lane_hid = None
+                    if self.engine.return_hidden:
+                        *out, lane_out, lane_hid = out
+                    else:
+                        *out, lane_out = out
+                    self._lane_scratch = (lane_out, lane_hid)
                 if self._sched == "slot":
                     # the slot program's extra next-token output feeds the
                     # overlap pipeline; the synchronous path ignores it
@@ -1482,6 +1832,7 @@ class ContinuousBatcher:
                 if self._slots[i] is None:  # device/host rule mismatch guard
                     break
                 self._token_done(i, int(t))
+        self._lane_land(feeds)
         self._host_work_hist.observe(
             max(0.0, self._clock() - t_step0 - self._step_sync_wait))
 
@@ -1549,7 +1900,12 @@ class ContinuousBatcher:
             self._sync_inflight()
             return
         for i, s in enumerate(self._slots):
-            self._budget[i] = self._remaining(i) if s is not None else 0
+            # a lane occupant rides the dispatch INACTIVE until its
+            # final chunk lands (budget 0 — its ghost row is overwritten
+            # by the lane chunk inside the same trace)
+            self._budget[i] = (self._remaining(i)
+                               if s is not None and not s.prefilling
+                               else 0)
         budget = self._budget.copy()
         rec = self._issue_round(budget)
         self._sync_inflight(next_t0=None if rec is None else rec["t0"])
@@ -1570,6 +1926,11 @@ class ContinuousBatcher:
         t_round = self._clock()
         lead = (None if self._inflight is None
                 else self._inflight.get("lead"))
+        # the lane rides the in-flight round and lands one round later
+        # at sync, exactly like admissions already do: a chunk fed here
+        # executes after the previous round's chunk (the cache donation
+        # chain sequences them), so fed_end may lead done_end by one
+        lanes, feeds = self._lane_feed()
         spec_lens = spec_kinds = None
         if self.engine.spec_len > 0:
             spec_lens, spec_kinds = self._plan_spec()
@@ -1583,7 +1944,7 @@ class ContinuousBatcher:
                 return self.engine.decode_block(
                     self.params, self._cache, toks_in, self._base_keys,
                     self._eos, b, self._temp, self._top_k, self._top_p,
-                    adapter_ids=adapter, lead=lead)
+                    adapter_ids=adapter, lead=lead, lanes=lanes)
         else:
             kind = "verify"
             nwrite = self.engine.spec_len + 1
@@ -1598,7 +1959,8 @@ class ContinuousBatcher:
                 return self.engine.verify(
                     self.params, self._cache, dev_tokens, self._base_keys,
                     self._eos, b, self._temp, self._top_k, self._top_p,
-                    draft_len=spec_lens, adapter_ids=adapter, lead=lead)
+                    draft_len=spec_lens, adapter_ids=adapter, lead=lead,
+                    lanes=lanes)
         t0 = self._clock()
         self._note_issue(t0)
         epochs = self._epoch.copy()
@@ -1608,8 +1970,14 @@ class ContinuousBatcher:
             _log_dispatch_failure("issue", "active slots", e)
             self._sync_inflight()
             self._round_fallback(kind, t_round, budget, spec_lens,
-                                 spec_kinds, issue)
+                                 spec_kinds, issue, feeds=feeds)
             return None
+        lane_out = lane_hid = None
+        if self._mixed:
+            if self.engine.return_hidden:
+                *out, lane_out, lane_hid = out
+            else:
+                *out, lane_out = out
         if spec_lens is None:
             accepted = None
             if self.engine.return_hidden:
@@ -1629,6 +1997,9 @@ class ContinuousBatcher:
                     budget=budget, epochs=epochs, toks=toks,
                     counts=counts, accepted=accepted, hid=hid,
                     spec_lens=spec_lens, spec_kinds=spec_kinds,
+                    # lane futures + feed records: the sync stage lands
+                    # them after the round's outputs materialize
+                    lane=(lane_out, lane_hid), feeds=feeds,
                     # the NEXT issue's _pre_write reach: this round may
                     # advance each slot by up to lead rows before the
                     # stale host_len catches up at sync
@@ -1636,22 +2007,34 @@ class ContinuousBatcher:
                     seq=self._round_seq)
 
     def _round_fallback(self, kind, t_round, budget, spec_lens,
-                        spec_kinds, issue) -> None:
+                        spec_kinds, issue, feeds=()) -> None:
         """Issue-time failure recovery: the pipeline is already drained
         (host state is current again), so re-run the round's built inputs
         through ``_guarded_round`` — the legacy retry/isolation semantics,
         transient chaos faults absorbed identically — and deliver
         synchronously like a non-overlapped step. Budget rows of seats
         freed by the drain are masked (their occupants are gone; a stale
-        row would generate into a released seat)."""
+        row would generate into a released seat). ``feeds`` are the
+        failed issue's lane feed records: the ``issue`` closure carries
+        their chunk operands, so the re-dispatch advances the lane too
+        (byte-identical rewrite under isolation) and the shared land
+        stage confirms or rewinds it."""
         occ = np.array([s is not None for s in self._slots])
         budget = np.where(occ, budget, 0).astype(budget.dtype)
         g = self.engine.spec_len
+        self._lane_scratch = None
 
         def dispatch(b):
             t0 = self._clock()
             self._note_issue(t0)
             out = issue(b, self._dev_tok())
+            if self._mixed:
+                lane_hid = None
+                if self.engine.return_hidden:
+                    *out, lane_out, lane_hid = out
+                else:
+                    *out, lane_out = out
+                self._lane_scratch = (lane_out, lane_hid)
             if kind == "decode":
                 accepted = None
                 if self.engine.return_hidden:
@@ -1717,6 +2100,7 @@ class ContinuousBatcher:
                 if self._slots[i] is None:
                     break
                 self._token_done(i, int(t))
+        self._lane_land(feeds)
 
     def _sync_inflight(self, next_t0=None) -> None:
         """Drain the in-flight round: materialize its device outputs (the
@@ -1744,7 +2128,15 @@ class ContinuousBatcher:
                 self._cache_lost()
                 return
             # outputs unrecoverable but the cache survived: the round's
-            # slots retire like a failed dispatch's would
+            # slots retire like a failed dispatch's would. The lane's
+            # chunk outputs are equally unrecoverable — rewind its feed
+            # so the chunk re-runs (a byte-identical rewrite; the ghost
+            # row an interim round writes past the stale length lands
+            # masked, NULL-paged, or overwritten by the refeed).
+            for f in rec.get("feeds") or ():
+                ln = f["lane"]
+                if self._lanes[f["shard"]] is ln:
+                    ln["fed_end"] = ln["done_end"]
             for i in range(len(self._slots)):
                 if (self._slots[i] is not None and rec["budget"][i] > 0
                         and rec["epochs"][i] == self._epoch[i]):
@@ -1800,6 +2192,12 @@ class ContinuousBatcher:
                 if self._slots[i] is None:
                     break
                 self._token_done(i, int(t))
+        if rec.get("feeds"):
+            # the round's lane chunk lands with its outputs: confirmed
+            # host lengths, lane span, and — on the final chunk — the
+            # first token, one round after it was fed (like admissions)
+            self._lane_scratch = rec["lane"]
+            self._lane_land(rec["feeds"])
 
     def _rebalance_overlap(self) -> None:
         """dp rebalance under overlap: the migration planner reads the
@@ -1862,6 +2260,10 @@ class ContinuousBatcher:
         for i, s in enumerate(self._slots):
             if s is not None:
                 self._finish(i, "error")
+        for sh in range(len(self._lanes)):
+            # any lane record the _finish sweep above did not close
+            # (stale occupant) dies with the cache it was writing into
+            self._lane_drop(sh, "cache_lost")
 
     def _guarded_round(self, dispatch, budget) -> tuple:
         """Run one decode/verify round with fault recovery.
@@ -2003,7 +2405,7 @@ class ContinuousBatcher:
             if self.controller is not None:
                 self.controller.record(i, gi, acc)
 
-    def _spec_round(self, budget, lens, kinds) -> tuple:
+    def _spec_round(self, budget, lens, kinds, lanes=None) -> tuple:
         """One draft-verify round: propose ``lens[i]`` tokens per occupied
         slot (per-slot RAGGED under the controller; the full
         ``engine.spec_len`` otherwise), dispatch ONE ``engine.verify``
@@ -2033,7 +2435,16 @@ class ContinuousBatcher:
                 self.params, self._cache, tokens, key, self._eos,
                 b, self._temp, self._top_k, self._top_p, draft_len=lens,
                 adapter_ids=(self._adapter if self.engine.adapters
-                             is not None else None))
+                             is not None else None), lanes=lanes)
+            if self._mixed:
+                # strip the fused lane tail for _lane_land (idempotent
+                # under isolation re-dispatch — see step()'s closure)
+                lane_hid = None
+                if self.engine.return_hidden:
+                    *out, lane_out, lane_hid = out
+                else:
+                    *out, lane_out = out
+                self._lane_scratch = (lane_out, lane_hid)
             if self._sched == "slot":
                 # extra next-token output (overlap feed) — ignored here
                 if self.engine.return_hidden:

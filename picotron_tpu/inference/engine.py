@@ -127,6 +127,7 @@ class InferenceEngine:
                  drafter: Optional[str] = None,
                  return_hidden: Optional[bool] = None,
                  overlap: Optional[bool] = None,
+                 mixed_dispatch: Optional[bool] = None,
                  key_schedule: Optional[str] = None,
                  hooks=None, adapters=None):
         self.cfg = inference_config(cfg)
@@ -234,19 +235,34 @@ class InferenceEngine:
         # keeps today's programs byte-identical.
         if overlap is not None:
             inf.overlap = bool(overlap)
+        if mixed_dispatch is not None:
+            inf.mixed_dispatch = bool(mixed_dispatch)
         if key_schedule is not None:
             inf.key_schedule = key_schedule
         self.overlap = bool(inf.overlap)
+        # Mixed prefill–decode dispatch (docs/INFERENCE.md "Mixed
+        # prefill–decode dispatch"): every decode/verify dispatch also
+        # advances one fixed-width prefill LANE (prefill_chunk tokens,
+        # padded/masked when idle so the compiled shape never changes).
+        # Like overlap, mixed streams must be keyed per slot so the lane's
+        # round placement cannot move a sampled token.
+        self.mixed = bool(inf.mixed_dispatch)
         ks = inf.key_schedule
         if ks not in ("auto", "round", "slot"):
             raise ValueError(
                 f"unknown key_schedule {ks!r} (auto|round|slot)")
         if ks == "auto":
-            ks = "slot" if self.overlap else "round"
+            ks = "slot" if (self.overlap or self.mixed) else "round"
         elif ks == "round" and self.overlap:
             raise ValueError(
                 "overlap requires the per-slot key schedule — round-keyed "
                 "sampling ties streams to round boundaries; use "
+                "key_schedule='slot' (or 'auto')")
+        elif ks == "round" and self.mixed:
+            raise ValueError(
+                "mixed_dispatch requires the per-slot key schedule — "
+                "round-keyed sampling ties streams to round boundaries, "
+                "which fusing the prefill lane changes; use "
                 "key_schedule='slot' (or 'auto')")
         self.key_schedule = ks
         # Deferred paged length advance: the overlapped batcher's sync
@@ -537,6 +553,19 @@ class InferenceEngine:
             self._decode_block_slot_jit = self._make_decode_block_slot_jit()
             if self.spec_len > 0:
                 self._verify_slot_jit = self._make_verify_slot_jit()
+        # mixed prefill–decode dispatch variants (mixed_dispatch): the
+        # slot-keyed programs + one fused prefill lane. Only built (and
+        # only dispatched) on a mixed engine — a mixed-off engine's
+        # program set stays byte-identical.
+        self._decode_block_mixed_jit = None
+        self._decode_block_mixed_poison_jit = None
+        self._verify_mixed_jit = None
+        self._verify_mixed_poison_jit = None
+        if self.mixed:
+            self._decode_block_mixed_jit = \
+                self._make_decode_block_mixed_jit()
+            if self.spec_len > 0:
+                self._verify_mixed_jit = self._make_verify_mixed_jit()
 
     def _make_verify_jit(self, poison: bool = False):
         dpP = P("dp") if self.dp_size > 1 else P()
@@ -551,6 +580,13 @@ class InferenceEngine:
     def _verify_prog(self, poison: bool):
         """The verify executable to run (lazily builds the chaos
         NaN-poisoned variant)."""
+        if self.mixed:
+            if not poison:
+                return self._verify_mixed_jit
+            if self._verify_mixed_poison_jit is None:
+                self._verify_mixed_poison_jit = self._make_verify_mixed_jit(
+                    poison=True)
+            return self._verify_mixed_poison_jit
         if self.key_schedule == "slot":
             if not poison:
                 return self._verify_slot_jit
@@ -590,6 +626,13 @@ class InferenceEngine:
     def _decode_block_prog(self, poison: bool):
         """The decode-block executable to run (lazily builds the chaos
         NaN-poisoned variant)."""
+        if self.mixed:
+            if not poison:
+                return self._decode_block_mixed_jit
+            if self._decode_block_mixed_poison_jit is None:
+                self._decode_block_mixed_poison_jit = \
+                    self._make_decode_block_mixed_jit(poison=True)
+            return self._decode_block_mixed_poison_jit
         if self.key_schedule == "slot":
             if not poison:
                 return self._decode_block_slot_jit
@@ -617,6 +660,49 @@ class InferenceEngine:
             in_specs=(self._decode_dispatch_pspecs, self._cspecs,
                       dpP, dpP, dpP, dpP, dpP, dpP, dpP),
             out_specs=(self._cspecs, dpP, dpP, dpP) + hidB),
+            donate_argnums=(1,))
+
+    def _lane_specs(self):
+        """(in_specs, out_specs) tails the prefill lane adds to a mixed
+        program: every lane operand/output is a per-shard [dp, ...] row
+        set, so they all shard over dp exactly like the per-slot batch
+        operands (dp == 1 collapses to replicated)."""
+        dpP = P("dp") if self.dp_size > 1 else P()
+        lane_in = (dpP, dpP, dpP, dpP)  # tokens, slot, start, valid
+        if self.sample_on_device:
+            lane_in += (dpP, dpP, dpP, dpP)  # key, temp, top_k, top_p
+        if self.adapters is not None:
+            lane_in += (dpP,)
+        lane_out = (dpP,) + ((dpP,) if self.return_hidden else ())
+        return lane_in, lane_out
+
+    def _make_decode_block_mixed_jit(self, poison: bool = False):
+        """The fused decode-block + prefill-lane program
+        (mixed_dispatch): the slot-keyed decode block's operands followed
+        by the lane tail (``_lane_chunk``)."""
+        dpP = P("dp") if self.dp_size > 1 else P()
+        hidB = (dpP,) if self.return_hidden else ()
+        lane_in, lane_out = self._lane_specs()
+        return jax.jit(shard_map(
+            partial(self._decode_block_mixed_impl, poison=poison),
+            self.topo.mesh,
+            in_specs=(self._decode_dispatch_pspecs, self._cspecs,
+                      dpP, dpP, dpP, dpP, dpP, dpP, dpP) + lane_in,
+            out_specs=(self._cspecs, dpP, dpP, dpP) + hidB + lane_out),
+            donate_argnums=(1,))
+
+    def _make_verify_mixed_jit(self, poison: bool = False):
+        """The fused verify + prefill-lane program (mixed_dispatch)."""
+        dpP = P("dp") if self.dp_size > 1 else P()
+        hidB = (dpP,) if self.return_hidden else ()
+        lane_in, lane_out = self._lane_specs()
+        return jax.jit(shard_map(
+            partial(self._verify_mixed_impl, poison=poison),
+            self.topo.mesh,
+            in_specs=(self._decode_dispatch_pspecs, self._cspecs,
+                      dpP, dpP, dpP, dpP, dpP, dpP, dpP, dpP) + lane_in,
+            out_specs=(self._cspecs, dpP, dpP, dpP, dpP) + hidB
+            + lane_out),
             donate_argnums=(1,))
 
     # ---- dispatch hooks + graceful degradation ----------------------------
@@ -1230,6 +1316,129 @@ class InferenceEngine:
             return new_cache, out, self._owner_reduce(h_last[:, 0], owner)
         return new_cache, out
 
+    def _lane_chunk(self, params, cache, tokens, slot, start, valid, *rest):
+        """The fused prefill LANE: one fixed-width chunk for one slot per
+        dp shard, run on the cache the SAME dispatch's decode half just
+        updated. All operands arrive shard-local ([1, ...] rows of the
+        [dp, ...] host arrays): tokens [1, C], slot [1] (LOCAL slot
+        index, clipped-valid when idle), start [1] (the chunk's first
+        write row — the contiguous window slide / paged absolute start,
+        exactly ``prefill_chunked``'s convention), valid [1] (real token
+        count; 0 = idle lane). ``rest`` carries (key [1, 2], temperature
+        [1], top_k [1], top_p [1]) on a sample_on_device engine and the
+        lane's adapter id [1] on a tenancy engine.
+
+        The body IS the serial chunk program's: same B = 1 slot view,
+        same batched-scatter cache_write, same ``pos_q = start + s``
+        rows, same last-valid-token head slice, same epilogue from the
+        same key — so every K/V byte and every logit bit matches what a
+        separate ``prefill_chunked`` dispatch would have produced. An
+        idle lane still traces (shape stability = one compile): its
+        writes are where'd out (contiguous) or land on this shard's NULL
+        scratch page (paged), its lengths stay untouched, and its
+        sampled token is garbage the host discards. Unlike the serial
+        chunk program there is NO dp owner psum — each shard runs its
+        OWN lane and keeps its result in its [dp] output row."""
+        cfg = self.cfg
+        rest = list(rest)
+        sample = ()
+        if self.sample_on_device:
+            key, s_temp, s_topk, s_topp = rest[:4]
+            rest = rest[4:]
+            sample = (key[0], s_temp, s_topk, s_topp)
+        C = tokens.shape[1]
+        slot_i = jnp.asarray(slot[0], jnp.int32)
+        start_i = jnp.asarray(start[0], jnp.int32)
+        valid_i = jnp.asarray(valid[0], jnp.int32)
+        active = valid_i > 0
+        lane_params = params
+        if self.adapters is not None:
+            # the decode binding carried per-slot ids [L, local slots];
+            # the lane's B = 1 compute needs ITS row — rebind in-trace
+            # (same {"w","a","b","ids"} leaf form the serial chunk
+            # dispatch binds host-side)
+            adapter = rest[0]
+            L = cfg.model.num_hidden_layers
+            ids1 = jnp.broadcast_to(
+                jnp.asarray(adapter, jnp.int32)[None, :], (L, 1))
+            layers = dict(params["layers"])
+            for name in llama.QUANT_WEIGHT_LEAVES:
+                layers[name] = {**layers[name], "ids": ids1}
+            lane_params = {**params, "layers": layers}
+        pos_rows = (start_i + jnp.arange(C, dtype=jnp.int32))[None, :]
+        cos_b, sin_b = rope_at_positions(self._cos, self._sin, pos_rows)
+        h = llama.embed_lookup(lane_params["embed"],
+                               tokens).astype(self._dt)
+        leaves, lengths = self._split_cache(cache)
+        pos = jnp.full((1,), start_i, jnp.int32)
+        if self.kv_layout == "paged":
+            local_meta = self._local_meta(cache)
+            row = lax.dynamic_slice_in_dim(local_meta["block_tables"],
+                                           slot_i, 1, axis=0)
+            # idle lane scribbles this shard's NULL scratch page
+            row = jnp.where(active, row, jnp.zeros_like(row))
+            meta = {**local_meta, "block_tables": row}
+            body = self._layer_body(cos_b, sin_b, pos, meta)
+            h, new_leaves = lax.scan(body, h,
+                                     (lane_params["layers"], leaves))
+        else:
+            def body(hc, xs):
+                lp, lc = xs
+                slot_c = {n: lax.dynamic_slice_in_dim(a, slot_i, 1, axis=0)
+                          for n, a in lc.items()}
+                hc, slot_new = llama.decoder_layer(lp, hc, cos_b, sin_b,
+                                                   cfg, cache=slot_c,
+                                                   pos=pos)
+                slot_new = {n: jnp.where(active, slot_new[n], slot_c[n])
+                            for n in slot_new}
+                lc = {n: lax.dynamic_update_slice_in_dim(
+                    lc[n], slot_new[n], slot_i, axis=0) for n in lc}
+                return hc, lc
+
+            h, new_leaves = lax.scan(body, h,
+                                     (lane_params["layers"], leaves))
+        idx = jnp.clip(valid_i - 1, 0, C - 1)
+        h_last = jnp.take_along_axis(
+            h, jnp.full((1, 1, 1), idx, jnp.int32), axis=1)
+        last = tp_gather(llama.head_logits(lane_params, h_last, cfg))[:, 0]
+        last = last.astype(jnp.float32)
+        new_lengths = jnp.where(active,
+                                lengths.at[slot_i].set(start_i + valid_i),
+                                lengths)
+        new_cache = self._rebuild(cache, new_leaves, new_lengths)
+        out = self._epilogue(last, *sample) if self.sample_on_device \
+            else last
+        if self.return_hidden:
+            return new_cache, out, h_last[:, 0]
+        return new_cache, out
+
+    def _decode_block_mixed_impl(self, params, cache, tokens, base_keys,
+                                 eos_id, budget, temperature, top_k,
+                                 top_p, *lane, poison=False):
+        """``_decode_block_slot_impl`` + one prefill lane in the SAME
+        program: the decode half runs first (the lane slot rides through
+        it inactive — budget 0, so its ghost row lands at its current
+        length and the lane immediately overwrites it), then the lane
+        chunk advances on the updated cache. Appends the lane outputs
+        (sampled token / logits row[, lane hidden]) after the decode
+        family's."""
+        d = self._decode_block_slot_impl(
+            params, cache, tokens, base_keys, eos_id, budget,
+            temperature, top_k, top_p, poison=poison)
+        ln = self._lane_chunk(params, d[0], *lane)
+        return (ln[0],) + d[1:] + ln[1:]
+
+    def _verify_mixed_impl(self, params, cache, tokens, valid, base_keys,
+                           eos_id, budget, temperature, top_k, top_p,
+                           *lane, poison=False):
+        """``_verify_slot_impl`` + one prefill lane, same contract as
+        ``_decode_block_mixed_impl``."""
+        d = self._verify_slot_impl(
+            params, cache, tokens, valid, base_keys, eos_id, budget,
+            temperature, top_k, top_p, poison=poison)
+        ln = self._lane_chunk(params, d[0], *lane)
+        return (ln[0],) + d[1:] + ln[1:]
+
     # ---- host-facing API ---------------------------------------------------
 
     def shard_params(self, params):
@@ -1458,6 +1667,86 @@ class InferenceEngine:
                 jnp.asarray(np.asarray(top_k, np.int32).reshape(1)),
                 jnp.asarray(np.asarray(top_p, np.float32).reshape(1)))
 
+    def _lane_args(self, lanes) -> tuple:
+        """Build the mixed programs' lane operand tail from per-shard
+        lane feeds. ``lanes`` is None (every lane idle) or a list of
+        ``dp_size`` entries, each None or a dict with ``slot`` (GLOBAL
+        slot id on that shard), ``tokens`` (the chunk's 1..prefill_chunk
+        real token ids), ``start`` (first write row — the caller applies
+        the contiguous window slide / paged absolute convention,
+        ``prefill_chunked``'s exact rule), and on a sample_on_device
+        engine ``key``/``temperature``/``top_k``/``top_p`` (the SAME
+        fold-at-len(prompt)-1 key every chunk of the serial path
+        samples with), plus ``adapter`` on a tenancy engine. Idle lanes
+        pad to fixed shapes (valid = 0) so the compiled program never
+        changes."""
+        dp = self.dp_size
+        C = self.prefill_chunk
+        toks = np.zeros((dp, C), np.int32)
+        slot = np.zeros(dp, np.int32)
+        start = np.zeros(dp, np.int32)
+        valid = np.zeros(dp, np.int32)
+        keyrows = np.zeros((dp, 2), np.uint32)
+        temp = np.ones(dp, np.float32)
+        topk = np.zeros(dp, np.int32)
+        topp = np.ones(dp, np.float32)
+        adapter = np.zeros(dp, np.int32)
+        if lanes is not None:
+            if len(lanes) != dp:
+                raise ValueError(
+                    f"lanes carries {len(lanes)} entries; this engine "
+                    f"serves one lane per dp shard ({dp})")
+            for sh, ln in enumerate(lanes):
+                if ln is None:
+                    continue
+                g = int(ln["slot"])
+                lo = sh * self.slots_per_shard
+                if not lo <= g < lo + self.slots_per_shard:
+                    raise ValueError(
+                        f"lane slot {g} does not live on dp shard {sh} "
+                        f"(slots [{lo}, {lo + self.slots_per_shard}))")
+                chunk = np.asarray(ln["tokens"], np.int32).reshape(-1)
+                if not 0 < chunk.size <= C:
+                    raise ValueError(
+                        f"lane chunk must carry 1..prefill_chunk ({C}) "
+                        f"real tokens; got {chunk.size}")
+                slot[sh] = g - lo
+                start[sh] = int(ln["start"])
+                toks[sh, : chunk.size] = chunk
+                valid[sh] = chunk.size
+                if self.sample_on_device:
+                    keyrows[sh] = np.asarray(ln["key"]).reshape(2)
+                    temp[sh] = np.float32(ln.get("temperature", 1.0))
+                    topk[sh] = np.int32(ln.get("top_k", 0))
+                    topp[sh] = np.float32(ln.get("top_p", 1.0))
+                if self.adapters is not None:
+                    adapter[sh] = int(ln.get("adapter") or 0)
+        args = (jnp.asarray(toks), jnp.asarray(slot), jnp.asarray(start),
+                jnp.asarray(valid))
+        if self.sample_on_device:
+            args += (jnp.asarray(keyrows), jnp.asarray(temp),
+                     jnp.asarray(topk), jnp.asarray(topp))
+        if self.adapters is not None:
+            args += (jnp.asarray(adapter),)
+        return args
+
+    def _lane_ensure(self, cache, lanes) -> dict:
+        """Paged pre-write for the lane chunks: make every active lane's
+        real rows [start, start + len(tokens)) writable (growth alloc +
+        COW) BEFORE the fused dispatch — the caller's ``_pre_write``
+        follows and ships the synced tables. Trailing pad rows target
+        unallocated table entries and drop to the NULL page, exactly
+        like the serial chunk dispatch."""
+        if self.paged is None or lanes is None:
+            return cache
+        for ln in lanes:
+            if ln is None:
+                continue
+            s0 = int(ln["start"])
+            n = int(np.asarray(ln["tokens"]).reshape(-1).size)
+            cache = self._ensure(cache, int(ln["slot"]), s0, s0 + n)
+        return cache
+
     def prefill(self, params, prompt_ids, sample=None,
                 adapter_id=None) -> tuple:
         """Run one prompt through the full-sequence model. Returns
@@ -1480,8 +1769,11 @@ class InferenceEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : ids.size] = ids
         self._hook("prefill")
-        return self._prefill_jit(params, jnp.asarray(padded),
-                                 jnp.asarray([ids.size], jnp.int32), *samp)
+        # resolved inside the lambda like every hot-path program, so the
+        # flash->dense fallback's rebuilt jit is what a re-dispatch runs
+        return self._dispatch(lambda: self._prefill_jit(
+            params, jnp.asarray(padded),
+            jnp.asarray([ids.size], jnp.int32), *samp))
 
     def prefill_chunked(self, params, cache, prompt_ids, slot: int,
                         start: int = 0, sample=None,
@@ -1812,7 +2104,7 @@ class InferenceEngine:
 
     def decode_block(self, params, cache, tokens, keys, eos_id, budget,
                      temperature, top_k, top_p, adapter_ids=None,
-                     lead=None) -> tuple:
+                     lead=None, lanes=None) -> tuple:
         """``decode_block_len`` tokens for every slot in one dispatch.
         ``keys`` is [decode_block_len, 2] (one PRNG key per in-block step)
         on a round-keyed engine, or the per-slot BASE keys [slots, 2] on a
@@ -1828,7 +2120,19 @@ class InferenceEngine:
         its last active step. Consumes ``cache``. ``lead`` forwards to
         ``_pre_write`` (overlap's stale-host_len reach allowance); with
         ``defer_advance`` set the paged length bookkeeping is skipped
-        here — the caller's sync stage applies it (``apply_advance``)."""
+        here — the caller's sync stage applies it (``apply_advance``).
+
+        ``lanes`` (mixed_dispatch engines only — see ``_lane_args``)
+        feeds each dp shard's fused prefill lane; a mixed engine ALWAYS
+        runs the fused program (idle padded lanes when None), so the
+        compiled shape never changes. The lane outputs ride at the end
+        of the returned tuple: the lane token [dp] (sample_on_device) or
+        logits [dp, V], then lane hidden [dp, H] on a return_hidden
+        engine."""
+        if lanes is not None and not self.mixed:
+            raise ValueError(
+                "lanes requires a mixed_dispatch engine (construct with "
+                "mixed_dispatch=True or set inference.mixed_dispatch)")
         keys = jnp.asarray(keys)
         if self.key_schedule == "slot":
             if keys.shape != (self.slots, 2):
@@ -1845,8 +2149,10 @@ class InferenceEngine:
             params = self.bind_adapter_ids(params, adapter_ids, self.slots)
         poison = self._poison("decode")
         if self.paged is not None:
+            cache = self._lane_ensure(cache, lanes)
             cache = self._pre_write(cache, self.decode_block_len,
                                     budget=budget, lead=lead)
+        lane_args = self._lane_args(lanes) if self.mixed else ()
         # a device tokens array must NOT round-trip through np.asarray —
         # that sync is exactly what the overlap pipeline exists to avoid
         tok_in = (tokens if isinstance(tokens, jax.Array)
@@ -1859,7 +2165,7 @@ class InferenceEngine:
             jnp.asarray(np.asarray(budget, np.int32)),
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
-            jnp.asarray(np.asarray(top_p, np.float32))))
+            jnp.asarray(np.asarray(top_p, np.float32)), *lane_args))
         if self.paged is not None and not self.defer_advance:
             # mirror device length advancement (counts per slot). The
             # host sync this forces is the block's ONE sync, just moved
@@ -1869,7 +2175,7 @@ class InferenceEngine:
 
     def verify(self, params, cache, tokens, key, eos_id, budget,
                temperature, top_k, top_p, draft_len=None,
-               adapter_ids=None, lead=None) -> tuple:
+               adapter_ids=None, lead=None, lanes=None) -> tuple:
         """One speculative draft-verify dispatch for every slot
         (``spec_len > 0`` engines only). ``tokens`` is
         [slots, spec_len + 1] int32 — column 0 is each slot's current last
@@ -1889,8 +2195,14 @@ class InferenceEngine:
         [slots, 2] and ``tokens`` may be a device array) appends next_tok
         [slots] — each row's on-device last emitted token — and a
         ``return_hidden`` engine appends hidden [slots, H]. Consumes
-        ``cache``. ``lead``/``defer_advance``: see ``decode_block``."""
-        if self._verify_jit is None and self._verify_slot_jit is None:
+        ``cache``. ``lead``/``defer_advance``/``lanes``: see
+        ``decode_block``."""
+        if lanes is not None and not self.mixed:
+            raise ValueError(
+                "lanes requires a mixed_dispatch engine (construct with "
+                "mixed_dispatch=True or set inference.mixed_dispatch)")
+        if (self._verify_jit is None and self._verify_slot_jit is None
+                and self._verify_mixed_jit is None):
             raise ValueError(
                 "speculative decoding is off for this engine (spec_len == "
                 "0); construct it with spec_len > 0 or set "
@@ -1934,7 +2246,9 @@ class InferenceEngine:
             # parked slot; ensuring them all exclusive BEFORE the dispatch
             # is what makes the rollback free — rejected rows strand in
             # pages only this slot holds, never in a shared one
+            cache = self._lane_ensure(cache, lanes)
             cache = self._pre_write(cache, self.spec_len + 1, lead=lead)
+        lane_args = self._lane_args(lanes) if self.mixed else ()
         # resolved inside the lambda, exactly like decode_block's program
         out = self._dispatch(lambda: self._verify_prog(poison)(
             params, cache, jnp.asarray(tokens), jnp.asarray(valid), key,
@@ -1942,7 +2256,7 @@ class InferenceEngine:
             jnp.asarray(np.asarray(budget, np.int32)),
             jnp.asarray(np.asarray(temperature, np.float32)),
             jnp.asarray(np.asarray(top_k, np.int32)),
-            jnp.asarray(np.asarray(top_p, np.float32))))
+            jnp.asarray(np.asarray(top_p, np.float32)), *lane_args))
         if self.paged is not None and not self.defer_advance:
             # device lengths advanced by the ACCEPTED counts (the length
             # pointer is the rollback) — mirror exactly that

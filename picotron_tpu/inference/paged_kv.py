@@ -891,6 +891,19 @@ class PagedKV:
             self.cached_tokens += cached
         return cached
 
+    def peek_prefix(self, ids, cap_last: bool = True,
+                    salt: str = "") -> int:
+        """Read-only admission probe: the cached-prefix length
+        ``match_prefix`` would resolve for ``ids``, WITHOUT taking page
+        references, touching slot state, or counting a query — the
+        mixed-dispatch batcher's lane-eligibility check (a one-shot-
+        sized miss takes the serial one-shot path; everything else
+        rides the lane)."""
+        if not self.prefix_cache:
+            return 0
+        _, matched = self.radix.match(ids, salt=salt)
+        return min(matched, len(ids) - (1 if cap_last else 0))
+
     def ensure_writable(self, slot: int, from_pos: int, to_pos: int) -> list:
         """Make rows ``[from_pos, to_pos)`` of ``slot`` writable: allocate
         missing pages, and for shared pages (refcount > 1) allocate a
@@ -1268,6 +1281,14 @@ class ShardedPagedKV:
         return [(src + base, dst + base) for src, dst in
                 self.shards[s].ensure_writable(self.local_slot(slot),
                                                from_pos, to_pos)]
+
+    def peek_prefix(self, ids, cap_last: bool = True, salt: str = "",
+                    shard: int = 0) -> int:
+        """Read-only probe against ONE shard's radix domain (prefix
+        domains are per shard, so the caller names the shard the slot
+        would seat on)."""
+        return self.shards[shard].peek_prefix(ids, cap_last=cap_last,
+                                              salt=salt)
 
     def register_prompt(self, slot: int, ids, salt: str = "") -> None:
         self.shards[self.shard_of(slot)].register_prompt(

@@ -171,6 +171,79 @@ def overlap_chain(trace: dict) -> dict:
     return out
 
 
+def lane_chain(trace: dict) -> dict:
+    """Validate the mixed-dispatch prefill-lane span chain
+    (inference.mixed_dispatch; docs/INFERENCE.md "Mixed prefill–decode
+    dispatch"): every ``lane`` event (one confirmed lane chunk) must
+    parent to a ``request`` root, and per request the chunks must tile
+    the prompt — chunk numbers 1..n with each chunk starting where the
+    previous ended, the last one landing at the lane prefill span's
+    ``prompt_tokens``. Returns {"lanes", "linked", "errors"}; the
+    mixed obs gate requires >= 1 linked and no errors
+    (``--require-lane-chain``)."""
+    events = [e for e in trace.get("traceEvents", ())
+              if isinstance(e, dict)]
+    by_id = {}
+    for e in events:
+        sid = (e.get("args") or {}).get("id")
+        if sid is not None:
+            by_id[sid] = e
+    # prompt length per request root, from the lane=True prefill span
+    prompt_of = {}
+    for e in events:
+        args = e.get("args") or {}
+        if (e.get("name") == "prefill" and args.get("lane")
+                and "prompt_tokens" in args):
+            prompt_of[args.get("parent")] = args["prompt_tokens"]
+    out = {"lanes": 0, "linked": 0, "errors": []}
+    per_root: dict = {}
+    for i, ev in enumerate(events):
+        if ev.get("name") != "lane":
+            continue
+        out["lanes"] += 1
+        args = ev.get("args") or {}
+        parent = by_id.get(args.get("parent"))
+        if parent is None:
+            out["errors"].append(
+                f"event {i}: lane span has no resolvable parent")
+            continue
+        if parent.get("name") != "request":
+            out["errors"].append(
+                f"event {i}: lane parent is {parent.get('name')!r}, "
+                f"expected a request root")
+            continue
+        if not all(k in args for k in ("chunk", "start", "end")):
+            out["errors"].append(
+                f"event {i}: lane span missing chunk/start/end args")
+            continue
+        if args["end"] <= args["start"]:
+            out["errors"].append(
+                f"event {i}: empty lane chunk window "
+                f"[{args['start']}, {args['end']}]")
+            continue
+        per_root.setdefault(args.get("parent"), []).append((i, args))
+        out["linked"] += 1
+    for root, chunks in per_root.items():
+        chunks.sort(key=lambda c: c[1]["chunk"])
+        if [c[1]["chunk"] for c in chunks] != list(
+                range(1, len(chunks) + 1)):
+            out["errors"].append(
+                f"request {root}: lane chunk numbers "
+                f"{[c[1]['chunk'] for c in chunks]} are not 1..n")
+            continue
+        for (i, a), (_, b) in zip(chunks, chunks[1:]):
+            if b["start"] != a["end"]:
+                out["errors"].append(
+                    f"request {root}: lane chunk {b['chunk']} starts at "
+                    f"{b['start']}, previous ended at {a['end']}")
+        want = prompt_of.get(root)
+        if want is not None and chunks[-1][1]["end"] != want:
+            out["errors"].append(
+                f"request {root}: lane chunks end at "
+                f"{chunks[-1][1]['end']}, prompt has {want} tokens")
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="validate/query Chrome-trace JSON from the span "
@@ -189,6 +262,10 @@ def main(argv=None) -> int:
                     help="fail unless >= 1 'overlap' span links to a "
                          "dispatch/* parent within its window (the "
                          "inference.overlap pipeline's obs-smoke gate)")
+    ap.add_argument("--require-lane-chain", action="store_true",
+                    help="fail unless >= 1 'lane' span links to a request "
+                         "root with chunks tiling the prompt (the "
+                         "inference.mixed_dispatch obs gate)")
     args = ap.parse_args(argv)
     if not args.path and not args.url:
         ap.error("pass a trace file path or --url")
@@ -233,6 +310,17 @@ def main(argv=None) -> int:
             if not ov["overlaps"]:
                 print("FAILED: no overlap spans in trace "
                       "(was the server run with --overlap?)",
+                      file=sys.stderr)
+            return 1
+    if args.require_lane_chain:
+        la = lane_chain(trace)
+        print(f"lane chain: {la['lanes']} spans, {la['linked']} linked")
+        for e in la["errors"]:
+            print(f"FAILED: {e}", file=sys.stderr)
+        if la["errors"] or not la["linked"]:
+            if not la["lanes"]:
+                print("FAILED: no lane spans in trace "
+                      "(was the server run with mixed_dispatch?)",
                       file=sys.stderr)
             return 1
     return 0

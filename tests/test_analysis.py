@@ -693,6 +693,91 @@ def test_j004_negative_hoisted_jit_and_def_in_loop(tmp_path):
 
 
 # --------------------------------------------------------------------------- #
+# PICO-J006: model program dispatched outside _dispatch
+# --------------------------------------------------------------------------- #
+
+
+def test_j006_program_called_outside_dispatch(tmp_path):
+    found = _scan(tmp_path, """
+        class Engine:
+            def _dispatch(self, call):
+                return call()
+
+            def decode(self, params, cache):
+                return self._decode_jit(params, cache)
+        """)
+    assert _rules(found) == ["PICO-J006"]
+    assert found[0].context == "Engine.decode"
+    assert "_decode_jit" in found[0].message
+    assert "_dispatch" in found[0].message
+
+
+def test_j006_negative_routed_through_dispatch(tmp_path):
+    found = _scan(tmp_path, """
+        class Engine:
+            def _dispatch(self, call):
+                return call()
+
+            def decode(self, params, cache):
+                return self._dispatch(lambda: self._decode_jit(params, cache))
+
+            def verify(self, params, cache):
+                return self._dispatch(
+                    call=lambda: self._verify_prog(params, cache))
+        """)
+    assert found == []
+
+
+def test_j006_negative_housekeeping_and_builders(tmp_path):
+    # Housekeeping jits take the cache (or nothing) first — not model
+    # dispatches.  `_make_*` builders construct rather than run programs.
+    found = _scan(tmp_path, """
+        class Engine:
+            def _dispatch(self, call):
+                return call()
+
+            def setup(self, params, cache, slot):
+                self._decode_jit = self._make_decode_jit(params)
+                cache = self._init_cache_jit(cache)
+                cache = self._set_length_jit(cache, slot)
+                return cache
+        """)
+    assert found == []
+
+
+def test_j006_negative_class_without_dispatch(tmp_path):
+    # The rule only binds classes that define the fault wrapper.
+    found = _scan(tmp_path, """
+        class Helper:
+            def decode(self, params, cache):
+                return self._decode_jit(params, cache)
+        """)
+    assert found == []
+
+
+def test_j006_mixed_routed_and_direct_in_one_class(tmp_path):
+    found = _scan(tmp_path, """
+        class Engine:
+            def _dispatch(self, call):
+                try:
+                    return call()
+                except RuntimeError:
+                    return call()
+
+            def good(self, params, cache):
+                return self._dispatch(lambda: self._block_jit(params, cache))
+
+            def bad(self, params, cache):
+                out = self._verify_jit(params, cache)
+                return out
+        """)
+    assert _rules(found) == ["PICO-J006"]
+    assert len(found) == 1
+    assert found[0].context == "Engine.bad"
+    assert "self._verify_jit" in found[0].snippet
+
+
+# --------------------------------------------------------------------------- #
 # PICO-C001: lock-order inversion
 # --------------------------------------------------------------------------- #
 
@@ -1494,6 +1579,7 @@ def test_rule_catalog_is_stable():
     removing or renaming one breaks every consumer."""
     assert set(RULES) == {
         "PICO-J001", "PICO-J002", "PICO-J003", "PICO-J004", "PICO-J005",
+        "PICO-J006",
         "PICO-C001", "PICO-C002", "PICO-C003", "PICO-C004"}
     for rule in RULES.values():
         assert rule.title and rule.rationale

@@ -130,6 +130,33 @@ def test_corpus_above_memory_cap_stays_arrow_backed(
     assert arrow._epoch == mem._epoch
 
 
+def test_arrow_gather_batched_take_bitwise_equals_per_row(
+        tiny_model_kwargs, json_corpus):
+    """_ArrowSamples.gather is one batched arrow `take`; it must return
+    bit-for-bit what the per-row fetch loop returns — same dtype, same
+    shape, same values — including repeated and unsorted indices (the
+    wrap-around batch pattern the loader actually produces)."""
+    from picotron_tpu.data import _ArrowSamples
+
+    cfg = make_config(tiny_model_kwargs, seq=32, mbs=2)
+    cfg.dataset.name = json_corpus
+    cfg.dataset.max_in_memory_tokens = 100  # force the arrow path
+    loader = MicroBatchDataLoader(cfg, tokenizer=ToyTokenizer(
+        cfg.model.vocab_size))
+    samples = loader.samples
+    assert isinstance(samples, _ArrowSamples)
+    n = len(samples)
+    rng = np.random.default_rng(3)
+    for idx in (np.arange(min(8, n)),
+                np.asarray([n - 1, 0, n // 2, 0]),  # unsorted + repeated
+                rng.integers(0, n, 16)):
+        got = samples.gather(np.asarray(idx))
+        ref = samples._gather_per_row(np.asarray(idx))
+        assert got.dtype == ref.dtype == np.int32
+        assert got.shape == ref.shape
+        np.testing.assert_array_equal(got, ref)
+
+
 def test_arrow_loader_skip_steps_matches_memory(tiny_model_kwargs,
                                                 json_corpus):
     """Resume support on the arrow-backed path: skip_steps must land the

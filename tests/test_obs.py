@@ -302,6 +302,56 @@ def test_trace_dump_cli_roundtrip(tmp_path):
     assert trace_dump.main([str(p2), "--require-request-chain"]) == 1
 
 
+def test_trace_dump_lane_chain_audit(tmp_path):
+    """The mixed-dispatch lane gate: ``lane`` spans must parent to a
+    request root and tile the prompt — chunk numbers 1..n, each chunk
+    starting where the previous ended, the last landing at the lane
+    prefill span's prompt_tokens. Gaps, bad numbering, or a short final
+    chunk fail ``--require-lane-chain``."""
+    tr = SpanTracer(ring=32)
+    root = tr.begin("request", uid="u1")
+    pf = tr.begin("prefill", parent=root, uid="u1", prompt_tokens=20,
+                  lane=True)
+    tr.record("lane", 0.0, 0.1, parent=root, chunk=1, start=0, end=8,
+              slot=0)
+    tr.record("lane", 0.1, 0.2, parent=root, chunk=2, start=8, end=16,
+              slot=0)
+    tr.record("lane", 0.2, 0.3, parent=root, chunk=3, start=16, end=20,
+              slot=0)
+    tr.end(pf, dispatches=3, lane=True)
+    tr.end(root)
+    good = tmp_path / "lane.json"
+    tr.dump_chrome(str(good))
+    la = trace_dump.lane_chain(trace_dump.load(str(good)))
+    assert la == {"lanes": 3, "linked": 3, "errors": []}
+    assert trace_dump.main([str(good), "--require-lane-chain"]) == 0
+
+    # a gap between chunks (8 -> 12) and a short final chunk both fail
+    tr2 = SpanTracer(ring=32)
+    r2 = tr2.begin("request", uid="u2")
+    pf2 = tr2.begin("prefill", parent=r2, uid="u2", prompt_tokens=20,
+                    lane=True)
+    tr2.record("lane", 0.0, 0.1, parent=r2, chunk=1, start=0, end=8,
+               slot=0)
+    tr2.record("lane", 0.1, 0.2, parent=r2, chunk=2, start=12, end=18,
+               slot=0)
+    tr2.end(pf2, dispatches=2, lane=True)
+    tr2.end(r2)
+    bad = tmp_path / "lane_bad.json"
+    tr2.dump_chrome(str(bad))
+    la2 = trace_dump.lane_chain(trace_dump.load(str(bad)))
+    assert any("starts at 12" in e for e in la2["errors"])
+    assert any("prompt has 20 tokens" in e for e in la2["errors"])
+    assert trace_dump.main([str(bad), "--require-lane-chain"]) == 1
+    # no lane spans at all: the gate reports the likely cause
+    empty = tmp_path / "none.json"
+    tr3 = SpanTracer(ring=4)
+    r3 = tr3.begin("request", uid="u3")
+    tr3.end(r3)
+    tr3.dump_chrome(str(empty))
+    assert trace_dump.main([str(empty), "--require-lane-chain"]) == 1
+
+
 # --------------------------------------------------------------------------- #
 # engine/batcher integration
 # --------------------------------------------------------------------------- #
